@@ -1,0 +1,25 @@
+"""mobilefinetuner_tpu — a TPU-native LLM fine-tuning framework.
+
+A from-scratch JAX/XLA rebuild with the capabilities of the MobileFineTuner
+reference (C++ CPU mobile fine-tuning framework): end-to-end LoRA and full
+fine-tuning of GPT-2 (small/medium/large/xl) and Gemma-3 (270M/1B) on
+WikiText-2, HF-compatible SafeTensors weight/adapter I/O, PEFT-format adapter
+save/resume, perplexity + MMLU evaluation, gradient accumulation, FSDP-style
+parameter/grad/optimizer-state sharding over a TPU mesh (the TPU-native
+equivalent of the reference's single-device disk-offload ParameterSharder),
+host-RAM offload, and a deterministic step governor (the reference's
+energy-aware throttler re-imagined as a duty-cycle knob).
+
+Layer map (TPU-native re-design of the reference's L0-L10; see SURVEY.md):
+  - L0-L3 (memory pools, autograd engine, hand-written kernels) collapse into
+    JAX/XLA: `jnp` ops + autodiff + the XLA allocator; `ops/` holds only what
+    XLA does not give us for free (fused LM loss with internal label shift,
+    flash attention via Pallas, RoPE).
+  - L4-L5 models are pure-functional pytree modules (`models/`).
+  - L6 data/tokenizers are host-side (`data/`), with native C++ fast paths.
+  - L7 optimizers/trainers: `optim/`, `train/`.
+  - L8 CLIs: `cli/`.
+  - L9 system optimizations: `parallel/` (FSDP, offload), `train/governor.py`.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,166 @@
+"""Model configurations, parsed from HF `config.json`.
+
+TPU-native analog of the reference's hand-rolled JSON field extraction
+(reference: operators/finetune_ops/graph/gpt2_model.h:50-66 `GPT2Config`,
+graph/gemma_model.h:17-43 `GemmaTextConfig`, both with `from_pretrained(dir)`).
+We parse with the stdlib json module instead of hand-rolled string scanning,
+but keep the same field surface + defaults so the same HF checkpoint dirs work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import List, Optional
+
+
+def _load_config_json(model_dir: str) -> dict:
+    path = os.path.join(model_dir, "config.json")
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+@dataclasses.dataclass
+class GPT2Config:
+    """GPT-2 family config (reference: graph/gpt2_model.h:50-66).
+
+    Field names follow HF `config.json` for GPT-2 (n_embd/n_head/n_layer/...).
+    """
+
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    layer_norm_epsilon: float = 1e-5
+    # "gelu_new" = tanh approximation; the reference's gelu matches HF
+    # gelu_new / gelu_pytorch_tanh (reference: core/ops.cpp:1055-1062).
+    activation_function: str = "gelu_new"
+    embd_pdrop: float = 0.0
+    resid_pdrop: float = 0.0
+    attn_pdrop: float = 0.0
+    tie_word_embeddings: bool = True
+    # Attention impl: "flash" (Pallas kernel), "xla" (plain jnp reference).
+    attention_impl: str = "xla"
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @classmethod
+    def from_pretrained(cls, model_dir: str) -> "GPT2Config":
+        raw = _load_config_json(model_dir)
+        return cls(
+            vocab_size=raw.get("vocab_size", 50257),
+            n_positions=raw.get("n_positions", raw.get("n_ctx", 1024)),
+            n_embd=raw.get("n_embd", 768),
+            n_layer=raw.get("n_layer", 12),
+            n_head=raw.get("n_head", 12),
+            layer_norm_epsilon=raw.get("layer_norm_epsilon", 1e-5),
+            activation_function=raw.get("activation_function", "gelu_new"),
+            tie_word_embeddings=raw.get("tie_word_embeddings", True),
+        )
+
+    @classmethod
+    def gpt2_small(cls) -> "GPT2Config":
+        return cls()
+
+    @classmethod
+    def gpt2_medium(cls) -> "GPT2Config":
+        return cls(n_embd=1024, n_layer=24, n_head=16)
+
+    @classmethod
+    def gpt2_large(cls) -> "GPT2Config":
+        return cls(n_embd=1280, n_layer=36, n_head=20)
+
+    @classmethod
+    def gpt2_xl(cls) -> "GPT2Config":
+        return cls(n_embd=1600, n_layer=48, n_head=25)
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 257) -> "GPT2Config":
+        """A tiny config for tests (fast CPU forward/backward)."""
+        return cls(vocab_size=vocab_size, n_positions=64, n_embd=32,
+                   n_layer=2, n_head=2)
+
+
+@dataclasses.dataclass
+class Gemma3TextConfig:
+    """Gemma-3 text-decoder config (reference: graph/gemma_model.h:17-43).
+
+    Defaults are the Gemma-3-270M text config. Key Gemma-3 specifics mirrored
+    from the reference model graph (graph/gemma_model.cpp):
+    - embeddings scaled by sqrt(hidden_size) (gemma_model.cpp:222-248)
+    - GQA with num_key_value_heads < num_attention_heads
+    - per-head q/k RMSNorm
+    - dual RoPE theta: `rope_theta` (global layers) vs `rope_local_base_freq`
+      (sliding-window layers), chosen per `layer_types[i]`
+      (gemma_model.cpp:579-625)
+    - 512-token sliding-window mask on local layers (gemma_model.h:26)
+    - sandwich norms + (1+weight) RMSNorm semantics (core/ops.cpp:1489)
+    - untied behavior: lm_head weight is tied to embeddings in HF Gemma-3.
+    """
+
+    vocab_size: int = 262144
+    hidden_size: int = 640
+    intermediate_size: int = 2048
+    num_hidden_layers: int = 18
+    num_attention_heads: int = 4
+    num_key_value_heads: int = 1
+    head_dim: int = 256
+    max_position_embeddings: int = 32768
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1000000.0
+    rope_local_base_freq: float = 10000.0
+    sliding_window: int = 512
+    # Per-layer attention type: "full_attention" | "sliding_attention".
+    # Gemma-3 default pattern: 5 local : 1 global.
+    layer_types: Optional[List[str]] = None
+    query_pre_attn_scalar: float = 256.0
+    hidden_activation: str = "gelu_pytorch_tanh"
+    tie_word_embeddings: bool = True
+    sliding_window_pattern: int = 6
+    attention_impl: str = "xla"
+
+    def __post_init__(self):
+        if self.layer_types is None:
+            p = self.sliding_window_pattern
+            self.layer_types = [
+                "full_attention" if (i + 1) % p == 0 else "sliding_attention"
+                for i in range(self.num_hidden_layers)
+            ]
+
+    def is_global_layer(self, i: int) -> bool:
+        return self.layer_types[i] == "full_attention"
+
+    @classmethod
+    def from_pretrained(cls, model_dir: str) -> "Gemma3TextConfig":
+        raw = _load_config_json(model_dir)
+        # Multimodal Gemma-3 checkpoints nest the text config.
+        if "text_config" in raw:
+            raw = raw["text_config"]
+        kw = {}
+        for f in dataclasses.fields(cls):
+            if f.name in raw:
+                kw[f.name] = raw[f.name]
+        return cls(**kw)
+
+    @classmethod
+    def gemma3_270m(cls) -> "Gemma3TextConfig":
+        return cls()
+
+    @classmethod
+    def gemma3_1b(cls) -> "Gemma3TextConfig":
+        return cls(hidden_size=1152, intermediate_size=6912,
+                   num_hidden_layers=26, num_attention_heads=4,
+                   num_key_value_heads=1, head_dim=256)
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 300) -> "Gemma3TextConfig":
+        """Tiny config for tests; keeps GQA + local/global interleave."""
+        return cls(vocab_size=vocab_size, hidden_size=32,
+                   intermediate_size=64, num_hidden_layers=4,
+                   num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+                   max_position_embeddings=128, sliding_window=16,
+                   query_pre_attn_scalar=8.0, sliding_window_pattern=3)

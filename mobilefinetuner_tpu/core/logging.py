@@ -1,0 +1,86 @@
+"""Leveled logger + CSV metrics + JSONL eval output.
+
+TPU-native analog of the reference's logging stack
+(reference: operators/finetune_ops/utils/logger.h:21-226 — leveled Logger with
+file+console sinks, MetricsLogger CSV with columns
+timestamp,epoch,step,loss,avg_loss,lr,step_time_ms, and OPS_LOG_* macros) and
+of the CLIs' JSONL eval-append output (gpt2_lora_finetune/main.cpp:654-664).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import logging
+import os
+import sys
+import time
+from typing import Optional
+
+
+def get_logger(name: str = "mft", level: str = "INFO",
+               log_file: Optional[str] = None) -> logging.Logger:
+    logger = logging.getLogger(name)
+    logger.setLevel(getattr(logging, level.upper(), logging.INFO))
+    fmt = logging.Formatter(
+        "[%(asctime)s] [%(levelname)s] %(message)s", "%Y-%m-%d %H:%M:%S")
+    if not any(isinstance(h, logging.StreamHandler)
+               and not isinstance(h, logging.FileHandler)
+               for h in logger.handlers):
+        sh = logging.StreamHandler(sys.stderr)
+        sh.setFormatter(fmt)
+        logger.addHandler(sh)
+    if log_file:
+        target = os.path.abspath(log_file)
+        have = any(isinstance(h, logging.FileHandler)
+                   and getattr(h, "baseFilename", None) == target
+                   for h in logger.handlers)
+        if not have:
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            fh = logging.FileHandler(target)
+            fh.setFormatter(fmt)
+            logger.addHandler(fh)
+    logger.propagate = False
+    return logger
+
+
+class MetricsLogger:
+    """CSV training-metrics sink, one row per logged step.
+
+    Columns mirror the reference MetricsLogger (logger.h:131-190).
+    """
+
+    COLUMNS = ["timestamp", "epoch", "step", "loss", "avg_loss", "lr",
+               "step_time_ms"]
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        new = not os.path.exists(path)
+        self._f = open(path, "a", newline="")
+        self._w = csv.writer(self._f)
+        if new:
+            self._w.writerow(self.COLUMNS)
+            self._f.flush()
+
+    def log(self, epoch: int, step: int, loss: float, avg_loss: float,
+            lr: float, step_time_ms: float):
+        self._w.writerow([f"{time.time():.3f}", epoch, step, f"{loss:.6f}",
+                          f"{avg_loss:.6f}", f"{lr:.8f}",
+                          f"{step_time_ms:.2f}"])
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+class JSONLWriter:
+    """Append-only JSONL sink for eval records (main.cpp:654-664 analog)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def write(self, record: dict):
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")

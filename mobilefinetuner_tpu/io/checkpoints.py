@@ -1,0 +1,214 @@
+"""HF checkpoint <-> framework-pytree conversion for GPT-2 and Gemma-3.
+
+Reference: operators/finetune_ops/graph/safetensors_loader.cpp
+(`GPT2KeyMapper` mapping HF `h.i.attn.c_attn.*` -> internal keys;
+`GemmaKeyMapper` mapping `model.layers.i.*`). Our internal layout stacks
+per-layer tensors into [L, ...] arrays (models/gpt2.py, models/gemma3.py),
+so "mapping" here is gather+stack rather than per-key rename.
+
+GPT-2 Conv1D subtlety (SURVEY.md §7.3): HF GPT-2 linear weights are stored
+[in, out] (Conv1D) and our models compute y = x @ W, so NO transpose is
+applied — the same reason the reference CLI disables its loader transpose
+(gpt2_lora_finetune/main.cpp:292-296). Gemma weights are true nn.Linear
+[out, in]; we transpose those to [in, out] at load.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from mobilefinetuner_tpu.core.config import GPT2Config, Gemma3TextConfig
+from mobilefinetuner_tpu.io.safetensors_io import (SafeTensorsReader,
+                                                   save_safetensors)
+
+
+def load_hf_state_dict(model_dir: str,
+                       promote_to_f32: bool = True) -> Dict[str, np.ndarray]:
+    """Load an HF checkpoint dir's full state dict — single-file or sharded
+    (model.safetensors.index.json) layouts."""
+    index = os.path.join(model_dir, "model.safetensors.index.json")
+    if os.path.exists(index):
+        import json
+        with open(index) as f:
+            weight_map = json.load(f)["weight_map"]
+        out = {}
+        for shard in sorted(set(weight_map.values())):
+            reader = SafeTensorsReader(os.path.join(model_dir, shard))
+            out.update(reader.load_all(promote_to_f32))
+        return out
+    return SafeTensorsReader(
+        _find_weights_file(model_dir)).load_all(promote_to_f32)
+
+
+def _find_weights_file(model_dir: str) -> str:
+    for name in ("model.safetensors", "pytorch_model.safetensors"):
+        p = os.path.join(model_dir, name)
+        if os.path.exists(p):
+            return p
+    cands = [f for f in os.listdir(model_dir) if f.endswith(".safetensors")]
+    if len(cands) == 1:
+        return os.path.join(model_dir, cands[0])
+    if cands:
+        raise FileNotFoundError(
+            f"multiple safetensors shards in {model_dir} but no "
+            "model.safetensors.index.json")
+    raise FileNotFoundError(f"no safetensors weights in {model_dir}")
+
+
+def _strip_prefix(tensors: Dict[str, np.ndarray],
+                  prefixes=("transformer.", "model.")) -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in tensors.items():
+        for p in prefixes:
+            if k.startswith(p):
+                k = k[len(p):]
+                break
+        out[k] = v
+    return out
+
+
+# ----------------------------- GPT-2 ---------------------------------------
+
+def gpt2_params_from_hf(tensors: Dict[str, np.ndarray],
+                        config: GPT2Config) -> dict:
+    """HF GPT2LMHeadModel state-dict -> stacked pytree (float32 numpy)."""
+    t = _strip_prefix(tensors)
+    L = config.n_layer
+
+    def stack(fmt):
+        return np.stack([t[fmt.format(i)] for i in range(L)])
+
+    return {
+        "wte": t["wte.weight"],
+        "wpe": t["wpe.weight"],
+        "blocks": {
+            "ln_1": {"g": stack("h.{}.ln_1.weight"),
+                     "b": stack("h.{}.ln_1.bias")},
+            "attn": {
+                "qkv_w": stack("h.{}.attn.c_attn.weight"),
+                "qkv_b": stack("h.{}.attn.c_attn.bias"),
+                "proj_w": stack("h.{}.attn.c_proj.weight"),
+                "proj_b": stack("h.{}.attn.c_proj.bias"),
+            },
+            "ln_2": {"g": stack("h.{}.ln_2.weight"),
+                     "b": stack("h.{}.ln_2.bias")},
+            "mlp": {
+                "fc_w": stack("h.{}.mlp.c_fc.weight"),
+                "fc_b": stack("h.{}.mlp.c_fc.bias"),
+                "proj_w": stack("h.{}.mlp.c_proj.weight"),
+                "proj_b": stack("h.{}.mlp.c_proj.bias"),
+            },
+        },
+        "ln_f": {"g": t["ln_f.weight"], "b": t["ln_f.bias"]},
+    }
+
+
+def gpt2_params_to_hf(params, prefix: str = "") -> Dict[str, np.ndarray]:
+    """Stacked pytree -> HF GPT2LMHeadModel key scheme (for full-FT save,
+    reference: gpt2_full_finetune/main.cpp:156-237)."""
+    p = {k: np.asarray(v) for k, v in (
+        ("wte.weight", params["wte"]), ("wpe.weight", params["wpe"]),
+        ("ln_f.weight", params["ln_f"]["g"]),
+        ("ln_f.bias", params["ln_f"]["b"]))}
+    b = params["blocks"]
+    L = np.asarray(b["ln_1"]["g"]).shape[0]
+    names = [
+        ("h.{}.ln_1.weight", b["ln_1"]["g"]),
+        ("h.{}.ln_1.bias", b["ln_1"]["b"]),
+        ("h.{}.attn.c_attn.weight", b["attn"]["qkv_w"]),
+        ("h.{}.attn.c_attn.bias", b["attn"]["qkv_b"]),
+        ("h.{}.attn.c_proj.weight", b["attn"]["proj_w"]),
+        ("h.{}.attn.c_proj.bias", b["attn"]["proj_b"]),
+        ("h.{}.ln_2.weight", b["ln_2"]["g"]),
+        ("h.{}.ln_2.bias", b["ln_2"]["b"]),
+        ("h.{}.mlp.c_fc.weight", b["mlp"]["fc_w"]),
+        ("h.{}.mlp.c_fc.bias", b["mlp"]["fc_b"]),
+        ("h.{}.mlp.c_proj.weight", b["mlp"]["proj_w"]),
+        ("h.{}.mlp.c_proj.bias", b["mlp"]["proj_b"]),
+    ]
+    for fmt, arr in names:
+        arr = np.asarray(arr)
+        for i in range(L):
+            p[fmt.format(i)] = arr[i]
+    if prefix:
+        p = {prefix + k: v for k, v in p.items()}
+    return p
+
+
+def load_gpt2(model_dir: str, config: Optional[GPT2Config] = None):
+    """(config, params) from an HF GPT-2 checkpoint directory."""
+    if config is None:
+        config = GPT2Config.from_pretrained(model_dir)
+    tensors = load_hf_state_dict(model_dir)
+    return config, gpt2_params_from_hf(tensors, config)
+
+
+def save_gpt2(path: str, params, metadata: Optional[dict] = None):
+    save_safetensors(path, gpt2_params_to_hf(jax_to_numpy(params)),
+                     metadata=metadata or {"format": "pt"})
+
+
+# ----------------------------- Gemma-3 -------------------------------------
+
+def gemma3_params_from_hf(tensors: Dict[str, np.ndarray],
+                          config: Gemma3TextConfig) -> dict:
+    """HF Gemma3ForCausalLM (text) state-dict -> stacked pytree.
+
+    HF keys: model.embed_tokens.weight, model.layers.{i}.self_attn.{q,k,v,o}_proj.weight,
+    ...input_layernorm, post_attention_layernorm, pre_feedforward_layernorm,
+    post_feedforward_layernorm, self_attn.{q,k}_norm, mlp.{gate,up,down}_proj,
+    model.norm.weight. Linear weights are [out, in] -> transposed to [in, out].
+    """
+    t = {}
+    for k, v in tensors.items():
+        if k.startswith("model."):
+            k = k[len("model."):]
+        t[k] = v
+    L = config.num_hidden_layers
+
+    def stack_w(fmt):  # linear weight: transpose [out,in] -> [in,out]
+        return np.stack([t[fmt.format(i)].T for i in range(L)])
+
+    def stack(fmt):
+        return np.stack([t[fmt.format(i)] for i in range(L)])
+
+    a = "layers.{}.self_attn."
+    m = "layers.{}.mlp."
+    return {
+        "embed": t["embed_tokens.weight"],
+        "blocks": {
+            "input_ln": stack("layers.{}.input_layernorm.weight"),
+            "attn": {
+                "q_w": stack_w(a + "q_proj.weight"),
+                "k_w": stack_w(a + "k_proj.weight"),
+                "v_w": stack_w(a + "v_proj.weight"),
+                "o_w": stack_w(a + "o_proj.weight"),
+                "q_norm": stack(a + "q_norm.weight"),
+                "k_norm": stack(a + "k_norm.weight"),
+            },
+            "post_attn_ln": stack("layers.{}.post_attention_layernorm.weight"),
+            "pre_ffn_ln": stack("layers.{}.pre_feedforward_layernorm.weight"),
+            "mlp": {
+                "gate_w": stack_w(m + "gate_proj.weight"),
+                "up_w": stack_w(m + "up_proj.weight"),
+                "down_w": stack_w(m + "down_proj.weight"),
+            },
+            "post_ffn_ln": stack("layers.{}.post_feedforward_layernorm.weight"),
+        },
+        "final_norm": t["norm.weight"],
+    }
+
+
+def load_gemma3(model_dir: str, config: Optional[Gemma3TextConfig] = None):
+    if config is None:
+        config = Gemma3TextConfig.from_pretrained(model_dir)
+    tensors = load_hf_state_dict(model_dir)
+    return config, gemma3_params_from_hf(tensors, config)
+
+
+def jax_to_numpy(tree):
+    import jax
+    return jax.tree.map(lambda x: np.asarray(x), tree)

@@ -1,0 +1,130 @@
+"""SafeTensors reader/writer — self-contained implementation of the format
+(8-byte little-endian header length + JSON header + raw blob).
+
+Reference: operators/finetune_ops/graph/safetensors_loader.{h,cpp}
+(`SafeTensorsReader`, safetensors_loader.h:45-92) and the hand-written writer
+in gpt2_full_finetune/main.cpp:156-237 / graph/lora_saver.cpp. Like the
+reference we parse the header ourselves and memory-map the blob; unlike the
+reference (F32/F16 only, auto-promote to F32) we also handle BF16 — the
+TPU-native parameter dtype.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+# safetensors dtype tag -> (numpy dtype used for raw decode, itemsize)
+_DTYPES = {
+    "F64": np.dtype("<f8"), "F32": np.dtype("<f4"), "F16": np.dtype("<f2"),
+    "I64": np.dtype("<i8"), "I32": np.dtype("<i4"), "I16": np.dtype("<i2"),
+    "I8": np.dtype("i1"), "U8": np.dtype("u1"), "BOOL": np.dtype("?"),
+    # BF16 has no numpy dtype; decoded via uint16 bit tricks.
+    "BF16": np.dtype("<u2"),
+}
+_TO_TAG = {
+    np.dtype("float64"): "F64", np.dtype("float32"): "F32",
+    np.dtype("float16"): "F16", np.dtype("int64"): "I64",
+    np.dtype("int32"): "I32", np.dtype("int16"): "I16",
+    np.dtype("int8"): "I8", np.dtype("uint8"): "U8", np.dtype("bool"): "BOOL",
+}
+
+
+def _bf16_to_f32(raw_u16: np.ndarray) -> np.ndarray:
+    return (raw_u16.astype(np.uint32) << 16).view(np.float32)
+
+
+def _f32_to_bf16_u16(x: np.ndarray) -> np.ndarray:
+    u = np.ascontiguousarray(x, dtype=np.float32).view(np.uint32)
+    # round-to-nearest-even on the truncated mantissa
+    rounding = 0x7FFF + ((u >> 16) & 1)
+    return ((u + rounding) >> 16).astype(np.uint16)
+
+
+class SafeTensorsReader:
+    """Parses header eagerly, memory-maps the blob, loads tensors lazily."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "rb") as f:
+            (header_len,) = struct.unpack("<Q", f.read(8))
+            header = json.loads(f.read(header_len).decode("utf-8"))
+        self.metadata: Dict[str, str] = header.pop("__metadata__", {}) or {}
+        self.entries: Dict[str, dict] = header
+        self._blob = np.memmap(path, dtype=np.uint8, mode="r",
+                               offset=8 + header_len)
+
+    def keys(self):
+        return list(self.entries.keys())
+
+    def shape_dtype(self, name: str) -> Tuple[tuple, str]:
+        e = self.entries[name]
+        return tuple(e["shape"]), e["dtype"]
+
+    def load(self, name: str, promote_to_f32: bool = False) -> np.ndarray:
+        """Load one tensor as a numpy array (copy).
+
+        BF16 always decodes to float32 (numpy can't hold bf16); other dtypes
+        keep their storage dtype unless promote_to_f32.
+        """
+        e = self.entries[name]
+        tag = e["dtype"]
+        if tag not in _DTYPES:
+            raise ValueError(f"unsupported safetensors dtype {tag}")
+        begin, end = e["data_offsets"]
+        raw = np.frombuffer(self._blob[begin:end], dtype=_DTYPES[tag])
+        if tag == "BF16":
+            arr = _bf16_to_f32(raw)
+        else:
+            arr = raw.copy()
+        if promote_to_f32 and arr.dtype != np.float32:
+            arr = arr.astype(np.float32)
+        return arr.reshape(e["shape"])
+
+    def load_all(self, promote_to_f32: bool = False) -> Dict[str, np.ndarray]:
+        return {k: self.load(k, promote_to_f32) for k in self.entries}
+
+
+def save_safetensors(path: str, tensors: Dict[str, np.ndarray],
+                     metadata: Optional[Dict[str, str]] = None,
+                     bf16_keys: Optional[set] = None):
+    """Write a safetensors file. Keys in `bf16_keys` (or arrays already
+    passed as jax bfloat16 via float32 conversion upstream) are stored BF16.
+    """
+    header: Dict[str, object] = {}
+    if metadata:
+        header["__metadata__"] = {str(k): str(v)
+                                  for k, v in metadata.items()}
+    blobs = []
+    offset = 0
+    for name, arr in tensors.items():
+        arr = np.asarray(arr)
+        # jax bf16 arrays arrive as ml_dtypes.bfloat16 numpy arrays — store
+        # them as BF16, not silently upcast to F32.
+        is_bf16_input = arr.dtype.name == "bfloat16"
+        if is_bf16_input:
+            arr = arr.astype(np.float32)
+        if is_bf16_input or (bf16_keys and name in bf16_keys):
+            raw = _f32_to_bf16_u16(arr.astype(np.float32)).tobytes()
+            tag = "BF16"
+        else:
+            if arr.dtype not in _TO_TAG:
+                arr = arr.astype(np.float32)
+            raw = np.ascontiguousarray(arr).tobytes()
+            tag = _TO_TAG[arr.dtype]
+        header[name] = {"dtype": tag, "shape": list(arr.shape),
+                        "data_offsets": [offset, offset + len(raw)]}
+        blobs.append(raw)
+        offset += len(raw)
+    hjson = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    # Pad header to 8-byte alignment (spec-conformant, matches HF writer).
+    pad = (-(len(hjson)) % 8)
+    hjson += b" " * pad
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hjson)))
+        f.write(hjson)
+        for b in blobs:
+            f.write(b)

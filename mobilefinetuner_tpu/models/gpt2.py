@@ -1,0 +1,178 @@
+"""GPT-2 family decoder, pure-functional JAX.
+
+Re-design of the reference's GPT2Model graph
+(reference: operators/finetune_ops/graph/gpt2_model.{h,cpp}): pre-LN blocks
+with fused-QKV attention, gelu_new MLP, final LN, tied lm_head = x @ wte^T
+(gpt2_model.cpp:421-440). Differences by design:
+  - parameters are a pytree of stacked per-layer arrays ([L, ...]) and the
+    block stack runs under `lax.scan` — one compiled block body instead of L
+    unrolled copies (compile time, remat-friendly), idiomatic for XLA;
+  - weights keep HF Conv1D [in, out] layout so `y = x @ W + b` loads GPT-2
+    checkpoints without transposition (the reference needs a no-transpose
+    flag for exactly this reason, gpt2_lora_finetune/main.cpp:292-296);
+  - attention is fully differentiable on every path (the reference's default
+    memory-efficient attention is forward-only, SURVEY.md §2.12.1 — a bug we
+    deliberately do not replicate);
+  - autodiff, fusion, and memory management come from JAX/XLA instead of the
+    reference's L0-L3 hand-written engine.
+
+LoRA enters functionally: `forward(..., lora=...)` takes an optional pytree
+(see lora/lora.py) whose entries add scale·(x@A@B) to the matching linears.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from mobilefinetuner_tpu.core.config import GPT2Config
+from mobilefinetuner_tpu.ops.attention import attention
+
+
+def layer_norm(x, g, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps) * g + b
+    return out.astype(x.dtype)
+
+
+def gelu_new(x):
+    """tanh-approx gelu, matches HF gelu_new (reference core/ops.cpp:1055)."""
+    return jax.nn.gelu(x, approximate=True)
+
+
+def _maybe_lora(y, x, lora_entry, layer_idx=None):
+    """Add scale·(x@A@B) if a LoRA entry exists for this linear.
+
+    lora_entry: {"A": [in,r], "B": [r,out], "scale": scalar} — or stacked
+    [L,...] leaves indexed by layer_idx when running under scan.
+    Split-QKV column injection ({"q","k","v"} sub-entries with col offsets)
+    is handled in lora/lora.py by materializing a fused entry.
+    """
+    if lora_entry is None:
+        return y
+    A, B = lora_entry["A"], lora_entry["B"]
+    if layer_idx is not None and A.ndim == 3:
+        A, B = A[layer_idx], B[layer_idx]
+    delta = (x @ A.astype(x.dtype)) @ B.astype(x.dtype)
+    scale = jax.lax.stop_gradient(
+        jnp.asarray(lora_entry["scale"]).astype(y.dtype))
+    return y + scale * delta
+
+
+def init_params(config: GPT2Config, key: jax.Array,
+                dtype=jnp.float32) -> Dict[str, Any]:
+    """Random init (N(0, 0.02), zeros for biases/proj per GPT-2 paper)."""
+    E, L, V, P = (config.n_embd, config.n_layer, config.vocab_size,
+                  config.n_positions)
+    ks = jax.random.split(key, 8)
+    std = 0.02
+
+    def n(k, shape):
+        return (jax.random.normal(k, shape) * std).astype(dtype)
+
+    z = lambda *shape: jnp.zeros(shape, dtype)
+    o = lambda *shape: jnp.ones(shape, dtype)
+    return {
+        "wte": n(ks[0], (V, E)),
+        "wpe": n(ks[1], (P, E)),
+        "blocks": {
+            "ln_1": {"g": o(L, E), "b": z(L, E)},
+            "attn": {
+                "qkv_w": n(ks[2], (L, E, 3 * E)), "qkv_b": z(L, 3 * E),
+                "proj_w": n(ks[3], (L, E, E)), "proj_b": z(L, E),
+            },
+            "ln_2": {"g": o(L, E), "b": z(L, E)},
+            "mlp": {
+                "fc_w": n(ks[4], (L, E, 4 * E)), "fc_b": z(L, 4 * E),
+                "proj_w": n(ks[5], (L, 4 * E, E)), "proj_b": z(L, E),
+            },
+        },
+        "ln_f": {"g": o(E), "b": z(E)},
+    }
+
+
+def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx):
+    """One pre-LN transformer block. bp leaves are [L, ...]-stacked and
+    indexed by layer_idx (traced scalar under scan)."""
+    eps = config.layer_norm_epsilon
+    H, D = config.n_head, config.head_dim
+    B, S, E = x.shape
+    g = lambda t: t[layer_idx]
+    lb = lambda name: None if lora_b is None else lora_b.get(name)
+
+    h = layer_norm(x, g(bp["ln_1"]["g"]), g(bp["ln_1"]["b"]), eps)
+    qkv = h @ g(bp["attn"]["qkv_w"]) + g(bp["attn"]["qkv_b"])
+    qkv = _maybe_lora(qkv, h, lb("attn_qkv"), layer_idx)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    to_heads = lambda t: t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+    ctx = attention(to_heads(q), to_heads(k), to_heads(v),
+                    impl=config.attention_impl, is_causal=True,
+                    padding_mask=padding_mask)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, E)
+    proj = ctx @ g(bp["attn"]["proj_w"]) + g(bp["attn"]["proj_b"])
+    proj = _maybe_lora(proj, ctx, lb("attn_proj"), layer_idx)
+    x = x + proj
+
+    h = layer_norm(x, g(bp["ln_2"]["g"]), g(bp["ln_2"]["b"]), eps)
+    fc = h @ g(bp["mlp"]["fc_w"]) + g(bp["mlp"]["fc_b"])
+    fc = _maybe_lora(fc, h, lb("mlp_fc_in"), layer_idx)
+    act = gelu_new(fc)
+    out = act @ g(bp["mlp"]["proj_w"]) + g(bp["mlp"]["proj_b"])
+    out = _maybe_lora(out, act, lb("mlp_fc_out"), layer_idx)
+    return x + out
+
+
+def hidden_states(config: GPT2Config, params, input_ids,
+                  attention_mask=None, lora=None,
+                  compute_dtype=jnp.float32, remat: bool = False):
+    """Final-LN hidden states [B, S, E] (pre lm_head)."""
+    B, S = input_ids.shape
+    params = jax.tree.map(jnp.asarray, params)
+    if attention_mask is not None:
+        # HF convention: position ids count only unmasked tokens, so
+        # left-padded batches line up with HF GPT-2 exactly.
+        positions = jnp.clip(
+            jnp.cumsum(attention_mask.astype(jnp.int32), axis=-1) - 1, 0)
+        pos_emb = params["wpe"][positions]
+    else:
+        pos_emb = params["wpe"][:S][None, :, :]
+    x = params["wte"][input_ids] + pos_emb
+    x = x.astype(compute_dtype)
+    padding_mask = attention_mask
+    bp = jax.tree.map(lambda t: t.astype(compute_dtype)
+                      if jnp.issubdtype(t.dtype, jnp.floating) else t,
+                      params["blocks"])
+    lora_b = None if lora is None else lora.get("blocks")
+
+    body = lambda x, i: (_block(config, bp, x, padding_mask, lora_b, i), None)
+    if remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, jnp.arange(config.n_layer))
+    x = layer_norm(x, params["ln_f"]["g"].astype(compute_dtype),
+                   params["ln_f"]["b"].astype(compute_dtype),
+                   config.layer_norm_epsilon)
+    return x
+
+
+def forward(config: GPT2Config, params, input_ids, attention_mask=None,
+            lora=None, compute_dtype=jnp.float32,
+            remat: bool = False) -> jnp.ndarray:
+    """Logits [B, S, V]. Tied lm_head: x @ wte^T (gpt2_model.cpp:421-440).
+
+    The reference caches wte^T when embeddings are frozen (SURVEY.md
+    §2.12.5); under XLA the transpose is a free layout change, so no cache.
+    """
+    x = hidden_states(config, params, input_ids, attention_mask, lora,
+                      compute_dtype, remat)
+    wte = params["wte"].astype(compute_dtype)
+    logits = x @ wte.T
+    return logits
+
+
+def param_count(params) -> int:
+    return sum(int(x.size) for x in jax.tree.leaves(params))

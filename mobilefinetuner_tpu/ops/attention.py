@@ -1,0 +1,98 @@
+"""Attention: XLA reference implementation with causal + sliding-window +
+padding masks and GQA. A Pallas flash kernel (ops/flash_attention.py) is the
+fast path; this module is the always-correct fallback and the numerics oracle
+the kernel is tested against.
+
+Replaces the reference's two attention paths
+(operators/finetune_ops/core/memory_efficient_attention.cpp — forward-only
+streaming softmax — and the per-model scalar score loops in
+graph/gpt2_model.cpp:669-711 / graph/gemma_model.cpp:358-520). Unlike the
+reference's memory-efficient path, this one is differentiable (SURVEY.md
+§2.12.1: the reference's GPT-2 default attention severs the autograd graph;
+we do NOT replicate that bug — JAX autodiff covers every path).
+
+Everything here is jit-traceable with static shapes: masks are built with
+broadcasted iotas (no data-dependent control flow).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_mask(q_len: int, kv_len: int,
+                sliding_window: Optional[int] = None) -> jnp.ndarray:
+    """[q_len, kv_len] bool mask, True = attend.
+
+    Causal: key j visible to query i iff j <= i (+ offset when kv_len >
+    q_len, i.e. with a prefix/KV cache). Sliding window additionally
+    requires j > i - window (reference: gemma_model.h:145
+    `build_sliding_mask`, window default 512 = gemma_model.h:26).
+    """
+    offset = kv_len - q_len
+    qi = jax.lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 0) + offset
+    kj = jax.lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 1)
+    mask = kj <= qi
+    if sliding_window is not None:
+        mask &= kj > qi - sliding_window
+    return mask
+
+
+def dot_product_attention(
+        q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+        *,
+        scale: Optional[float] = None,
+        is_causal: bool = True,
+        sliding_window: Optional[int] = None,
+        padding_mask: Optional[jnp.ndarray] = None,
+        logits_dtype=jnp.float32) -> jnp.ndarray:
+    """Scaled dot-product attention with GQA.
+
+    q: [B, Hq, S, D]; k, v: [B, Hkv, S, D] with Hq % Hkv == 0 — GQA is
+    expressed by reshaping q into [B, Hkv, G, S, D] groups rather than
+    materializing repeated K/V heads (the reference materializes via
+    `repeat_kv_heads`, core/ops.cpp:2072; on TPU the einsum broadcast keeps
+    K/V in their small layout and saves HBM).
+    padding_mask: [B, S] bool/0-1, True/1 = real token.
+    scale: default 1/sqrt(D). (Gemma uses query_pre_attn_scalar^-0.5 —
+    pass it explicitly; gemma_model.h:33.)
+    """
+    B, Hq, S, D = q.shape
+    Hkv = k.shape[1]
+    assert Hq % Hkv == 0, (Hq, Hkv)
+    G = Hq // Hkv
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+
+    qg = q.reshape(B, Hkv, G, S, D)
+    scores = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k,
+                        preferred_element_type=logits_dtype)
+    scores = scores.astype(logits_dtype) * jnp.asarray(scale, logits_dtype)
+
+    neg = jnp.asarray(jnp.finfo(logits_dtype).min, logits_dtype)
+    if is_causal or sliding_window is not None:
+        m = causal_mask(S, S, sliding_window if sliding_window else None)
+        scores = jnp.where(m[None, None, None, :, :], scores, neg)
+    if padding_mask is not None:
+        pm = padding_mask.astype(bool)
+        scores = jnp.where(pm[:, None, None, None, :], scores, neg)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", probs.astype(v.dtype), v)
+    return out.reshape(B, Hq, S, D)
+
+
+def attention(q, k, v, *, impl: str = "xla", **kwargs):
+    """Dispatch between the XLA reference and the Pallas flash kernel."""
+    if impl == "flash":
+        try:
+            from mobilefinetuner_tpu.ops import flash_attention
+        except ImportError as e:
+            raise NotImplementedError(
+                "attention_impl='flash' requires the Pallas kernel "
+                "(ops/flash_attention.py); use attention_impl='xla'") from e
+        return flash_attention.flash_attention(q, k, v, **kwargs)
+    return dot_product_attention(q, k, v, **kwargs)

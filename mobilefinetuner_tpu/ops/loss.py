@@ -1,0 +1,128 @@
+"""Language-model cross-entropy loss.
+
+Semantics mirror the reference's `lm_cross_entropy`
+(reference: operators/finetune_ops/core/lm_loss.cpp:19-103):
+  - the HF label shift is performed INTERNALLY (logits[:, :-1] vs
+    labels[:, 1:], lm_loss.cpp:27-32) — callers pass UNSHIFTED labels and
+    must not shift again (SURVEY.md §2.12.4);
+  - ignore_index = -100 positions contribute nothing and are excluded from
+    the valid-token count;
+  - "mean" reduction divides by the number of valid (non-ignored) tokens;
+  - numerically stable logsumexp in fp32 regardless of logits dtype.
+
+The backward is JAX autodiff of this forward — analytically identical to the
+reference's fused `(softmax - onehot)/valid_count` (lm_loss.cpp:105+).
+
+`chunked_lm_cross_entropy` fuses the lm_head projection with the loss over
+sequence chunks so the full [B,S,V] logits tensor is never materialized —
+needed for Gemma-3's 262k vocab (SURVEY.md §7 hard part (d)).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100
+
+
+def _shift(logits: jnp.ndarray, labels: jnp.ndarray):
+    return logits[:, :-1, :], labels[:, 1:]
+
+
+def _token_nll(logits: jnp.ndarray, labels: jnp.ndarray,
+               ignore_index: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-token NLL (fp32) and validity mask. No shift here."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, safe_labels[..., None], axis=-1).squeeze(-1)
+    nll = jnp.where(valid, lse - gold, 0.0)
+    return nll, valid
+
+
+def lm_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
+                     ignore_index: int = IGNORE_INDEX,
+                     reduction: str = "mean") -> jnp.ndarray:
+    """Causal-LM loss over UNSHIFTED labels; shift happens inside.
+
+    logits: [B, S, V] (any float dtype), labels: [B, S] int.
+    Returns scalar for "mean"/"sum", [B, S-1] for "none".
+    """
+    logits_s, labels_s = _shift(logits, labels)
+    nll, valid = _token_nll(logits_s, labels_s, ignore_index)
+    if reduction == "none":
+        return nll
+    total = nll.sum()
+    if reduction == "sum":
+        return total
+    count = jnp.maximum(valid.sum(), 1)
+    return total / count
+
+
+def lm_cross_entropy_with_count(
+        logits: jnp.ndarray, labels: jnp.ndarray,
+        ignore_index: int = IGNORE_INDEX) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(mean_loss, valid_token_count) — eval_ppl needs token-weighted
+    accumulation (reference: gpt2_lora_finetune/eval_ppl.cpp:157-200)."""
+    logits_s, labels_s = _shift(logits, labels)
+    nll, valid = _token_nll(logits_s, labels_s, ignore_index)
+    count = valid.sum()
+    return nll.sum() / jnp.maximum(count, 1), count
+
+
+@partial(jax.jit, static_argnames=("ignore_index", "num_chunks"))
+def _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks):
+    B, S, H = hidden.shape
+    # Shift first: positions 0..S-2 predict labels 1..S-1.
+    hidden_s = hidden[:, :-1, :]
+    labels_s = labels[:, 1:]
+    # Pad S-1 up to a multiple of num_chunks with ignored positions.
+    Sm1 = S - 1
+    pad = (-Sm1) % num_chunks
+    if pad:
+        hidden_s = jnp.pad(hidden_s, ((0, 0), (0, pad), (0, 0)))
+        labels_s = jnp.pad(labels_s, ((0, 0), (0, pad)),
+                           constant_values=ignore_index)
+    chunk = (Sm1 + pad) // num_chunks
+    hs = hidden_s.reshape(B, num_chunks, chunk, H).swapaxes(0, 1)
+    ls = labels_s.reshape(B, num_chunks, chunk).swapaxes(0, 1)
+
+    def body(carry, xs):
+        total, count = carry
+        h, lab = xs
+        logits = (h.astype(jnp.float32)
+                  @ lm_head_w.astype(jnp.float32).T)
+        nll, valid = _token_nll(logits, lab, ignore_index)
+        return (total + nll.sum(), count + valid.sum()), None
+
+    (total, count), _ = jax.lax.scan(
+        jax.checkpoint(body), (jnp.float32(0.0), jnp.int32(0)), (hs, ls))
+    return total, count
+
+
+def chunked_lm_cross_entropy(hidden: jnp.ndarray, lm_head_w: jnp.ndarray,
+                             labels: jnp.ndarray,
+                             ignore_index: int = IGNORE_INDEX,
+                             num_chunks: int = 8) -> jnp.ndarray:
+    """Mean causal-LM loss computed without materializing [B,S,V] logits.
+
+    hidden: [B, S, H] final hidden states; lm_head_w: [V, H] (HF layout);
+    labels: [B, S] unshifted. The projection + logsumexp runs per sequence
+    chunk under lax.scan with rematerialization, so peak memory holds one
+    [B, S/num_chunks, V] block. Differentiable end-to-end.
+    """
+    total, count = _chunked_nll_sum(hidden, lm_head_w, labels,
+                                    ignore_index, num_chunks)
+    return total / jnp.maximum(count, 1).astype(jnp.float32)
+
+
+def perplexity_from_loss(loss) -> float:
+    """ppl = exp(mean NLL) (reference: core/lm_loss.h:39-41)."""
+    import math
+    return math.exp(float(loss))

@@ -1,0 +1,40 @@
+"""Rotary position embeddings (RoPE), HF-Gemma/LLaMA rotate-half convention.
+
+Reference: operators/finetune_ops/core/ops.cpp:2151 `apply_rope` and the
+Gemma dual-theta selection (graph/gemma_model.cpp:579-625): global layers use
+theta=1e6, sliding-window layers theta=1e4 (SURVEY.md §2.5).
+
+Computed in fp32 for accuracy, cast back to the input dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(positions: jnp.ndarray, head_dim: int,
+                 theta: float) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables [S, head_dim] for integer positions [S].
+
+    HF convention: inv_freq over even dims, each frequency repeated across
+    the two halves (rotate_half pairing dim i with dim i + head_dim/2).
+    """
+    inv_freq = 1.0 / (theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray,
+               sin: jnp.ndarray) -> jnp.ndarray:
+    """x: [B, H, S, D]; cos/sin: [S, D] → same shape, same dtype as x."""
+    orig = x.dtype
+    xf = x.astype(jnp.float32)
+    out = xf * cos[None, None, :, :] + _rotate_half(xf) * sin[None, None, :, :]
+    return out.astype(orig)

@@ -1,0 +1,100 @@
+"""Device mesh + sharding-spec utilities.
+
+The TPU-native replacement for the reference's single-device ZeRO-style
+ParameterSharder (reference: operators/opt_ops/sharding/parameter_sharder.h):
+instead of tiering parameters between RAM and disk under a byte budget, we
+shard parameters/gradients/optimizer state FSDP-style across chips over ICI
+(axis "fsdp") and batch-shard over axis "data". XLA inserts the
+all-gather/reduce-scatter collectives; we only annotate shardings.
+
+Mesh axes:
+  data — pure data parallelism (batch axis of activations)
+  fsdp — ZeRO-3-style parameter/grad/optimizer-state sharding; activations'
+         batch axis is also sharded over it (fsdp acts as a second DP axis),
+         so the effective data-parallel world is data*fsdp.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(data: int = 1, fsdp: Optional[int] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a 2D ("data", "fsdp") mesh over the available devices.
+
+    fsdp=None → use all remaining devices on the fsdp axis.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if fsdp is None:
+        if n % data != 0:
+            raise ValueError(f"{n} devices not divisible by data={data}")
+        fsdp = n // data
+    if data * fsdp != n:
+        raise ValueError(f"data*fsdp={data * fsdp} != n_devices={n}")
+    arr = np.asarray(devices).reshape(data, fsdp)
+    return Mesh(arr, axis_names=("data", "fsdp"))
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(data=1, fsdp=1, devices=jax.devices()[:1])
+
+
+def fsdp_spec_for(shape: Tuple[int, ...], mesh: Mesh,
+                  min_size: int = 2 ** 16) -> P:
+    """FSDP sharding rule for one parameter: shard the largest axis that
+    divides evenly by the fsdp mesh size; replicate small params.
+
+    This is the weight-sharding analog of the reference sharder's per-param
+    registration (parameter_sharder.cpp:215-232) — but across chips, not to
+    disk. Small params (norms, biases) stay replicated: gathering them is
+    cheaper than the latency of tiny collectives.
+    """
+    n_fsdp = mesh.shape.get("fsdp", 1)
+    if n_fsdp <= 1 or int(np.prod(shape)) < min_size:
+        return P()
+    # Largest divisible axis, ties broken toward the first axis.
+    best = None
+    for i, d in enumerate(shape):
+        if d % n_fsdp == 0 and (best is None or d > shape[best]):
+            best = i
+    if best is None:
+        return P()
+    spec = [None] * len(shape)
+    spec[best] = "fsdp"
+    return P(*spec)
+
+
+def params_shardings(params, mesh: Mesh, min_size: int = 2 ** 16):
+    """Pytree of NamedShardings implementing FSDP over `mesh`."""
+    return jax.tree.map(
+        lambda x: NamedSharding(mesh, fsdp_spec_for(x.shape, mesh, min_size)),
+        params)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch axis sharded over both mesh axes (data-parallel over the full
+    device set; fsdp doubles as a DP axis for activations)."""
+    return NamedSharding(mesh, P(("data", "fsdp")))
+
+
+def shard_params(params, mesh: Mesh, min_size: int = 2 ** 16):
+    """Place a parameter pytree onto the mesh with FSDP shardings."""
+    shardings = params_shardings(params, mesh, min_size)
+    return jax.device_put(params, shardings)
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a batch pytree (leading batch axis) onto the mesh."""
+    s = batch_sharding(mesh)
+    return jax.device_put(batch, jax.tree.map(lambda _: s, batch))

@@ -1,0 +1,106 @@
+"""GPT-2 golden-logit parity vs HF transformers (torch CPU).
+
+The reference's backbone correctness strategy is golden-file alignment vs a
+fixed HF forward (SURVEY.md §4.2, graph/save_pt_gold.py +
+test_gpt2_forward.cpp). With zero egress we go one better: build a tiny
+RANDOM-weight HF GPT2LMHeadModel in-process, export its state dict through
+our safetensors round-trip + key mapping, and require logit agreement.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from mobilefinetuner_tpu.core.config import GPT2Config
+from mobilefinetuner_tpu.io.checkpoints import gpt2_params_from_hf
+from mobilefinetuner_tpu.models import gpt2
+
+
+@pytest.fixture(scope="module")
+def hf_tiny():
+    from transformers import GPT2Config as HFConfig, GPT2LMHeadModel
+    torch.manual_seed(0)
+    hf_cfg = HFConfig(vocab_size=97, n_positions=32, n_embd=16, n_layer=3,
+                      n_head=2, resid_pdrop=0.0, embd_pdrop=0.0,
+                      attn_pdrop=0.0)
+    model = GPT2LMHeadModel(hf_cfg).eval()
+    return hf_cfg, model
+
+
+def _our_params(model, cfg: GPT2Config):
+    sd = {k: v.detach().numpy() for k, v in
+          model.transformer.state_dict().items()
+          if not k.endswith(".attn.bias") and ".attn.masked_bias" not in k}
+    return gpt2_params_from_hf(sd, cfg)
+
+
+def test_logits_match_hf(hf_tiny):
+    hf_cfg, model = hf_tiny
+    cfg = GPT2Config(vocab_size=hf_cfg.vocab_size,
+                     n_positions=hf_cfg.n_positions, n_embd=hf_cfg.n_embd,
+                     n_layer=hf_cfg.n_layer, n_head=hf_cfg.n_head)
+    params = _our_params(model, cfg)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 20))
+    with torch.no_grad():
+        ref = model(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(gpt2.forward(cfg, params, jnp.array(ids)))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=1e-4)
+
+
+def test_padding_mask_matches_hf(hf_tiny):
+    hf_cfg, model = hf_tiny
+    cfg = GPT2Config(vocab_size=hf_cfg.vocab_size,
+                     n_positions=hf_cfg.n_positions, n_embd=hf_cfg.n_embd,
+                     n_layer=hf_cfg.n_layer, n_head=hf_cfg.n_head)
+    params = _our_params(model, cfg)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 12))
+    mask = np.ones((2, 12), dtype=np.int64)
+    mask[1, 8:] = 0
+    with torch.no_grad():
+        ref = model(torch.tensor(ids),
+                    attention_mask=torch.tensor(mask)).logits.numpy()
+    ours = np.asarray(gpt2.forward(cfg, params, jnp.array(ids),
+                                   attention_mask=jnp.array(mask)))
+    # compare only non-padded positions (HF's padded positions differ by
+    # position-embedding handling conventions)
+    np.testing.assert_allclose(ours[0], ref[0], atol=2e-4, rtol=1e-4)
+    np.testing.assert_allclose(ours[1, :8], ref[1, :8], atol=2e-4, rtol=1e-4)
+
+
+def test_safetensors_roundtrip(tmp_path, hf_tiny):
+    from mobilefinetuner_tpu.io.safetensors_io import (SafeTensorsReader,
+                                                       save_safetensors)
+    hf_cfg, model = hf_tiny
+    sd = {k: v.detach().numpy()
+          for k, v in model.transformer.state_dict().items()
+          if not k.endswith(".attn.bias")}
+    path = str(tmp_path / "m.safetensors")
+    save_safetensors(path, sd, metadata={"format": "pt"})
+    back = SafeTensorsReader(path).load_all()
+    assert set(back) == set(sd)
+    for k in sd:
+        np.testing.assert_array_equal(back[k], sd[k])
+    # cross-check against the official safetensors library
+    from safetensors.numpy import load_file
+    official = load_file(path)
+    for k in sd:
+        np.testing.assert_array_equal(official[k], sd[k])
+
+
+def test_bf16_roundtrip(tmp_path):
+    from mobilefinetuner_tpu.io.safetensors_io import (SafeTensorsReader,
+                                                       save_safetensors)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(5, 7)).astype(np.float32)
+    path = str(tmp_path / "b.safetensors")
+    save_safetensors(path, {"x": x}, bf16_keys={"x"})
+    back = SafeTensorsReader(path).load("x")
+    # bf16 quantization error <= 2^-8 relative
+    np.testing.assert_allclose(back, x, rtol=1 / 256)
+    ref = torch.tensor(x).to(torch.bfloat16).float().numpy()
+    np.testing.assert_array_equal(back, ref)

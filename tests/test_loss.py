@@ -1,0 +1,80 @@
+"""lm_cross_entropy semantics: internal shift, ignore_index=-100,
+token-weighted mean — vs torch.nn.functional.cross_entropy oracle.
+(Reference test analog: core/test_lm_loss.cpp, test_ce_grad.cpp.)"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+from mobilefinetuner_tpu.ops.loss import (chunked_lm_cross_entropy,
+                                          lm_cross_entropy,
+                                          lm_cross_entropy_with_count)
+
+
+def _torch_ref(logits, labels, ignore_index=-100):
+    lt = torch.tensor(logits)[:, :-1, :].reshape(-1, logits.shape[-1])
+    lb = torch.tensor(labels)[:, 1:].reshape(-1)
+    return F.cross_entropy(lt, lb, ignore_index=ignore_index).item()
+
+
+def test_matches_torch_with_shift_and_ignore():
+    rng = np.random.default_rng(0)
+    B, S, V = 3, 17, 29
+    logits = rng.normal(size=(B, S, V)).astype(np.float32)
+    labels = rng.integers(0, V, size=(B, S)).astype(np.int64)
+    labels[0, :5] = -100
+    labels[2, -3:] = -100
+    ours = float(lm_cross_entropy(jnp.array(logits), jnp.array(labels)))
+    ref = _torch_ref(logits, labels)
+    assert abs(ours - ref) < 1e-5, (ours, ref)
+
+
+def test_all_ignored_is_finite():
+    logits = jnp.ones((1, 4, 7))
+    labels = jnp.full((1, 4), -100)
+    assert float(lm_cross_entropy(logits, labels)) == 0.0
+
+
+def test_count_matches_valid_tokens():
+    rng = np.random.default_rng(1)
+    B, S, V = 2, 9, 11
+    logits = jnp.array(rng.normal(size=(B, S, V)), dtype=jnp.float32)
+    labels = np.full((B, S), -100, dtype=np.int64)
+    labels[0, 1:4] = 5
+    loss, count = lm_cross_entropy_with_count(logits, jnp.array(labels))
+    # labels[0, 1:4] -> shifted positions 0..2 are valid
+    assert int(count) == 3
+    assert np.isfinite(float(loss))
+
+
+def test_chunked_matches_full():
+    rng = np.random.default_rng(2)
+    B, S, H, V = 2, 13, 8, 37
+    hidden = rng.normal(size=(B, S, H)).astype(np.float32)
+    w = rng.normal(size=(V, H)).astype(np.float32)
+    labels = rng.integers(0, V, size=(B, S)).astype(np.int64)
+    labels[1, :4] = -100
+    logits = hidden @ w.T
+    full = float(lm_cross_entropy(jnp.array(logits), jnp.array(labels)))
+    for nc in (1, 3, 4):
+        ch = float(chunked_lm_cross_entropy(jnp.array(hidden), jnp.array(w),
+                                            jnp.array(labels), num_chunks=nc))
+        assert abs(ch - full) < 1e-5, (nc, ch, full)
+
+
+def test_chunked_grad_matches_full():
+    rng = np.random.default_rng(3)
+    B, S, H, V = 2, 8, 4, 19
+    hidden = jnp.array(rng.normal(size=(B, S, H)), dtype=jnp.float32)
+    w = jnp.array(rng.normal(size=(V, H)), dtype=jnp.float32)
+    labels = jnp.array(rng.integers(0, V, size=(B, S)))
+
+    g_full = jax.grad(
+        lambda h, w: lm_cross_entropy(h @ w.T, labels))(hidden, w)
+    g_ch = jax.grad(
+        lambda h, w: chunked_lm_cross_entropy(h, w, labels, num_chunks=2)
+    )(hidden, w)
+    np.testing.assert_allclose(np.asarray(g_full), np.asarray(g_ch),
+                               atol=1e-5)

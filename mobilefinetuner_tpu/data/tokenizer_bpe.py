@@ -1,0 +1,152 @@
+"""GPT-2 byte-level BPE tokenizer, HF-aligned.
+
+Behavioral spec mirrors the reference's GPT2BPETokenizer
+(reference: core/tokenizer_bpe.{h,cpp} — exact bytes_to_unicode table
+(tokenizer_bpe.cpp:110-167), the GPT-2 pre-tokenization regex
+(tokenizer_bpe.cpp:257-275), vocab.json/merges.txt loading, and
+eos=bos=pad=unk=50256 (tokenizer_bpe.h:29-33)), itself aligned with the
+public GPT-2 tokenizer algorithm. Implemented from the public algorithm, not
+ported. Uses the `regex` module for \\p{L}/\\p{N} unicode categories.
+
+A native C++ fast path (native/fast_bpe) is used automatically when built;
+this Python implementation is the reference and fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import regex as re
+
+# GPT-2 pre-tokenization pattern (public, from the GPT-2 release).
+_PAT = re.compile(
+    r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+|"""
+    r""" ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+""")
+
+
+@functools.lru_cache(maxsize=1)
+def bytes_to_unicode() -> Dict[int, str]:
+    """The GPT-2 reversible byte<->unicode-char table: printable bytes map
+    to themselves, the rest to U+0100+n."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("\xa1"), ord("\xac") + 1))
+          + list(range(ord("\xae"), ord("\xff") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+def _get_pairs(word: Tuple[str, ...]) -> set:
+    return {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+
+
+class GPT2BPETokenizer:
+    """Byte-level BPE with merge ranks; encode/decode exactly match HF's
+    GPT2TokenizerFast on the same vocab/merges files."""
+
+    def __init__(self, vocab: Dict[str, int], merges: List[Tuple[str, str]],
+                 eos_token: str = "<|endoftext|>"):
+        self.encoder = dict(vocab)
+        self.decoder = {v: k for k, v in vocab.items()}
+        self.bpe_ranks = {pair: i for i, pair in enumerate(merges)}
+        self.byte_encoder = bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+        self.eos_token = eos_token
+        self.eos_id = self.encoder.get(eos_token, len(vocab) - 1)
+        # GPT-2 convention: all special roles share <|endoftext|>
+        # (tokenizer_bpe.h:29-33)
+        self.bos_id = self.pad_id = self.unk_id = self.eos_id
+        self._cache: Dict[str, List[str]] = {}
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_pretrained(cls, model_dir: str) -> "GPT2BPETokenizer":
+        with open(os.path.join(model_dir, "vocab.json"),
+                  encoding="utf-8") as f:
+            vocab = json.load(f)
+        merges: List[Tuple[str, str]] = []
+        with open(os.path.join(model_dir, "merges.txt"),
+                  encoding="utf-8") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line or line.startswith("#version"):
+                    continue
+                a, b = line.split(" ")
+                merges.append((a, b))
+        eos = "<|endoftext|>"
+        stm = os.path.join(model_dir, "special_tokens_map.json")
+        if os.path.exists(stm):
+            with open(stm, encoding="utf-8") as f:
+                sm = json.load(f)
+            e = sm.get("eos_token", eos)
+            eos = e["content"] if isinstance(e, dict) else e
+        return cls(vocab, merges, eos)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.encoder)
+
+    # -- BPE core ------------------------------------------------------------
+
+    def _bpe(self, token: str) -> List[str]:
+        cached = self._cache.get(token)
+        if cached is not None:
+            return cached
+        word = tuple(token)
+        if len(word) == 1:
+            self._cache[token] = [token]
+            return [token]
+        pairs = _get_pairs(word)
+        while True:
+            best = min(pairs,
+                       key=lambda p: self.bpe_ranks.get(p, float("inf")))
+            if best not in self.bpe_ranks:
+                break
+            a, b = best
+            new_word: List[str] = []
+            i = 0
+            while i < len(word):
+                try:
+                    j = word.index(a, i)
+                except ValueError:
+                    new_word.extend(word[i:])
+                    break
+                new_word.extend(word[i:j])
+                if j < len(word) - 1 and word[j + 1] == b:
+                    new_word.append(a + b)
+                    i = j + 2
+                else:
+                    new_word.append(word[j])
+                    i = j + 1
+            word = tuple(new_word)
+            if len(word) == 1:
+                break
+            pairs = _get_pairs(word)
+        out = list(word)
+        self._cache[token] = out
+        return out
+
+    # -- public API ----------------------------------------------------------
+
+    def encode(self, text: str) -> List[int]:
+        ids: List[int] = []
+        for piece in _PAT.findall(text):
+            mapped = "".join(self.byte_encoder[b]
+                             for b in piece.encode("utf-8"))
+            for sub in self._bpe(mapped):
+                ids.append(self.encoder.get(sub, self.unk_id))
+        return ids
+
+    def decode(self, ids: List[int]) -> str:
+        text = "".join(self.decoder.get(int(i), "") for i in ids)
+        raw = bytearray(self.byte_decoder[c] for c in text)
+        return raw.decode("utf-8", errors="replace")

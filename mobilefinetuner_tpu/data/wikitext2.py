@@ -1,0 +1,267 @@
+"""WikiText-2 data pipeline: concat-lines + EOS + fixed-length chunking,
+with in-RAM, streaming-window, and pretokenized-binary modes.
+
+Behavioral spec mirrors the reference's WikiText2Dataset
+(reference: data/wikitext2_dataset.{h,cpp}):
+  - lines are tokenized and concatenated with an EOS inserted after each
+    line (HF-aligned; wikitext2_dataset.cpp chunking);
+  - fixed seq_len chunks at `stride` intervals (stride == seq_len ->
+    no overlap; smaller stride -> overlapping chunks whose overlapping
+    prefix is label-masked to -100, wikitext2_dataset.h:27-39);
+  - three modes (wikitext2_dataset.h:36-39, :92-111): (a) in-RAM,
+    (b) streaming — prescan the file for per-line token offsets, keep only
+    a bounded token window in RAM, re-tokenize on demand,
+    (c) pretokenized .bin + meta.json (np.memmap; producer:
+    `pretokenize()` below, analog of scripts/pretokenize_wikitext2_gemma.py);
+  - per-epoch seeded shuffle of chunk order (wikitext2_dataset.cpp:266-268,
+    seeded mt19937 — here np.random.Generator, equally reproducible);
+  - batches {input_ids i32 [B,S], attention_mask f32 [B,S], labels i32
+    [B,S] with pad = -100} (wikitext2_dataset.h:44-48);
+  - data_fraction / drop_last (wikitext2_dataset.h:27-39).
+
+Tokenizer-agnostic: pass any `encode_fn(str)->List[int]` + eos/pad ids
+(the reference ctor's encode_fn hook, wikitext2_dataset.h:53-54).
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import json
+import os
+from typing import Callable, Iterator, List, Optional
+
+import numpy as np
+
+IGNORE_INDEX = -100
+
+_SPLIT_FILENAMES = {
+    "train": ("wiki.train.tokens", "wiki.train.raw", "train.txt"),
+    "valid": ("wiki.valid.tokens", "wiki.valid.raw", "valid.txt",
+              "validation.txt"),
+    "test": ("wiki.test.tokens", "wiki.test.raw", "test.txt"),
+}
+
+
+def resolve_split_file(path: str, split: str) -> str:
+    """`path` may be a file (used directly) or a wikitext dir."""
+    if os.path.isfile(path):
+        return path
+    for name in _SPLIT_FILENAMES[split]:
+        p = os.path.join(path, name)
+        if os.path.exists(p):
+            return p
+    raise FileNotFoundError(f"no {split} split under {path}")
+
+
+@dataclasses.dataclass
+class WT2Config:
+    seq_len: int = 128
+    batch_size: int = 4
+    stride: Optional[int] = None  # None -> seq_len (no overlap)
+    data_fraction: float = 1.0
+    drop_last: bool = True
+    shuffle: bool = True
+    seed: int = 42
+    streaming: bool = False
+    window_tokens: int = 100_000  # streaming-mode resident window
+
+
+class WikiText2Dataset:
+    def __init__(self, path: str, split: str, config: WT2Config,
+                 encode_fn: Callable[[str], List[int]], eos_id: int,
+                 pad_id: Optional[int] = None,
+                 pretokenized_bin: Optional[str] = None):
+        self.config = config
+        self.eos_id = eos_id
+        self.pad_id = eos_id if pad_id is None else pad_id
+        self.encode_fn = encode_fn
+        self._tokens: Optional[np.ndarray] = None
+        self._epoch = 0
+
+        if pretokenized_bin is not None:
+            meta_path = pretokenized_bin + ".meta.json"
+            if not os.path.exists(meta_path):
+                meta_path = os.path.join(
+                    os.path.dirname(pretokenized_bin), "meta.json")
+            with open(meta_path) as f:
+                meta = json.load(f)
+            dtype = np.dtype(meta.get("dtype", "int32"))
+            self._tokens = np.memmap(pretokenized_bin, dtype=dtype,
+                                     mode="r")
+            total = int(meta.get("count", len(self._tokens)))
+            self._total_tokens = min(total, len(self._tokens))
+            self._lines = None
+        else:
+            file = resolve_split_file(path, split)
+            self._file = file
+            if config.streaming:
+                self._prescan(file)
+            else:
+                ids: List[int] = []
+                with open(file, encoding="utf-8") as f:
+                    for line in f:
+                        line = line.rstrip("\n")
+                        if not line.strip():
+                            continue
+                        ids.extend(encode_fn(line))
+                        ids.append(eos_id)
+                self._tokens = np.asarray(ids, dtype=np.int32)
+                self._total_tokens = len(ids)
+                self._lines = None
+
+        if config.data_fraction < 1.0:
+            self._total_tokens = max(
+                int(self._total_tokens * config.data_fraction),
+                config.seq_len + 1)
+
+        stride = config.stride or config.seq_len
+        assert 0 < stride <= config.seq_len
+        self._stride = stride
+        n_full = max((self._total_tokens - config.seq_len) // stride + 1, 0)
+        has_tail = (n_full == 0 or
+                    (self._total_tokens - config.seq_len) % stride != 0)
+        if config.drop_last or self._total_tokens < config.seq_len:
+            self.num_chunks = n_full
+        else:
+            self.num_chunks = n_full + (1 if has_tail else 0)
+        if self.num_chunks == 0 and self._total_tokens > 1:
+            self.num_chunks = 1  # single short chunk, padded
+
+    # -- streaming machinery -------------------------------------------------
+
+    def _prescan(self, file: str):
+        """Token-offset prescan: cumulative token count per line, without
+        keeping tokens (wikitext2_dataset.cpp:230-249 semantics)."""
+        offsets = [0]
+        lines_pos: List[int] = []
+        with open(file, encoding="utf-8") as f:
+            pos = f.tell()
+            for line in iter(f.readline, ""):
+                stripped = line.rstrip("\n")
+                if stripped.strip():
+                    lines_pos.append(pos)
+                    n = len(self.encode_fn(stripped)) + 1  # +1 for EOS
+                    offsets.append(offsets[-1] + n)
+                pos = f.tell()
+        self._line_offsets = offsets  # len = n_lines + 1
+        self._line_pos = lines_pos
+        self._total_tokens = offsets[-1]
+        self._win_start = 0
+        self._win_tokens = np.empty(0, dtype=np.int32)
+
+    def _window_fetch(self, start: int, end: int) -> np.ndarray:
+        """Return tokens[start:end] by re-tokenizing the covering lines,
+        keeping a bounded resident window."""
+        ws, we = self._win_start, self._win_start + len(self._win_tokens)
+        if start >= ws and end <= we:
+            return self._win_tokens[start - ws:end - ws]
+        # recompute a window beginning at the line containing `start`
+        li = bisect.bisect_right(self._line_offsets, start) - 1
+        win_start_tok = self._line_offsets[li]
+        want = max(end - win_start_tok, self.config.window_tokens)
+        toks: List[int] = []
+        with open(self._file, encoding="utf-8") as f:
+            j = li
+            while j < len(self._line_pos) and len(toks) < want:
+                f.seek(self._line_pos[j])
+                line = f.readline().rstrip("\n")
+                toks.extend(self.encode_fn(line))
+                toks.append(self.eos_id)
+                j += 1
+        self._win_start = win_start_tok
+        self._win_tokens = np.asarray(toks, dtype=np.int32)
+        ws = self._win_start
+        return self._win_tokens[start - ws:end - ws]
+
+    # -- chunk/batch API -----------------------------------------------------
+
+    def _chunk_tokens(self, idx: int) -> np.ndarray:
+        start = idx * self._stride
+        end = min(start + self.config.seq_len, self._total_tokens)
+        if self._tokens is not None:
+            return np.asarray(self._tokens[start:end], dtype=np.int32)
+        return self._window_fetch(start, end)
+
+    def chunk(self, idx: int):
+        """(input_ids, attention_mask, labels) for one chunk, padded to
+        seq_len."""
+        S = self.config.seq_len
+        toks = self._chunk_tokens(idx)
+        n = len(toks)
+        input_ids = np.full(S, self.pad_id, dtype=np.int32)
+        input_ids[:n] = toks
+        mask = np.zeros(S, dtype=np.float32)
+        mask[:n] = 1.0
+        labels = np.full(S, IGNORE_INDEX, dtype=np.int32)
+        labels[:n] = toks
+        if idx > 0 and self._stride < S:
+            # overlapping prefix is context only — matches sliding-window
+            # PPL convention
+            labels[:S - self._stride] = IGNORE_INDEX
+        return input_ids, mask, labels
+
+    def num_batches(self) -> int:
+        b = self.config.batch_size
+        if self.config.drop_last:
+            return self.num_chunks // b
+        return (self.num_chunks + b - 1) // b
+
+    def epoch(self, epoch: Optional[int] = None) -> Iterator[dict]:
+        """Yield batches for one epoch; chunk order reshuffled per epoch
+        from (seed, epoch)."""
+        if epoch is None:
+            epoch = self._epoch
+            self._epoch += 1
+        order = np.arange(self.num_chunks)
+        if self.config.shuffle:
+            rng = np.random.default_rng(self.config.seed + epoch)
+            if self.config.streaming and self._tokens is None:
+                # window-local shuffle: permute blocks of window-resident
+                # chunks, and chunks within each block, so nearly every
+                # access hits the resident window instead of re-tokenizing
+                # ~window_tokens per chunk
+                per_block = max(self.config.window_tokens
+                                // max(self._stride, 1), 1)
+                blocks = [order[i:i + per_block]
+                          for i in range(0, len(order), per_block)]
+                for b in blocks:
+                    rng.shuffle(b)
+                bidx = np.arange(len(blocks))
+                rng.shuffle(bidx)
+                order = np.concatenate([blocks[i] for i in bidx]) \
+                    if blocks else order
+            else:
+                rng.shuffle(order)
+        b = self.config.batch_size
+        nb = self.num_batches()
+        for bi in range(nb):
+            idxs = order[bi * b:(bi + 1) * b]
+            rows = [self.chunk(int(i)) for i in idxs]
+            yield {
+                "input_ids": np.stack([r[0] for r in rows]),
+                "attention_mask": np.stack([r[1] for r in rows]),
+                "labels": np.stack([r[2] for r in rows]),
+            }
+
+    def total_valid_tokens(self) -> int:
+        return self._total_tokens
+
+
+def pretokenize(input_file: str, encode_fn: Callable[[str], List[int]],
+                eos_id: int, out_bin: str):
+    """Offline pretokenization -> .bin + .bin.meta.json
+    (scripts/pretokenize_wikitext2_gemma.py analog)."""
+    count = 0
+    with open(out_bin, "wb") as out:
+        with open(input_file, encoding="utf-8") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line.strip():
+                    continue
+                ids = encode_fn(line) + [eos_id]
+                np.asarray(ids, dtype=np.int32).tofile(out)
+                count += len(ids)
+    with open(out_bin + ".meta.json", "w") as f:
+        json.dump({"dtype": "int32", "count": count, "eos_id": eos_id}, f)
+    return count

@@ -1,0 +1,201 @@
+"""LoRA: spec, initialization, merge/unmerge, trainable-subset partitioning.
+
+TPU-native re-design of the reference's LoRA machinery
+(reference: graph/lora_injector.{h,cpp} for GPT-2,
+graph/gemma_lora_injector.{h,cpp} for Gemma, nn/lora_linear.{h,cpp}).
+The reference wraps each linear in a LoRALinear module holding pointers to
+the frozen base weight; here LoRA is a *separate pytree* of stacked per-layer
+A/B factors that the model forward adds functionally
+(y = x@W + scale·(x@A@B)), so:
+  - base params stay frozen by construction (grads are taken w.r.t. the LoRA
+    tree only via jax.grad argnums),
+  - FSDP can shard base params independently of the tiny trainable tree
+    (SURVEY.md §7 hard part (c)),
+  - merge/unmerge is a pure pytree->pytree function.
+
+Entry layout per target: {"A": [L, in, r], "B": [L, r, out], "scale": ()}
+with scale = alpha/rank (lora_injector.h:29-71). "scale" leaves are
+non-trainable: forward stop-gradients them and trainable_mask() excludes
+them from optimizer updates.
+
+Init parity (SURVEY.md §2.5):
+  - gpt2 style: A ~ N(0, 1/sqrt(r)), B = 0 (lora_injector.cpp:18-42) — but
+    seeded jax.random instead of the reference's std::random_device
+    (SURVEY.md §2.12.6: the reference is non-reproducible; we are).
+  - peft style (Gemma, gemma_lora_injector.cpp:31): kaiming_uniform(a=√5)
+    on A = U(-1/sqrt(in), 1/sqrt(in)) scaled by gain for fan_in; B = 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# GPT-2 target name -> (in_dim_fn, out_dim_fn) over config
+GPT2_TARGETS = {
+    "attn_qkv": lambda c: (c.n_embd, 3 * c.n_embd),
+    "attn_proj": lambda c: (c.n_embd, c.n_embd),
+    "mlp_fc_in": lambda c: (c.n_embd, 4 * c.n_embd),
+    "mlp_fc_out": lambda c: (4 * c.n_embd, c.n_embd),
+}
+# Default PEFT-aligned GPT-2 topology: fused c_attn + c_proj
+# (reference: gpt2_lora_finetune/main.cpp:381-390).
+GPT2_DEFAULT_TARGETS = ["attn_qkv", "attn_proj"]
+
+GEMMA_TARGETS = {
+    "q_proj": lambda c: (c.hidden_size, c.num_attention_heads * c.head_dim),
+    "k_proj": lambda c: (c.hidden_size, c.num_key_value_heads * c.head_dim),
+    "v_proj": lambda c: (c.hidden_size, c.num_key_value_heads * c.head_dim),
+    "o_proj": lambda c: (c.num_attention_heads * c.head_dim, c.hidden_size),
+    "gate_proj": lambda c: (c.hidden_size, c.intermediate_size),
+    "up_proj": lambda c: (c.hidden_size, c.intermediate_size),
+    "down_proj": lambda c: (c.intermediate_size, c.hidden_size),
+}
+# Target presets (reference: gemma_lora_injector.h:9-34).
+GEMMA_PRESETS = {
+    "full": list(GEMMA_TARGETS),
+    "attn": ["q_proj", "k_proj", "v_proj", "o_proj"],
+    "light": ["q_proj", "v_proj"],
+}
+
+
+@dataclasses.dataclass
+class LoRASpec:
+    rank: int = 8
+    alpha: float = 16.0
+    dropout: float = 0.0
+    targets: Optional[List[str]] = None
+    init: str = "gpt2"  # "gpt2" | "peft"
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+    def to_metadata(self) -> Dict[str, str]:
+        return {"lora_rank": str(self.rank), "lora_alpha": str(self.alpha),
+                "lora_dropout": str(self.dropout),
+                "lora_targets": ",".join(self.targets or []),
+                "lora_init": self.init}
+
+    @classmethod
+    def from_metadata(cls, md: Dict[str, str]) -> "LoRASpec":
+        return cls(rank=int(md["lora_rank"]),
+                   alpha=float(md["lora_alpha"]),
+                   dropout=float(md.get("lora_dropout", 0.0)),
+                   targets=[t for t in md.get("lora_targets", "").split(",")
+                            if t],
+                   init=md.get("lora_init", "gpt2"))
+
+
+def _init_A(key, shape, style: str, dtype):
+    """shape = [L, in, r]."""
+    _, fan_in, r = shape
+    if style == "peft":
+        # torch kaiming_uniform_(a=sqrt(5)) on a [r, in] matrix:
+        # bound = sqrt(3) * (1/sqrt(5+1) gain...) — torch computes
+        # gain = sqrt(2/(1+a^2)) = sqrt(1/3), std = gain/sqrt(fan_in),
+        # bound = sqrt(3)*std = 1/sqrt(fan_in).
+        bound = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+    # reference GPT-2 init: N(0, 1/sqrt(r)) (lora_injector.cpp:18-42)
+    return (jax.random.normal(key, shape) / math.sqrt(r)).astype(dtype)
+
+
+def init_lora(target_dims: Dict[str, Tuple[int, int]], n_layers: int,
+              spec: LoRASpec, key: jax.Array, dtype=jnp.float32) -> dict:
+    """Build the stacked LoRA pytree for the given targets."""
+    tree = {}
+    keys = jax.random.split(key, max(len(target_dims), 1))
+    for k, name in zip(keys, sorted(target_dims)):
+        fan_in, fan_out = target_dims[name]
+        tree[name] = {
+            "A": _init_A(k, (n_layers, fan_in, spec.rank), spec.init, dtype),
+            "B": jnp.zeros((n_layers, spec.rank, fan_out), dtype),
+            "scale": jnp.asarray(spec.scale, dtype),
+        }
+    return {"blocks": tree}
+
+
+def init_lora_gpt2(config, spec: LoRASpec, key: jax.Array,
+                   dtype=jnp.float32) -> dict:
+    targets = spec.targets or GPT2_DEFAULT_TARGETS
+    dims = {t: GPT2_TARGETS[t](config) for t in targets}
+    return init_lora(dims, config.n_layer, spec, key, dtype)
+
+
+def init_lora_gemma3(config, spec: LoRASpec, key: jax.Array,
+                     dtype=jnp.float32) -> dict:
+    targets = spec.targets or GEMMA_PRESETS["full"]
+    if isinstance(targets, str):
+        targets = GEMMA_PRESETS[targets]
+    dims = {t: GEMMA_TARGETS[t](config) for t in targets}
+    return init_lora(dims, config.num_hidden_layers, spec, key, dtype)
+
+
+def trainable_mask(lora_tree) -> dict:
+    """Pytree of bools: True for trainable leaves (A/B), False for scale.
+    Feed to the optimizer so scale is never updated/decayed."""
+    return jax.tree.map_with_path(
+        lambda path, _: not (path and getattr(path[-1], "key", None)
+                             == "scale"),
+        lora_tree)
+
+
+def num_trainable(lora_tree) -> int:
+    mask = trainable_mask(lora_tree)
+    return sum(int(x.size) for x, m in
+               zip(jax.tree.leaves(lora_tree), jax.tree.leaves(mask)) if m)
+
+
+def _delta_w(entry) -> jnp.ndarray:
+    """[L, in, out] = scale * A @ B per layer."""
+    return entry["scale"] * jnp.einsum("lir,lro->lio", entry["A"],
+                                       entry["B"])
+
+
+# name of the base-weight leaf each target modifies, per model family
+_GPT2_BASE = {"attn_qkv": ("attn", "qkv_w"), "attn_proj": ("attn", "proj_w"),
+              "mlp_fc_in": ("mlp", "fc_w"), "mlp_fc_out": ("mlp", "proj_w")}
+_GEMMA_BASE = {"q_proj": ("attn", "q_w"), "k_proj": ("attn", "k_w"),
+               "v_proj": ("attn", "v_w"), "o_proj": ("attn", "o_w"),
+               "gate_proj": ("mlp", "gate_w"), "up_proj": ("mlp", "up_w"),
+               "down_proj": ("mlp", "down_w")}
+
+
+def _merge(params, lora_tree, base_map, sign: float):
+    """params + sign * ΔW on every LoRA'd base weight (functional)."""
+    params = jax.tree.map(jnp.asarray, params)
+    blocks = dict(params["blocks"])
+    groups = {g: dict(blocks[g]) for g in {v[0] for v in base_map.values()}}
+    for name, entry in lora_tree["blocks"].items():
+        group, leaf = base_map[name]
+        w = groups[group][leaf]
+        groups[group][leaf] = (
+            w + sign * _delta_w(entry).astype(w.dtype))
+    blocks.update(groups)
+    out = dict(params)
+    out["blocks"] = blocks
+    return out
+
+
+def merge_gpt2(params, lora_tree):
+    """Fold ΔW into base weights (reference: lora_linear.cpp:109-176
+    merge; used by eval with --merge)."""
+    return _merge(params, lora_tree, _GPT2_BASE, +1.0)
+
+
+def unmerge_gpt2(params, lora_tree):
+    return _merge(params, lora_tree, _GPT2_BASE, -1.0)
+
+
+def merge_gemma3(params, lora_tree):
+    return _merge(params, lora_tree, _GEMMA_BASE, +1.0)
+
+
+def unmerge_gemma3(params, lora_tree):
+    return _merge(params, lora_tree, _GEMMA_BASE, -1.0)

@@ -1,0 +1,154 @@
+"""Adapter save/load: native format (fast resume) + PEFT-compatible export.
+
+Reference: graph/lora_saver.{h,cpp} — PEFT-compatible safetensors of adapter
+weights with rank/alpha/dropout metadata in the safetensors header, plus
+`load_safetensors -> attach_from_state` for resume. We mirror both:
+
+  - native format: keys `blocks.{target}.{A|B}` holding the stacked
+    [L, ...] arrays, spec in the header metadata — exact, single-blob resume;
+  - PEFT export/import: per-layer `base_model.model.<hf_module_path>.
+    lora_A.weight` ([r, in], torch layout) + `adapter_config.json`, loadable
+    by HF PEFT on the matching base model.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from mobilefinetuner_tpu.io.safetensors_io import (SafeTensorsReader,
+                                                   save_safetensors)
+from mobilefinetuner_tpu.lora.lora import LoRASpec
+
+# target name -> HF module path fragment (PEFT keys prepend
+# "base_model.model." and append ".lora_A.weight"/".lora_B.weight")
+GPT2_PEFT_MODULES = {
+    "attn_qkv": "transformer.h.{}.attn.c_attn",
+    "attn_proj": "transformer.h.{}.attn.c_proj",
+    "mlp_fc_in": "transformer.h.{}.mlp.c_fc",
+    "mlp_fc_out": "transformer.h.{}.mlp.c_proj",
+}
+GEMMA_PEFT_MODULES = {
+    t: "model.layers.{}.self_attn." + t for t in
+    ("q_proj", "k_proj", "v_proj", "o_proj")
+}
+GEMMA_PEFT_MODULES.update({
+    t: "model.layers.{}.mlp." + t for t in
+    ("gate_proj", "up_proj", "down_proj")
+})
+PEFT_TARGET_MODULES = {  # for adapter_config.json target_modules
+    "attn_qkv": "c_attn", "attn_proj": "c_proj", "mlp_fc_in": "c_fc",
+    "mlp_fc_out": "c_proj",
+}
+
+
+# ----------------------------- native format --------------------------------
+
+def save_adapter(path: str, lora_tree, spec: LoRASpec,
+                 extra_metadata: Optional[Dict[str, str]] = None):
+    """Native adapter safetensors: stacked arrays + spec metadata."""
+    tensors = {}
+    for name, entry in lora_tree["blocks"].items():
+        tensors[f"blocks.{name}.A"] = np.asarray(entry["A"],
+                                                 dtype=np.float32)
+        tensors[f"blocks.{name}.B"] = np.asarray(entry["B"],
+                                                 dtype=np.float32)
+    md = spec.to_metadata()
+    md["format"] = "mobilefinetuner_tpu.lora.v1"
+    if extra_metadata:
+        md.update(extra_metadata)
+    save_safetensors(path, tensors, metadata=md)
+
+
+def load_adapter(path: str) -> Tuple[dict, LoRASpec]:
+    """Load a native adapter -> (lora_tree, spec). Resume analog of the
+    reference's attach_from_state (lora_saver.h:16-46)."""
+    reader = SafeTensorsReader(path)
+    spec = LoRASpec.from_metadata(reader.metadata)
+    blocks: dict = {}
+    for key in reader.keys():
+        assert key.startswith("blocks."), key
+        _, name, leaf = key.split(".")
+        blocks.setdefault(name, {})[leaf] = jnp.asarray(reader.load(key))
+    for name in blocks:
+        blocks[name]["scale"] = jnp.asarray(spec.scale, jnp.float32)
+    spec.targets = sorted(blocks)
+    return {"blocks": blocks}, spec
+
+
+# ----------------------------- PEFT export ----------------------------------
+
+def export_peft(out_dir: str, lora_tree, spec: LoRASpec, family: str,
+                base_model_name: str = ""):
+    """Write adapter_model.safetensors + adapter_config.json loadable by HF
+    PEFT. A/B are stored in torch nn.Linear layout: lora_A.weight [r, in],
+    lora_B.weight [out, r] (our stacked layout is A [L, in, r], B [L, r, out]
+    → transpose per layer)."""
+    modules = (GPT2_PEFT_MODULES if family == "gpt2"
+               else GEMMA_PEFT_MODULES)
+    os.makedirs(out_dir, exist_ok=True)
+    tensors = {}
+    for name, entry in lora_tree["blocks"].items():
+        A = np.asarray(entry["A"], dtype=np.float32)
+        B = np.asarray(entry["B"], dtype=np.float32)
+        L = A.shape[0]
+        for i in range(L):
+            mod = "base_model.model." + modules[name].format(i)
+            tensors[mod + ".lora_A.weight"] = A[i].T.copy()
+            tensors[mod + ".lora_B.weight"] = B[i].T.copy()
+    save_safetensors(os.path.join(out_dir, "adapter_model.safetensors"),
+                     tensors, metadata={"format": "pt"})
+    if family == "gpt2":
+        target_modules = sorted({PEFT_TARGET_MODULES[t]
+                                 for t in lora_tree["blocks"]})
+        fan_in_fan_out = True  # GPT-2 Conv1D
+    else:
+        target_modules = sorted(lora_tree["blocks"])
+        fan_in_fan_out = False
+    cfg = {
+        "peft_type": "LORA", "task_type": "CAUSAL_LM",
+        "base_model_name_or_path": base_model_name,
+        "r": spec.rank, "lora_alpha": spec.alpha,
+        "lora_dropout": spec.dropout, "bias": "none",
+        "fan_in_fan_out": fan_in_fan_out,
+        "target_modules": target_modules,
+        "inference_mode": False,
+    }
+    with open(os.path.join(out_dir, "adapter_config.json"), "w") as f:
+        json.dump(cfg, f, indent=2)
+
+
+def import_peft(adapter_dir: str, family: str) -> Tuple[dict, LoRASpec]:
+    """Load an HF-PEFT adapter dir into our stacked lora_tree."""
+    with open(os.path.join(adapter_dir, "adapter_config.json")) as f:
+        cfg = json.load(f)
+    spec = LoRASpec(rank=cfg["r"], alpha=cfg["lora_alpha"],
+                    dropout=cfg.get("lora_dropout", 0.0), init="peft")
+    path = os.path.join(adapter_dir, "adapter_model.safetensors")
+    raw = SafeTensorsReader(path).load_all(promote_to_f32=True)
+    modules = (GPT2_PEFT_MODULES if family == "gpt2"
+               else GEMMA_PEFT_MODULES)
+    blocks: dict = {}
+    for name, fmt in modules.items():
+        per_layer_A, per_layer_B = [], []
+        i = 0
+        while True:
+            mod = "base_model.model." + fmt.format(i)
+            ka, kb = mod + ".lora_A.weight", mod + ".lora_B.weight"
+            if ka not in raw:
+                break
+            per_layer_A.append(raw[ka].T)
+            per_layer_B.append(raw[kb].T)
+            i += 1
+        if per_layer_A:
+            blocks[name] = {
+                "A": jnp.asarray(np.stack(per_layer_A)),
+                "B": jnp.asarray(np.stack(per_layer_B)),
+                "scale": jnp.asarray(spec.scale, jnp.float32),
+            }
+    spec.targets = sorted(blocks)
+    return {"blocks": blocks}, spec

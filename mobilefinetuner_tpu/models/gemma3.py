@@ -1,0 +1,194 @@
+"""Gemma-3 text decoder, pure-functional JAX.
+
+Re-design of the reference's GemmaModel graph
+(reference: operators/finetune_ops/graph/gemma_model.{h,cpp}), HF-Gemma3
+aligned (SURVEY.md §2.5):
+  - embeddings scaled by sqrt(hidden_size) (gemma_model.cpp:222-248);
+  - GQA (num_attention_heads q-heads over num_key_value_heads kv-heads) —
+    expressed as a broadcast einsum, not materialized repeat_kv_heads;
+  - per-head q/k RMSNorm before RoPE;
+  - dual RoPE theta: rope_theta (global layers) vs rope_local_base_freq
+    (sliding-window layers) selected per layer_types[i]
+    (gemma_model.cpp:579-625);
+  - sliding-window mask (default 512) on local layers (gemma_model.h:26);
+  - sandwich norms: input_ln -> attn -> post_attn_ln -> residual;
+    pre_ffn_ln -> MLP(gelu_tanh(gate)*up -> down) -> post_ffn_ln ->
+    residual (gemma_model.cpp:579-680);
+  - RMSNorm with Gemma (1 + weight) semantics, fp32 accumulation
+    (core/ops.cpp:1489);
+  - query scaling by query_pre_attn_scalar^-0.5 (gemma_model.h:33);
+  - lm_head tied to the embedding table (HF Gemma-3 text checkpoints).
+
+Layers are stacked [L, ...] and run under lax.scan; per-layer global/local
+behavior is selected with jnp.where over precomputed global+local RoPE
+tables and masks (static shapes, no data-dependent control flow).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from mobilefinetuner_tpu.core.config import Gemma3TextConfig
+from mobilefinetuner_tpu.ops.attention import attention, causal_mask
+from mobilefinetuner_tpu.ops.rope import apply_rope, rope_cos_sin
+
+
+def rms_norm(x, w, eps, dtype=None):
+    """Gemma RMSNorm: x/rms(x) * (1 + w), fp32 math
+    (reference: core/ops.cpp:1489, scale at ops.cpp:1515)."""
+    dtype = dtype or x.dtype
+    xf = x.astype(jnp.float32)
+    var = (xf * xf).mean(-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + eps) * (1.0 + w.astype(jnp.float32))
+    return out.astype(dtype)
+
+
+def gelu_tanh(x):
+    return jax.nn.gelu(x, approximate=True)
+
+
+def init_params(config: Gemma3TextConfig, key: jax.Array,
+                dtype=jnp.float32) -> Dict[str, Any]:
+    c = config
+    L, H, D = c.num_hidden_layers, c.hidden_size, c.head_dim
+    nq, nkv, I = c.num_attention_heads, c.num_key_value_heads, \
+        c.intermediate_size
+    ks = jax.random.split(key, 8)
+    std = 0.02
+
+    def n(k, shape):
+        return (jax.random.normal(k, shape) * std).astype(dtype)
+
+    z = lambda *s: jnp.zeros(s, dtype)
+    return {
+        "embed": n(ks[0], (c.vocab_size, H)),
+        "blocks": {
+            "input_ln": z(L, H),
+            "attn": {
+                "q_w": n(ks[1], (L, H, nq * D)),
+                "k_w": n(ks[2], (L, H, nkv * D)),
+                "v_w": n(ks[3], (L, H, nkv * D)),
+                "o_w": n(ks[4], (L, nq * D, H)),
+                "q_norm": z(L, D),
+                "k_norm": z(L, D),
+            },
+            "post_attn_ln": z(L, H),
+            "pre_ffn_ln": z(L, H),
+            "mlp": {
+                "gate_w": n(ks[5], (L, H, I)),
+                "up_w": n(ks[6], (L, H, I)),
+                "down_w": n(ks[7], (L, I, H)),
+            },
+            "post_ffn_ln": z(L, H),
+        },
+        "final_norm": z(H),
+    }
+
+
+from mobilefinetuner_tpu.models.lora_apply import maybe_lora
+
+
+def _block(c: Gemma3TextConfig, bp, x, padding_mask, masks, ropes,
+           is_global, lora_b, i, lora_dropout=0.0, dropout_rng=None):
+    """One Gemma-3 block; bp leaves are [L, ...]-stacked, indexed at i."""
+    eps = c.rms_norm_eps
+    B, S, H = x.shape
+    nq, nkv, D = (c.num_attention_heads, c.num_key_value_heads, c.head_dim)
+    g = lambda t: t[i]
+    rng = None if dropout_rng is None else jax.random.fold_in(dropout_rng, i)
+
+    def lora(y, x_in, name, site):
+        entry = None if lora_b is None else lora_b.get(name)
+        return maybe_lora(y, x_in, entry, i, lora_dropout,
+                          None if rng is None
+                          else jax.random.fold_in(rng, site))
+
+    a = bp["attn"]
+
+    # --- attention, sandwich-normed
+    h = rms_norm(x, g(bp["input_ln"]), eps)
+    q = lora(h @ g(a["q_w"]), h, "q_proj", 0)
+    k = lora(h @ g(a["k_w"]), h, "k_proj", 1)
+    v = lora(h @ g(a["v_w"]), h, "v_proj", 2)
+    q = q.reshape(B, S, nq, D).transpose(0, 2, 1, 3)
+    k = k.reshape(B, S, nkv, D).transpose(0, 2, 1, 3)
+    v = v.reshape(B, S, nkv, D).transpose(0, 2, 1, 3)
+    q = rms_norm(q, g(a["q_norm"]), eps)
+    k = rms_norm(k, g(a["k_norm"]), eps)
+    cos = jnp.where(is_global[i], ropes["cos_g"], ropes["cos_l"])
+    sin = jnp.where(is_global[i], ropes["sin_g"], ropes["sin_l"])
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    mask = jnp.where(is_global[i], masks["global"], masks["local"])
+    ctx = attention(q, k, v, impl=c.attention_impl,
+                    scale=c.query_pre_attn_scalar ** -0.5,
+                    is_causal=False, attn_mask=mask,
+                    padding_mask=padding_mask)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, nq * D)
+    attn_out = lora(ctx @ g(a["o_w"]), ctx, "o_proj", 3)
+    attn_out = rms_norm(attn_out, g(bp["post_attn_ln"]), eps)
+    x = x + attn_out
+
+    # --- MLP, sandwich-normed
+    h = rms_norm(x, g(bp["pre_ffn_ln"]), eps)
+    gate = lora(h @ g(bp["mlp"]["gate_w"]), h, "gate_proj", 4)
+    up = lora(h @ g(bp["mlp"]["up_w"]), h, "up_proj", 5)
+    act = gelu_tanh(gate) * up
+    down = lora(act @ g(bp["mlp"]["down_w"]), act, "down_proj", 6)
+    down = rms_norm(down, g(bp["post_ffn_ln"]), eps)
+    return x + down
+
+
+def hidden_states(config: Gemma3TextConfig, params, input_ids,
+                  attention_mask=None, lora=None,
+                  compute_dtype=jnp.float32, remat: bool = False,
+                  lora_dropout: float = 0.0, dropout_rng=None):
+    c = config
+    B, S = input_ids.shape
+    params = jax.tree.map(jnp.asarray, params)
+    x = params["embed"][input_ids].astype(compute_dtype)
+    # sqrt(hidden) embedding scaling, computed in the embed dtype as HF does
+    normalizer = jnp.asarray(c.hidden_size ** 0.5, compute_dtype)
+    x = x * normalizer
+
+    if attention_mask is not None:
+        # mask-derived positions (HF convention) so left-padded batches get
+        # the same RoPE phases as HF Gemma-3
+        positions = jnp.clip(
+            jnp.cumsum(attention_mask.astype(jnp.int32), axis=-1) - 1, 0)
+    else:
+        positions = jnp.arange(S)
+    cos_g, sin_g = rope_cos_sin(positions, c.head_dim, c.rope_theta)
+    cos_l, sin_l = rope_cos_sin(positions, c.head_dim,
+                                c.rope_local_base_freq)
+    ropes = {"cos_g": cos_g, "sin_g": sin_g, "cos_l": cos_l, "sin_l": sin_l}
+    masks = {"global": causal_mask(S, S),
+             "local": causal_mask(S, S, sliding_window=c.sliding_window)}
+    is_global = jnp.asarray([c.is_global_layer(i)
+                             for i in range(c.num_hidden_layers)])
+
+    bp = jax.tree.map(lambda t: jnp.asarray(t).astype(compute_dtype),
+                      params["blocks"])
+    lora_b = None if lora is None else lora.get("blocks")
+
+    def body(x, i):
+        return _block(c, bp, x, attention_mask, masks, ropes, is_global,
+                      lora_b, i, lora_dropout, dropout_rng), None
+    if remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, jnp.arange(c.num_hidden_layers))
+    return rms_norm(x, params["final_norm"].astype(compute_dtype),
+                    c.rms_norm_eps)
+
+
+def forward(config: Gemma3TextConfig, params, input_ids,
+            attention_mask=None, lora=None, compute_dtype=jnp.float32,
+            remat: bool = False, lora_dropout: float = 0.0,
+            dropout_rng=None) -> jnp.ndarray:
+    """Logits [B, S, V]; lm_head tied to the embedding table."""
+    x = hidden_states(config, params, input_ids, attention_mask, lora,
+                      compute_dtype, remat, lora_dropout, dropout_rng)
+    return x @ params["embed"].astype(compute_dtype).T

@@ -45,23 +45,7 @@ def gelu_new(x):
     return jax.nn.gelu(x, approximate=True)
 
 
-def _maybe_lora(y, x, lora_entry, layer_idx=None):
-    """Add scale·(x@A@B) if a LoRA entry exists for this linear.
-
-    lora_entry: {"A": [in,r], "B": [r,out], "scale": scalar} — or stacked
-    [L,...] leaves indexed by layer_idx when running under scan.
-    Split-QKV column injection ({"q","k","v"} sub-entries with col offsets)
-    is handled in lora/lora.py by materializing a fused entry.
-    """
-    if lora_entry is None:
-        return y
-    A, B = lora_entry["A"], lora_entry["B"]
-    if layer_idx is not None and A.ndim == 3:
-        A, B = A[layer_idx], B[layer_idx]
-    delta = (x @ A.astype(x.dtype)) @ B.astype(x.dtype)
-    scale = jax.lax.stop_gradient(
-        jnp.asarray(lora_entry["scale"]).astype(y.dtype))
-    return y + scale * delta
+from mobilefinetuner_tpu.models.lora_apply import maybe_lora
 
 
 def init_params(config: GPT2Config, key: jax.Array,
@@ -96,18 +80,26 @@ def init_params(config: GPT2Config, key: jax.Array,
     }
 
 
-def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx):
+def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx,
+           lora_dropout=0.0, dropout_rng=None):
     """One pre-LN transformer block. bp leaves are [L, ...]-stacked and
     indexed by layer_idx (traced scalar under scan)."""
     eps = config.layer_norm_epsilon
     H, D = config.n_head, config.head_dim
     B, S, E = x.shape
     g = lambda t: t[layer_idx]
-    lb = lambda name: None if lora_b is None else lora_b.get(name)
+    rng = (None if dropout_rng is None
+           else jax.random.fold_in(dropout_rng, layer_idx))
+
+    def lora(y, x_in, name, site):
+        entry = None if lora_b is None else lora_b.get(name)
+        return maybe_lora(y, x_in, entry, layer_idx, lora_dropout,
+                          None if rng is None
+                          else jax.random.fold_in(rng, site))
 
     h = layer_norm(x, g(bp["ln_1"]["g"]), g(bp["ln_1"]["b"]), eps)
     qkv = h @ g(bp["attn"]["qkv_w"]) + g(bp["attn"]["qkv_b"])
-    qkv = _maybe_lora(qkv, h, lb("attn_qkv"), layer_idx)
+    qkv = lora(qkv, h, "attn_qkv", 0)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     to_heads = lambda t: t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
     ctx = attention(to_heads(q), to_heads(k), to_heads(v),
@@ -115,21 +107,22 @@ def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx):
                     padding_mask=padding_mask)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, E)
     proj = ctx @ g(bp["attn"]["proj_w"]) + g(bp["attn"]["proj_b"])
-    proj = _maybe_lora(proj, ctx, lb("attn_proj"), layer_idx)
+    proj = lora(proj, ctx, "attn_proj", 1)
     x = x + proj
 
     h = layer_norm(x, g(bp["ln_2"]["g"]), g(bp["ln_2"]["b"]), eps)
     fc = h @ g(bp["mlp"]["fc_w"]) + g(bp["mlp"]["fc_b"])
-    fc = _maybe_lora(fc, h, lb("mlp_fc_in"), layer_idx)
+    fc = lora(fc, h, "mlp_fc_in", 2)
     act = gelu_new(fc)
     out = act @ g(bp["mlp"]["proj_w"]) + g(bp["mlp"]["proj_b"])
-    out = _maybe_lora(out, act, lb("mlp_fc_out"), layer_idx)
+    out = lora(out, act, "mlp_fc_out", 3)
     return x + out
 
 
 def hidden_states(config: GPT2Config, params, input_ids,
                   attention_mask=None, lora=None,
-                  compute_dtype=jnp.float32, remat: bool = False):
+                  compute_dtype=jnp.float32, remat: bool = False,
+                  lora_dropout: float = 0.0, dropout_rng=None):
     """Final-LN hidden states [B, S, E] (pre lm_head)."""
     B, S = input_ids.shape
     params = jax.tree.map(jnp.asarray, params)
@@ -149,7 +142,8 @@ def hidden_states(config: GPT2Config, params, input_ids,
                       params["blocks"])
     lora_b = None if lora is None else lora.get("blocks")
 
-    body = lambda x, i: (_block(config, bp, x, padding_mask, lora_b, i), None)
+    body = lambda x, i: (_block(config, bp, x, padding_mask, lora_b, i,
+                                lora_dropout, dropout_rng), None)
     if remat:
         body = jax.checkpoint(body)
     x, _ = jax.lax.scan(body, x, jnp.arange(config.n_layer))
@@ -160,15 +154,15 @@ def hidden_states(config: GPT2Config, params, input_ids,
 
 
 def forward(config: GPT2Config, params, input_ids, attention_mask=None,
-            lora=None, compute_dtype=jnp.float32,
-            remat: bool = False) -> jnp.ndarray:
+            lora=None, compute_dtype=jnp.float32, remat: bool = False,
+            lora_dropout: float = 0.0, dropout_rng=None) -> jnp.ndarray:
     """Logits [B, S, V]. Tied lm_head: x @ wte^T (gpt2_model.cpp:421-440).
 
     The reference caches wte^T when embeddings are frozen (SURVEY.md
     §2.12.5); under XLA the transpose is a free layout change, so no cache.
     """
     x = hidden_states(config, params, input_ids, attention_mask, lora,
-                      compute_dtype, remat)
+                      compute_dtype, remat, lora_dropout, dropout_rng)
     wte = params["wte"].astype(compute_dtype)
     logits = x @ wte.T
     return logits

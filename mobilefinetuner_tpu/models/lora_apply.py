@@ -1,0 +1,40 @@
+"""Shared functional LoRA application used by all model families.
+
+y = base(x) + scale · ((dropout(x) @ A) @ B), PEFT semantics: dropout is
+applied to the LoRA branch's input only, never the base path
+(reference: nn/lora_linear.cpp:47-106 forward; dropout field in
+LoraSpec, lora_injector.h:29-71). "scale" is stop-gradiented — it is a
+hyperparameter leaf living in the pytree, not a trainable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def maybe_lora(y, x, lora_entry, layer_idx=None, dropout: float = 0.0,
+               rng: Optional[jax.Array] = None):
+    """Add the LoRA delta to y if an entry exists.
+
+    lora_entry: {"A": [in,r] or [L,in,r], "B": [r,out] or [L,r,out],
+    "scale": scalar}; stacked leaves are indexed by layer_idx (a traced
+    scalar under lax.scan). dropout>0 with rng!=None enables train-mode
+    inverted dropout on the branch input.
+    """
+    if lora_entry is None:
+        return y
+    A, B = lora_entry["A"], lora_entry["B"]
+    if layer_idx is not None and A.ndim == 3:
+        A, B = A[layer_idx], B[layer_idx]
+    xb = x
+    if dropout > 0.0 and rng is not None:
+        keep = 1.0 - dropout
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        xb = jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    delta = (xb @ A.astype(x.dtype)) @ B.astype(x.dtype)
+    scale = jax.lax.stop_gradient(
+        jnp.asarray(lora_entry["scale"]).astype(y.dtype))
+    return y + scale * delta

@@ -48,6 +48,7 @@ def dot_product_attention(
         is_causal: bool = True,
         sliding_window: Optional[int] = None,
         padding_mask: Optional[jnp.ndarray] = None,
+        attn_mask: Optional[jnp.ndarray] = None,
         logits_dtype=jnp.float32) -> jnp.ndarray:
     """Scaled dot-product attention with GQA.
 
@@ -57,6 +58,9 @@ def dot_product_attention(
     `repeat_kv_heads`, core/ops.cpp:2072; on TPU the einsum broadcast keeps
     K/V in their small layout and saves HBM).
     padding_mask: [B, S] bool/0-1, True/1 = real token.
+    attn_mask: precomputed [q, k] bool mask (True = attend) used INSTEAD of
+    the causal/sliding construction (e.g. Gemma's per-layer selected mask);
+    combined with padding_mask if both given.
     scale: default 1/sqrt(D). (Gemma uses query_pre_attn_scalar^-0.5 —
     pass it explicitly; gemma_model.h:33.)
     """
@@ -73,7 +77,9 @@ def dot_product_attention(
     scores = scores.astype(logits_dtype) * jnp.asarray(scale, logits_dtype)
 
     neg = jnp.asarray(jnp.finfo(logits_dtype).min, logits_dtype)
-    if is_causal or sliding_window is not None:
+    if attn_mask is not None:
+        scores = jnp.where(attn_mask[None, None, None, :, :], scores, neg)
+    elif is_causal or sliding_window is not None:
         m = causal_mask(S, S, sliding_window if sliding_window else None)
         scores = jnp.where(m[None, None, None, :, :], scores, neg)
     if padding_mask is not None:

@@ -65,6 +65,16 @@ def lm_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
     return total / count
 
 
+def lm_cross_entropy_sum(
+        logits: jnp.ndarray, labels: jnp.ndarray,
+        ignore_index: int = IGNORE_INDEX) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(sum_nll, valid_token_count) — the accumulation-friendly form used by
+    the train step for exact token-weighted gradient accumulation."""
+    logits_s, labels_s = _shift(logits, labels)
+    nll, valid = _token_nll(logits_s, labels_s, ignore_index)
+    return nll.sum(), valid.sum()
+
+
 def lm_cross_entropy_with_count(
         logits: jnp.ndarray, labels: jnp.ndarray,
         ignore_index: int = IGNORE_INDEX) -> Tuple[jnp.ndarray, jnp.ndarray]:

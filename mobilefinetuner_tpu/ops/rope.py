@@ -14,14 +14,16 @@ import jax.numpy as jnp
 
 def rope_cos_sin(positions: jnp.ndarray, head_dim: int,
                  theta: float) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """cos/sin tables [S, head_dim] for integer positions [S].
+    """cos/sin tables [..., S, head_dim] for integer positions [..., S]
+    ([S] shared across the batch, or [B, S] per-row, e.g. mask-derived
+    positions for left-padded batches).
 
     HF convention: inv_freq over even dims, each frequency repeated across
     the two halves (rotate_half pairing dim i with dim i + head_dim/2).
     """
     inv_freq = 1.0 / (theta ** (
         jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
-    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq
     emb = jnp.concatenate([freqs, freqs], axis=-1)
     return jnp.cos(emb), jnp.sin(emb)
 
@@ -33,8 +35,13 @@ def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
 
 def apply_rope(x: jnp.ndarray, cos: jnp.ndarray,
                sin: jnp.ndarray) -> jnp.ndarray:
-    """x: [B, H, S, D]; cos/sin: [S, D] → same shape, same dtype as x."""
+    """x: [B, H, S, D]; cos/sin: [S, D] or [B, S, D] → same shape/dtype.
+
+    expand_dims inserts the head axis: [S,D]->[1,S,D] (broadcast over B,H),
+    [B,S,D]->[B,1,S,D] (broadcast over H)."""
     orig = x.dtype
     xf = x.astype(jnp.float32)
-    out = xf * cos[None, None, :, :] + _rotate_half(xf) * sin[None, None, :, :]
+    c = jnp.expand_dims(cos, -3)
+    s = jnp.expand_dims(sin, -3)
+    out = xf * c + _rotate_half(xf) * s
     return out.astype(orig)

@@ -1,0 +1,38 @@
+"""LR schedules: warmup + cosine-to-floor or linear decay.
+
+Reference semantics: gpt2_lora_finetune/main.cpp:469-488 (linear warmup over
+warmup_ratio of total steps, then cosine decay to 10% of peak) and
+gemma_trainer.cpp:64-85 (warmup + linear or cosine). Pure functions of the
+step index so they trace into the jitted train step without recompilation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def lr_schedule(step, total_steps: int, base_lr: float,
+                warmup_ratio: float = 0.03, kind: str = "cosine",
+                min_lr_ratio: float = 0.1):
+    """LR at `step` (0-based, traced or static).
+
+    kind: "cosine" (decay to min_lr_ratio*base_lr, main.cpp:469-488),
+    "linear" (decay to min_lr_ratio*base_lr), "constant".
+    """
+    step = jnp.asarray(step, jnp.float32)
+    total = jnp.asarray(max(total_steps, 1), jnp.float32)
+    warmup = jnp.maximum(jnp.floor(total * warmup_ratio), 0.0)
+    warm_lr = base_lr * (step + 1.0) / jnp.maximum(warmup, 1.0)
+    progress = jnp.clip((step - warmup) / jnp.maximum(total - warmup, 1.0),
+                        0.0, 1.0)
+    floor = base_lr * min_lr_ratio
+    if kind == "cosine":
+        decayed = floor + (base_lr - floor) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * progress))
+    elif kind == "linear":
+        decayed = base_lr + (floor - base_lr) * progress
+    elif kind == "constant":
+        decayed = jnp.asarray(base_lr, jnp.float32)
+    else:
+        raise ValueError(f"unknown schedule kind {kind!r}")
+    return jnp.where(step < warmup, warm_lr, decayed)

@@ -1,0 +1,141 @@
+"""Jitted train/eval step builders: value_and_grad + lax.scan gradient
+accumulation + clip + LR schedule + Adam, one XLA program per optimizer step.
+
+Re-design of the reference trainer loops (reference: optim/trainer.{h,cpp}
+`LoRATrainer`, optim/gemma_trainer.{h,cpp} `GemmaLoRATrainer`, and the inline
+loop in gpt2_lora_finetune/main.cpp:561-684): where the reference runs
+per-micro-batch Python-level forward/backward with loss scaled by 1/accum
+(main.cpp:569-583), we scan over the micro-batch axis INSIDE the compiled
+step — micro-batches stream through one compiled block, gradients accumulate
+in registers/HBM, and the optimizer update happens in the same program
+(no host round-trips inside an optimizer step).
+
+Generic over "what is trainable": LoRA training passes the LoRA tree as
+`trainable` and the frozen base params as `frozen`; full fine-tuning passes
+the model params as `trainable`. The loss_fn contract is
+loss_fn(trainable, frozen, micro_batch) -> scalar loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from mobilefinetuner_tpu.optim.adam import (AdamConfig, adam_update,
+                                            clip_by_global_norm, init_state)
+from mobilefinetuner_tpu.optim.schedule import lr_schedule
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    total_steps: int = 1000
+    lr: float = 1e-4
+    warmup_ratio: float = 0.03
+    schedule: str = "cosine"  # cosine | linear | constant
+    min_lr_ratio: float = 0.1
+    clip_grad_norm: float = 1.0
+    grad_accum_steps: int = 1
+    weight_decay: float = 0.0
+    coupled_weight_decay: bool = False
+    amsgrad: bool = False
+
+    def adam(self) -> AdamConfig:
+        return AdamConfig(lr=self.lr, weight_decay=self.weight_decay,
+                          coupled_weight_decay=self.coupled_weight_decay,
+                          amsgrad=self.amsgrad)
+
+
+def reshape_for_accum(batch: dict, accum: int) -> dict:
+    """[accum*micro_b, ...] arrays -> [accum, micro_b, ...] for lax.scan."""
+    def r(x):
+        total = x.shape[0]
+        assert total % accum == 0, (total, accum)
+        return x.reshape(accum, total // accum, *x.shape[1:])
+    return jax.tree.map(r, batch)
+
+
+def make_train_step(loss_fn: Callable[[Any, Any, dict], tuple],
+                    train_cfg: TrainConfig,
+                    mask: Optional[Any] = None,
+                    donate: bool = True,
+                    in_shardings=None, out_shardings=None):
+    """Build the jitted optimizer step.
+
+    loss_fn(trainable, frozen, micro_batch) -> (sum_loss, weight): the SUM
+    of per-token losses and the token count (or any weight). Accumulation
+    sums both across micro-batches and divides once at the end, so the
+    update equals the gradient of total_loss/total_weight over the whole
+    batch — exact even when micro-batches have unequal valid-token counts
+    (masked labels), unlike mean-of-means accumulation. (The reference
+    scales each micro loss by 1/accum, main.cpp:569-583, which has the
+    mean-of-means bias; we keep the exact semantics.)
+
+    Returns step_fn(trainable, frozen, opt_state, batch, step) ->
+    (trainable, opt_state, metrics) where batch leaves are
+    [accum*micro_b, ...] and step is the 0-based optimizer step index
+    (drives the LR schedule as a traced value — no recompiles).
+    metrics = {loss, grad_norm, lr} (scalars, pre-clip global norm as in
+    main.cpp:490-516).
+    """
+    accum = train_cfg.grad_accum_steps
+    adam_cfg = train_cfg.adam()
+
+    def step_fn(trainable, frozen, opt_state, batch, step):
+        micro = reshape_for_accum(batch, accum)
+
+        def sum_fn(tr, mb):
+            s, w = loss_fn(tr, frozen, mb)
+            return s, w
+
+        vg = jax.value_and_grad(sum_fn, has_aux=True)
+
+        def body(carry, mb):
+            g_acc, loss_acc, w_acc = carry
+            (s, w), g = vg(trainable, mb)
+            g_acc = jax.tree.map(jnp.add, g_acc, g)
+            return (g_acc, loss_acc + s,
+                    w_acc + w.astype(jnp.float32)), None
+
+        g0 = jax.tree.map(lambda t: jnp.zeros(t.shape, jnp.float32),
+                          trainable)
+        (g_sum, loss_sum, w_sum), _ = jax.lax.scan(
+            body, (g0, jnp.float32(0.0), jnp.float32(0.0)), micro)
+        inv = 1.0 / jnp.maximum(w_sum, 1.0)
+        grads = jax.tree.map(lambda g: g * inv, g_sum)
+        loss = loss_sum * inv
+        if train_cfg.clip_grad_norm and train_cfg.clip_grad_norm > 0:
+            grads, norm = clip_by_global_norm(grads,
+                                              train_cfg.clip_grad_norm)
+        else:
+            from mobilefinetuner_tpu.optim.adam import global_norm
+            norm = global_norm(grads)
+        lr = lr_schedule(step, train_cfg.total_steps, train_cfg.lr,
+                         train_cfg.warmup_ratio, train_cfg.schedule,
+                         train_cfg.min_lr_ratio)
+        trainable2, opt_state2 = adam_update(grads, opt_state, trainable,
+                                             adam_cfg, lr, mask)
+        metrics = {"loss": loss, "grad_norm": norm, "lr": lr}
+        return trainable2, opt_state2, metrics
+
+    donate_argnums = (0, 2) if donate else ()
+    return jax.jit(step_fn, donate_argnums=donate_argnums,
+                   in_shardings=in_shardings, out_shardings=out_shardings)
+
+
+def make_eval_step(nll_fn: Callable[[Any, Any, dict], tuple]):
+    """Jitted eval step: nll_fn(trainable, frozen, batch) ->
+    (sum_nll, token_count). Token-weighted accumulation is the caller's job
+    (eval_ppl.cpp:157-200 semantics)."""
+    @jax.jit
+    def eval_step(trainable, frozen, batch):
+        return nll_fn(trainable, frozen, batch)
+    return eval_step
+
+
+def init_optimizer(trainable, train_cfg: TrainConfig,
+                   mask: Optional[Any] = None) -> dict:
+    return init_state(trainable, train_cfg.adam(), mask)

@@ -1,0 +1,127 @@
+"""WikiText-2 pipeline: chunking/padding/label semantics, per-epoch seeded
+shuffle, streaming == in-RAM equivalence, pretokenized mode, data_fraction,
+stride overlap masking. (Reference analog: data/test_wikitext2_dataset.cpp.)"""
+
+import numpy as np
+import pytest
+
+from mobilefinetuner_tpu.data.wikitext2 import (IGNORE_INDEX, WT2Config,
+                                                WikiText2Dataset,
+                                                pretokenize)
+
+EOS = 999
+
+
+def _encode(line: str):
+    # toy whitespace "tokenizer": word -> stable small int
+    return [abs(hash(w)) % 900 for w in line.split()]
+
+
+@pytest.fixture(scope="module")
+def corpus_file(tmp_path_factory):
+    d = tmp_path_factory.mktemp("wt2")
+    path = str(d / "wiki.train.tokens")
+    rng = np.random.default_rng(0)
+    with open(path, "w") as f:
+        for i in range(200):
+            n = int(rng.integers(3, 30))
+            f.write(" ".join(f"w{rng.integers(0, 500)}"
+                             for _ in range(n)) + "\n")
+            if i % 17 == 0:
+                f.write("\n")  # blank lines are skipped
+    return path
+
+
+def _mk(path, **kw):
+    cfg = WT2Config(seq_len=32, batch_size=4, seed=7, **kw)
+    return WikiText2Dataset(path, "train", cfg, _encode, eos_id=EOS)
+
+
+def test_batch_shapes_and_labels(corpus_file):
+    ds = _mk(corpus_file)
+    batch = next(ds.epoch(0))
+    assert batch["input_ids"].shape == (4, 32)
+    assert batch["input_ids"].dtype == np.int32
+    assert batch["attention_mask"].dtype == np.float32
+    assert batch["labels"].dtype == np.int32
+    # full chunks: labels == input_ids, mask all ones
+    assert (batch["attention_mask"] == 1.0).all()
+    np.testing.assert_array_equal(batch["input_ids"], batch["labels"])
+
+
+def test_eos_inserted_between_lines(corpus_file):
+    ds = _mk(corpus_file, shuffle=False)
+    flat = np.concatenate([ds._chunk_tokens(i)
+                           for i in range(ds.num_chunks)])
+    assert (flat == EOS).sum() >= 150  # one EOS per nonempty line
+
+
+def test_shuffle_is_seeded_and_per_epoch(corpus_file):
+    ds = _mk(corpus_file)
+    b0a = next(ds.epoch(0))["input_ids"]
+    b0b = next(ds.epoch(0))["input_ids"]
+    b1 = next(ds.epoch(1))["input_ids"]
+    np.testing.assert_array_equal(b0a, b0b)  # same epoch -> same order
+    assert not np.array_equal(b0a, b1)  # different epoch -> reshuffled
+
+
+def test_streaming_equals_in_ram(corpus_file):
+    ram = _mk(corpus_file, shuffle=False)
+    stream = _mk(corpus_file, shuffle=False, streaming=True,
+                 window_tokens=64)
+    assert ram.num_chunks == stream.num_chunks
+    assert ram.total_valid_tokens() == stream.total_valid_tokens()
+    for i in range(ram.num_chunks):
+        np.testing.assert_array_equal(ram._chunk_tokens(i),
+                                      stream._chunk_tokens(i))
+    # random access out of window order
+    for i in (ram.num_chunks - 1, 0, ram.num_chunks // 2, 1):
+        np.testing.assert_array_equal(ram._chunk_tokens(i),
+                                      stream._chunk_tokens(i))
+
+
+def test_pretokenized_mode(tmp_path, corpus_file):
+    out_bin = str(tmp_path / "toks.bin")
+    n = pretokenize(corpus_file, _encode, EOS, out_bin)
+    ram = _mk(corpus_file, shuffle=False)
+    cfg = WT2Config(seq_len=32, batch_size=4, seed=7, shuffle=False)
+    pre = WikiText2Dataset("", "train", cfg, _encode, eos_id=EOS,
+                           pretokenized_bin=out_bin)
+    assert n == ram.total_valid_tokens()
+    assert pre.num_chunks == ram.num_chunks
+    for i in range(ram.num_chunks):
+        np.testing.assert_array_equal(ram._chunk_tokens(i),
+                                      pre._chunk_tokens(i))
+
+
+def test_data_fraction(corpus_file):
+    full = _mk(corpus_file)
+    half = _mk(corpus_file, data_fraction=0.5)
+    assert half.num_chunks <= full.num_chunks // 2 + 1
+
+
+def test_stride_overlap_label_masking(corpus_file):
+    ds = _mk(corpus_file, stride=16, shuffle=False)
+    ids1, mask1, lab1 = ds.chunk(1)
+    # overlapping prefix (seq_len - stride = 16 tokens) is label-masked
+    assert (lab1[:16] == IGNORE_INDEX).all()
+    assert (lab1[16:] != IGNORE_INDEX).any()
+    ids0, _, lab0 = ds.chunk(0)
+    np.testing.assert_array_equal(lab0, ids0)  # first chunk unmasked
+    # chunk 1 starts stride tokens in
+    np.testing.assert_array_equal(ids1[:16], ids0[16:])
+
+
+def test_drop_last_and_padding(tmp_path):
+    path = str(tmp_path / "small.txt")
+    with open(path, "w") as f:
+        f.write("a b c d e\n" * 7)
+    cfg = WT2Config(seq_len=32, batch_size=2, drop_last=False,
+                    shuffle=False)
+    ds = WikiText2Dataset(path, "train", cfg, _encode, eos_id=EOS)
+    chunks = [ds.chunk(i) for i in range(ds.num_chunks)]
+    ids, mask, lab = chunks[-1]
+    n_valid = int(mask.sum())
+    assert n_valid < 32
+    assert (lab[n_valid:] == IGNORE_INDEX).all()
+    assert (ids[n_valid:] == EOS).all()  # pad with pad_id(=eos)

@@ -1,0 +1,116 @@
+"""Gemma-3 golden-logit parity vs HF transformers Gemma3ForCausalLM
+(tiny random weights), covering GQA, q/k norms, dual-theta RoPE,
+sliding/global layer interleave, sandwich norms, scaled embeddings, tied
+head. (Reference analog: test_gemma_forward.cpp + the align-dump harness,
+train_lora_gemma.cpp:620-920.)"""
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from mobilefinetuner_tpu.core.config import Gemma3TextConfig
+from mobilefinetuner_tpu.io.checkpoints import gemma3_params_from_hf
+from mobilefinetuner_tpu.models import gemma3
+
+
+@pytest.fixture(scope="module")
+def hf_tiny():
+    from transformers import Gemma3TextConfig as HFCfg
+    from transformers import Gemma3ForCausalLM
+    torch.manual_seed(0)
+    hf_cfg = HFCfg(
+        vocab_size=199, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=128, rope_theta=1_000_000.0,
+        rope_local_base_freq=10_000.0, sliding_window=8,
+        query_pre_attn_scalar=8.0, rms_norm_eps=1e-6,
+        layer_types=["sliding_attention", "sliding_attention",
+                     "full_attention", "sliding_attention"],
+        attention_dropout=0.0, tie_word_embeddings=True)
+    model = Gemma3ForCausalLM(hf_cfg).eval()
+    cfg = Gemma3TextConfig(
+        vocab_size=199, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=128, sliding_window=8,
+        query_pre_attn_scalar=8.0,
+        layer_types=["sliding_attention", "sliding_attention",
+                     "full_attention", "sliding_attention"])
+    sd = {k: v.detach().numpy() for k, v in model.model.state_dict().items()}
+    params = gemma3_params_from_hf(sd, cfg)
+    return hf_cfg, model, cfg, params
+
+
+def test_logits_match_hf(hf_tiny):
+    hf_cfg, model, cfg, params = hf_tiny
+    rng = np.random.default_rng(0)
+    # S=24 > sliding_window=8 so local masking actually matters
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 24))
+    with torch.no_grad():
+        ref = model(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(gemma3.forward(cfg, params, jnp.array(ids)))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=1e-3)
+
+
+def test_sliding_vs_global_layers_differ(hf_tiny):
+    """Ablation: flipping a local layer to global must change logits
+    (proves the per-layer mask/theta selection is live)."""
+    _, _, cfg, params = hf_tiny
+    import dataclasses
+    cfg2 = dataclasses.replace(
+        cfg, layer_types=["full_attention"] * 4)
+    rng = np.random.default_rng(1)
+    ids = jnp.array(rng.integers(0, cfg.vocab_size, size=(1, 24)))
+    a = np.asarray(gemma3.forward(cfg, params, ids))
+    b = np.asarray(gemma3.forward(cfg2, params, ids))
+    assert np.abs(a - b).max() > 1e-4
+
+
+def test_lora_zero_init_identity_and_grads(hf_tiny):
+    import jax
+    from mobilefinetuner_tpu.lora.lora import (LoRASpec, init_lora_gemma3,
+                                               merge_gemma3, unmerge_gemma3)
+    from mobilefinetuner_tpu.ops.loss import lm_cross_entropy
+    _, _, cfg, params = hf_tiny
+    spec = LoRASpec(rank=4, alpha=32.0, init="peft", targets=None)
+    lora = init_lora_gemma3(cfg, spec, jax.random.PRNGKey(0))
+    assert set(lora["blocks"]) == {"q_proj", "k_proj", "v_proj", "o_proj",
+                                   "gate_proj", "up_proj", "down_proj"}
+    rng = np.random.default_rng(2)
+    ids = jnp.array(rng.integers(0, cfg.vocab_size, size=(2, 16)))
+    base = gemma3.forward(cfg, params, ids)
+    with_lora = gemma3.forward(cfg, params, ids, lora=lora)
+    np.testing.assert_allclose(np.asarray(base), np.asarray(with_lora),
+                               atol=1e-5)
+
+    # every LoRA target receives gradient (the reference's GPT-2 qkv-LoRA
+    # gets NO grad, SURVEY.md §2.12.1 — Gemma path and ours must)
+    def loss_fn(lora):
+        return lm_cross_entropy(
+            gemma3.forward(cfg, params, ids, lora=lora), ids)
+    grads = jax.grad(loss_fn)(lora)
+    for name, entry in grads["blocks"].items():
+        ga = np.abs(np.asarray(entry["A"])).sum()
+        gb = np.abs(np.asarray(entry["B"])).sum()
+        assert gb > 0, f"{name}.B got no gradient"
+        # A's grad flows through B=0 at init, so dL/dA == 0 on the very
+        # first step; after B moves it must be nonzero. Perturb B:
+    lora2 = jax.tree.map(lambda x: x, lora)
+    for entry in lora2["blocks"].values():
+        entry["B"] = jnp.ones_like(entry["B"]) * 0.01
+    grads2 = jax.grad(loss_fn)(lora2)
+    for name, entry in grads2["blocks"].items():
+        assert np.abs(np.asarray(entry["A"])).sum() > 0, \
+            f"{name}.A got no gradient"
+
+    # merge/unmerge round trip
+    merged = merge_gemma3(params, lora2)
+    dyn = gemma3.forward(cfg, params, ids, lora=lora2)
+    stat = gemma3.forward(cfg, merged, ids)
+    np.testing.assert_allclose(np.asarray(dyn), np.asarray(stat),
+                               atol=2e-4)
+    restored = unmerge_gemma3(merged, lora2)
+    import jax as _jax
+    for a, b in zip(_jax.tree.leaves(params), _jax.tree.leaves(restored)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)

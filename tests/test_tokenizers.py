@@ -1,0 +1,125 @@
+"""Tokenizer parity vs the HF `tokenizers` Rust library as oracle.
+(Reference analogs: core/test_tokenizer_bpe.cpp HF-parity cases,
+core/test_tokenizer_gemma.cpp.) With zero egress we can't use the real
+GPT-2/Gemma vocab files, so we TRAIN small tokenizers of the same
+construction with the oracle library, save them in the same file formats,
+and require byte-identical encodes/decodes."""
+
+import numpy as np
+import pytest
+
+CORPUS = [
+    "The quick brown fox jumps over the lazy dog.",
+    "Hello, world! It's a fine day — isn't it?",
+    "In 1984, George Orwell wrote about   surveillance states.",
+    "Tokenization: bytes, unicode (naïve café), and CJK 日本語のテキスト.",
+    "def main():\n    print('hello')\n",
+    "Prices rose 3.5% to $1,234.56 yesterday.",
+    "  leading spaces and\ttabs\tmatter  ",
+] * 50
+
+TRICKY = [
+    "Hello, world!",
+    "it's isn't we're I'll you've they'd I'm",
+    "multiple   spaces\nand\nnewlines\n\n",
+    "numbers 123 45.67 and mixed a1b2",
+    "unicode: naïve café résumé — über 日本語",
+    "   ",
+    "",
+    "a",
+    "don't stop 'til midnight '99",
+]
+
+
+@pytest.fixture(scope="module")
+def gpt2_files(tmp_path_factory):
+    from tokenizers import Tokenizer, models, pre_tokenizers, decoders, \
+        trainers
+    d = tmp_path_factory.mktemp("gpt2tok")
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=600, special_tokens=["<|endoftext|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False)
+    tok.train_from_iterator(CORPUS, trainer)
+    tok.model.save(str(d))
+    return str(d), tok
+
+
+def test_gpt2_bpe_matches_oracle(gpt2_files):
+    from mobilefinetuner_tpu.data.tokenizer_bpe import GPT2BPETokenizer
+    d, oracle = gpt2_files
+    ours = GPT2BPETokenizer.from_pretrained(d)
+    for text in TRICKY + CORPUS[:7]:
+        expect = oracle.encode(text).ids
+        got = ours.encode(text)
+        assert got == expect, (text, got, expect)
+
+
+def test_gpt2_bpe_decode_roundtrip(gpt2_files):
+    from mobilefinetuner_tpu.data.tokenizer_bpe import GPT2BPETokenizer
+    d, _ = gpt2_files
+    ours = GPT2BPETokenizer.from_pretrained(d)
+    for text in TRICKY:
+        assert ours.decode(ours.encode(text)) == text
+
+
+def test_gpt2_special_ids(gpt2_files):
+    from mobilefinetuner_tpu.data.tokenizer_bpe import GPT2BPETokenizer
+    d, _ = gpt2_files
+    ours = GPT2BPETokenizer.from_pretrained(d)
+    # GPT-2 convention: eos==bos==pad==unk (tokenizer_bpe.h:29-33)
+    assert ours.eos_id == ours.bos_id == ours.pad_id == ours.unk_id
+    assert ours.eos_id == ours.encoder["<|endoftext|>"]
+
+
+@pytest.fixture(scope="module")
+def gemma_file(tmp_path_factory):
+    from tokenizers import Tokenizer, models, normalizers, trainers
+    d = tmp_path_factory.mktemp("gemmatok")
+    byte_tokens = [f"<0x{b:02X}>" for b in range(256)]
+    tok = Tokenizer(models.BPE(unk_token="<unk>", byte_fallback=True))
+    tok.normalizer = normalizers.Replace(" ", "▁")
+    trainer = trainers.BpeTrainer(
+        vocab_size=700,
+        special_tokens=["<pad>", "<eos>", "<bos>", "<unk>"] + byte_tokens,
+        show_progress=False)
+    tok.train_from_iterator(CORPUS, trainer)
+    path = str(d / "tokenizer.json")
+    tok.save(path)
+    return path, tok
+
+
+def test_gemma_bpe_matches_oracle(gemma_file):
+    from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
+    path, oracle = gemma_file
+    ours = GemmaTokenizer(path)
+    for text in TRICKY + CORPUS[:7]:
+        expect = oracle.encode(text).ids
+        got = ours.encode(text, add_bos=False)
+        assert got == expect, (text, got, expect)
+
+
+def test_gemma_byte_fallback(gemma_file):
+    from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
+    path, oracle = gemma_file
+    ours = GemmaTokenizer(path)
+    # char far outside the training corpus -> byte-fallback tokens
+    text = "☃ unseen 𝄞"
+    got = ours.encode(text, add_bos=False)
+    expect = oracle.encode(text).ids
+    assert got == expect
+    assert ours.decode(got) == text.replace(" ", " ")
+
+
+def test_gemma_add_bos_and_special_ids(gemma_file):
+    from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
+    path, _ = gemma_file
+    ours = GemmaTokenizer(path)
+    assert ours.pad_id == 0 and ours.eos_id == 1 and ours.bos_id == 2 \
+        and ours.unk_id == 3
+    ids = ours.encode("hello")
+    assert ids[0] == ours.bos_id  # add_bos defaults True
+    assert ours.encode("hello", add_bos=False) == ids[1:]

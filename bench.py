@@ -1,0 +1,84 @@
+"""Headline benchmark: GPT-2-small LoRA training throughput (tokens/sec/chip).
+
+Config mirrors the driver's primary config (BASELINE.json): GPT-2-small
+124M, LoRA r=8 alpha=16, seq_len=128, WikiText-2-shaped batches. Baseline is
+the reference's published epoch time — 4-6 h/epoch at batch=4, S=128 on a
+mobile SoC (reference README.md:419), i.e. ~2.39M-token WikiText-2 train
+split / 18000 s midpoint ≈ 133 tokens/sec.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mobilefinetuner_tpu.core.config import GPT2Config
+from mobilefinetuner_tpu.lora.lora import (LoRASpec, init_lora_gpt2,
+                                           trainable_mask)
+from mobilefinetuner_tpu.models import gpt2
+from mobilefinetuner_tpu.ops.loss import lm_cross_entropy_sum
+from mobilefinetuner_tpu.train.trainer import (TrainConfig, init_optimizer,
+                                               make_train_step)
+
+BASELINE_TOKENS_PER_SEC = 2_391_884 / 18_000.0  # ≈ 132.9 (reference CPU)
+
+
+def main():
+    config = GPT2Config.gpt2_small()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    batch, seq = (32, 128) if on_tpu else (4, 64)
+    compute_dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    steps = 50 if on_tpu else 3
+
+    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    spec = LoRASpec(rank=8, alpha=16.0)
+    lora = init_lora_gpt2(config, spec, jax.random.PRNGKey(1))
+    mask = trainable_mask(lora)
+    tc = TrainConfig(total_steps=1000, lr=2e-4, schedule="constant",
+                     warmup_ratio=0.0, grad_accum_steps=1)
+
+    def loss_fn(lora, params, mb):
+        logits = gpt2.forward(config, params, mb["input_ids"],
+                              attention_mask=mb["attention_mask"], lora=lora,
+                              compute_dtype=compute_dtype)
+        return lm_cross_entropy_sum(logits, mb["labels"])
+
+    step_fn = make_train_step(loss_fn, tc, mask=mask, donate=True)
+    opt = init_optimizer(lora, tc, mask)
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, config.vocab_size, (batch, seq)),
+                      jnp.int32)
+    b = {"input_ids": ids, "attention_mask": jnp.ones_like(ids),
+         "labels": ids}
+
+    # Warmup: compile + 2 steady-state steps. NOTE: sync via host readback
+    # of a scalar, not block_until_ready — the latter does not actually
+    # wait for completion on the tunneled TPU platform.
+    for s in range(3):
+        lora, opt, m = step_fn(lora, params, opt, b, jnp.int32(s))
+    float(m["loss"])
+
+    t0 = time.perf_counter()
+    for s in range(steps):
+        lora, opt, m = step_fn(lora, params, opt, b, jnp.int32(s + 3))
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+
+    toks_per_sec = batch * seq * steps / dt
+    print(json.dumps({
+        "metric": "gpt2s_lora_train_tokens_per_sec_per_chip",
+        "value": round(toks_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(toks_per_sec / BASELINE_TOKENS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

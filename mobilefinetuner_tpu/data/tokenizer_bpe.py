@@ -138,12 +138,18 @@ class GPT2BPETokenizer:
     # -- public API ----------------------------------------------------------
 
     def encode(self, text: str) -> List[int]:
+        # Special tokens are matched verbatim before BPE (HF AddedToken
+        # semantics): "<|endoftext|>" in the text becomes the single eos id,
+        # not the BPE pieces of its characters.
         ids: List[int] = []
-        for piece in _PAT.findall(text):
-            mapped = "".join(self.byte_encoder[b]
-                             for b in piece.encode("utf-8"))
-            for sub in self._bpe(mapped):
-                ids.append(self.encoder.get(sub, self.unk_id))
+        for part in text.split(self.eos_token):
+            for piece in _PAT.findall(part):
+                mapped = "".join(self.byte_encoder[b]
+                                 for b in piece.encode("utf-8"))
+                for sub in self._bpe(mapped):
+                    ids.append(self.encoder.get(sub, self.unk_id))
+            ids.append(self.eos_id)
+        ids.pop()  # one eos per separator, not per part
         return ids
 
     def decode(self, ids: List[int]) -> str:

@@ -141,6 +141,11 @@ class GemmaTokenizer:
         self.byte_fallback = model.get("byte_fallback", False)
         self.unk_token = model.get("unk_token")
         self.normalizer = _Normalizer(spec.get("normalizer"))
+        # Space handling may live in a Metaspace pre_tokenizer instead of a
+        # Replace normalizer (common in SentencePiece-converted tokenizers).
+        # Anything else unsupported -> raise, never silently produce garbage.
+        self.metaspace: Optional[Tuple[str, str]] = None  # (repl, scheme)
+        self._parse_pre_tokenizer(spec.get("pre_tokenizer"))
         self.added_tokens = {t["content"]: t["id"]
                              for t in spec.get("added_tokens", [])}
         self._added_re = None
@@ -158,6 +163,22 @@ class GemmaTokenizer:
         self.unk_id = _tid("<unk>", 3)
         self.add_bos = True  # Gemma default (tokenizer_gemma.h add_bos)
 
+    def _parse_pre_tokenizer(self, spec: Optional[dict]):
+        if spec is None:
+            return
+        t = spec.get("type")
+        if t == "Sequence":
+            for sub in spec.get("pretokenizers", []):
+                self._parse_pre_tokenizer(sub)
+        elif t == "Metaspace":
+            self.metaspace = (spec.get("replacement", "▁"),
+                              spec.get("prepend_scheme",
+                                       "always" if spec.get("add_prefix_space",
+                                                            True)
+                                       else "never"))
+        else:
+            raise ValueError(f"unsupported pre_tokenizer {t}")
+
     @classmethod
     def from_pretrained(cls, model_dir: str) -> "GemmaTokenizer":
         return cls(os.path.join(model_dir, "tokenizer.json"))
@@ -166,10 +187,16 @@ class GemmaTokenizer:
     def vocab_size(self) -> int:
         return len(self.vocab)
 
-    def _encode_chunk(self, text: str) -> List[int]:
+    def _encode_chunk(self, text: str, first: bool = True) -> List[int]:
         if not text:
             return []
         text = self.normalizer(text)
+        if self.metaspace is not None:
+            rep, scheme = self.metaspace
+            text = text.replace(" ", rep)
+            if (scheme == "always" or (scheme == "first" and first)) \
+                    and not text.startswith(rep):
+                text = rep + text
         pieces = _bpe_heap(list(text), self.ranks)
         ids: List[int] = []
         for piece in pieces:
@@ -190,13 +217,15 @@ class GemmaTokenizer:
             parts = self._added_re.split(text)
         else:
             parts = [text]
+        first = True
         for part in parts:
             if not part:
                 continue
             if part in self.added_tokens:
                 ids.append(self.added_tokens[part])
             else:
-                ids.extend(self._encode_chunk(part))
+                ids.extend(self._encode_chunk(part, first=first))
+                first = False
         return ids
 
     def decode(self, ids: List[int], skip_special: bool = True) -> str:

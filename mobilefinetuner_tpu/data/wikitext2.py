@@ -91,7 +91,6 @@ class WikiText2Dataset:
                                      mode="r")
             total = int(meta.get("count", len(self._tokens)))
             self._total_tokens = min(total, len(self._tokens))
-            self._lines = None
         else:
             file = resolve_split_file(path, split)
             self._file = file
@@ -108,8 +107,7 @@ class WikiText2Dataset:
                         ids.append(eos_id)
                 self._tokens = np.asarray(ids, dtype=np.int32)
                 self._total_tokens = len(ids)
-                self._lines = None
-
+    
         if config.data_fraction < 1.0:
             self._total_tokens = max(
                 int(self._total_tokens * config.data_fraction),

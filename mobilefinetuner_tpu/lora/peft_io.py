@@ -40,9 +40,13 @@ GEMMA_PEFT_MODULES.update({
     t: "model.layers.{}.mlp." + t for t in
     ("gate_proj", "up_proj", "down_proj")
 })
-PEFT_TARGET_MODULES = {  # for adapter_config.json target_modules
-    "attn_qkv": "c_attn", "attn_proj": "c_proj", "mlp_fc_in": "c_fc",
-    "mlp_fc_out": "c_proj",
+# For adapter_config.json target_modules. PEFT suffix-matches these against
+# full module paths, so they must be path-qualified: a bare "c_proj" would
+# match BOTH attn.c_proj and mlp.c_proj and make PEFT instantiate phantom
+# adapters the safetensors has no weights for.
+PEFT_TARGET_MODULES = {
+    "attn_qkv": "attn.c_attn", "attn_proj": "attn.c_proj",
+    "mlp_fc_in": "mlp.c_fc", "mlp_fc_out": "mlp.c_proj",
 }
 
 

@@ -45,7 +45,6 @@ def init_state(params, config: AdamConfig,
     non-trainable leaves get zero-size placeholders (no HBM for frozen
     params — the state-partitioning dimension of ZeRO, SURVEY.md §2.11)."""
     if mask is None:
-        z = lambda p, m=None: jnp.zeros_like(p)
         zeros = jax.tree.map(jnp.zeros_like, params)
         mk = lambda: jax.tree.map(jnp.zeros_like, params)
     else:

@@ -217,6 +217,10 @@ class GemmaTokenizer:
             parts = self._added_re.split(text)
         else:
             parts = [text]
+        # HF Metaspace prepend_scheme="first": the space marker is prepended
+        # only to a part at offset 0 of the original string — a part that
+        # follows a special token is NOT "first" (verified vs HF tokenizers:
+        # "<bos>user" -> [bos, "user"], not [bos, "▁user"]).
         first = True
         for part in parts:
             if not part:
@@ -225,7 +229,7 @@ class GemmaTokenizer:
                 ids.append(self.added_tokens[part])
             else:
                 ids.extend(self._encode_chunk(part, first=first))
-                first = False
+            first = False
         return ids
 
     def decode(self, ids: List[int], skip_special: bool = True) -> str:

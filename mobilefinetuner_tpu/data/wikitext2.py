@@ -107,7 +107,7 @@ class WikiText2Dataset:
                         ids.append(eos_id)
                 self._tokens = np.asarray(ids, dtype=np.int32)
                 self._total_tokens = len(ids)
-    
+
         if config.data_fraction < 1.0:
             self._total_tokens = max(
                 int(self._total_tokens * config.data_fraction),

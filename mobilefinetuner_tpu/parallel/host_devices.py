@@ -1,0 +1,44 @@
+"""Force a virtual N-device CPU platform (test/dryrun harness).
+
+The analog of the reference's mocked-telemetry testing culture (SURVEY.md
+§4.6): FSDP/mesh code paths must run without a TPU pod. Shared by
+tests/conftest.py and __graft_entry__.dryrun_multichip so the two subtle
+workarounds below live in exactly one place:
+
+  - XLA reads --xla_force_host_platform_device_count from XLA_FLAGS at
+    backend init; an existing entry with a DIFFERENT value must be rewritten,
+    not just detected by substring.
+  - The TPU plugin may pin jax_platforms programmatically at interpreter
+    start, shadowing the JAX_PLATFORMS env var; forcing CPU requires
+    jax.config.update BEFORE any backend init (best-effort after).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_host_devices(n: int) -> None:
+    """Arrange for jax to expose >= n virtual CPU devices.
+
+    Must run before the first jax backend initialization to take full
+    effect; afterwards it is best-effort (config update may raise if the
+    backend is live — swallowed, callers assert on jax.devices()).
+    """
+    flag = "--xla_force_host_platform_device_count"
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(flag + r"=(\d+)", flags)
+    if m:
+        if int(m.group(1)) < n:
+            flags = re.sub(flag + r"=\d+", f"{flag}={n}", flags)
+    else:
+        flags = (flags + f" {flag}={n}").strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized; use whatever devices exist

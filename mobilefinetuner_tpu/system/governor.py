@@ -1,0 +1,163 @@
+"""Step-pacing governor: deterministic throttle / duty-cycle control for the
+training loop.
+
+TPU-native analog of the reference's energy-aware PowerMonitor
+(reference: operators/opt_ops/energy/power_monitor.{h,cpp}): every
+`check_interval_steps` steps, telemetry (battery %, temperature °C) maps to a
+target step frequency, and the trainer sleeps `suggest_sleep_ms(step)` between
+optimizer steps:
+
+  f_batt = freq_batt_low  if battery < battery_threshold else freq_batt_high
+  f_temp = freq_temp_low  if temp    > temp_threshold    else freq_temp_high
+  f      = min(f_batt, f_temp);  sleep_ms = 1000 / f, clamped to 5000
+  (power_monitor.cpp:72-96)
+
+A deterministic override schedule string "0-99:300,100-199:150,200-:50"
+(step-range -> sleep_ms) takes precedence over telemetry
+(power_monitor.cpp:28-70). Telemetry can be injected manually for platforms
+without sensors (power_monitor.h:47-48) — on a TPU host there is no battery,
+so manual injection / schedule mode is the normal use; the governor is a
+duty-cycle knob for shared-host politeness and for reproducing the
+reference's energy benchmarks (scripts/benchmark/test_energy_function.sh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import time
+from typing import Callable, List, Optional
+
+MAX_SLEEP_MS = 5000.0  # clamp, power_monitor.cpp:92
+
+
+@dataclasses.dataclass
+class StepSleep:
+    """One parsed schedule range: steps in [start, end] sleep `sleep_ms`.
+    end=None means open-ended ("200-:50")."""
+    start: int
+    end: Optional[int]
+    sleep_ms: float
+
+    def covers(self, step: int) -> bool:
+        return step >= self.start and (self.end is None or step <= self.end)
+
+
+def parse_schedule(spec: str) -> List[StepSleep]:
+    """Parse "0-99:300,100-199:150,200-:50" (power_monitor.cpp:28-70).
+
+    Each entry is "<start>-<end>:<ms>" or "<start>-:<ms>" (open-ended).
+    A bare "<step>:<ms>" pins a single step. Whitespace tolerated.
+    """
+    out: List[StepSleep] = []
+    if not spec:
+        return out
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        m = re.fullmatch(r"(\d+)\s*-\s*(\d*)\s*:\s*(\d+(?:\.\d+)?)", part)
+        if m:
+            start, end_s, ms = m.group(1), m.group(2), m.group(3)
+            out.append(StepSleep(int(start),
+                                 int(end_s) if end_s else None, float(ms)))
+            continue
+        m = re.fullmatch(r"(\d+)\s*:\s*(\d+(?:\.\d+)?)", part)
+        if m:
+            s = int(m.group(1))
+            out.append(StepSleep(s, s, float(m.group(2))))
+            continue
+        raise ValueError(f"bad schedule entry: {part!r}")
+    return out
+
+
+@dataclasses.dataclass
+class GovernorConfig:
+    """Mirror of the reference PowerConfig (power_monitor.h:20-35)."""
+    enable: bool = False
+    check_interval_steps: int = 10
+    battery_threshold: float = 20.0   # percent
+    temp_threshold: float = 40.0      # celsius
+    freq_batt_high: float = 10.0      # steps/sec when battery healthy
+    freq_batt_low: float = 1.0        # steps/sec when battery low
+    freq_temp_high: float = 10.0
+    freq_temp_low: float = 0.5
+    schedule: str = ""                # deterministic override
+    manual_battery: Optional[float] = None
+    manual_temp: Optional[float] = None
+
+
+class StepGovernor:
+    """suggest_sleep_ms(step) -> ms to sleep after this optimizer step.
+
+    Telemetry readers default to the manual injections in the config; a real
+    platform can pass `battery_fn` / `temp_fn` callables.
+    """
+
+    def __init__(self, config: GovernorConfig,
+                 battery_fn: Optional[Callable[[], float]] = None,
+                 temp_fn: Optional[Callable[[], float]] = None):
+        self.config = config
+        self._schedule = parse_schedule(config.schedule)
+        self._battery_fn = battery_fn
+        self._temp_fn = temp_fn
+        self._cached_sleep_ms = 0.0
+        self._last_check_step: Optional[int] = None
+
+    # -- telemetry ----------------------------------------------------------
+    def set_manual_telemetry(self, battery: Optional[float] = None,
+                             temp: Optional[float] = None):
+        """Manual injection (power_monitor.h:47-48)."""
+        if battery is not None:
+            self.config.manual_battery = battery
+        if temp is not None:
+            self.config.manual_temp = temp
+        self._last_check_step = None  # force re-evaluation next step
+
+    def _read_battery(self) -> Optional[float]:
+        if self.config.manual_battery is not None:
+            return self.config.manual_battery
+        return self._battery_fn() if self._battery_fn else None
+
+    def _read_temp(self) -> Optional[float]:
+        if self.config.manual_temp is not None:
+            return self.config.manual_temp
+        return self._temp_fn() if self._temp_fn else None
+
+    # -- policy -------------------------------------------------------------
+    def _telemetry_sleep_ms(self) -> float:
+        c = self.config
+        battery, temp = self._read_battery(), self._read_temp()
+        f_batt = (c.freq_batt_low if (battery is not None
+                                      and battery < c.battery_threshold)
+                  else c.freq_batt_high)
+        f_temp = (c.freq_temp_low if (temp is not None
+                                      and temp > c.temp_threshold)
+                  else c.freq_temp_high)
+        f = min(f_batt, f_temp)
+        if f <= 0:
+            return MAX_SLEEP_MS
+        return min(1000.0 / f, MAX_SLEEP_MS)
+
+    def suggest_sleep_ms(self, step: int) -> float:
+        if not self.config.enable:
+            return 0.0
+        for rng in self._schedule:  # schedule overrides telemetry
+            if rng.covers(step):
+                return min(rng.sleep_ms, MAX_SLEEP_MS)
+        if self._schedule:
+            return 0.0  # explicit schedule, step uncovered -> full speed
+        k = max(self.config.check_interval_steps, 1)
+        if (self._last_check_step is None
+                or step - self._last_check_step >= k):
+            self._cached_sleep_ms = self._telemetry_sleep_ms()
+            self._last_check_step = step
+        return self._cached_sleep_ms
+
+    def throttle(self, step: int):
+        """Sleep per policy (trainer call site; gemma_trainer.cpp loop,
+        gpt2_lora_finetune/main.cpp:679-683)."""
+        ms = self.suggest_sleep_ms(step)
+        if ms > 0:
+            time.sleep(ms / 1000.0)
+        return ms

@@ -1,21 +1,12 @@
 """Test harness setup: force an 8-device virtual CPU platform so mesh/FSDP
 code paths run without a TPU pod (the analog of the reference's mocked
-telemetry testing culture, SURVEY.md §4.6).
+telemetry testing culture, SURVEY.md §4.6). The subtle platform-forcing
+recipe lives in parallel/host_devices.py, shared with __graft_entry__."""
 
-Note: the TPU plugin may set jax_platforms programmatically at interpreter
-start (shadowing the JAX_PLATFORMS env var), so we force cpu through
-jax.config — env vars alone are not enough.
-"""
+from mobilefinetuner_tpu.parallel.host_devices import force_host_devices
 
-import os
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+force_host_devices(8)
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")

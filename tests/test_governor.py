@@ -1,0 +1,109 @@
+"""Step governor tests — mirror the reference's energy-function shell tests
+(reference: scripts/benchmark/test_energy_function.sh: schedule parsing and
+throttle behavior driven by --pm_manual_batt/--pm_manual_temp mocked
+telemetry)."""
+
+import pytest
+
+from mobilefinetuner_tpu.system.governor import (GovernorConfig, MAX_SLEEP_MS,
+                                                 StepGovernor, StepSleep,
+                                                 parse_schedule)
+
+
+def test_parse_schedule_ranges():
+    s = parse_schedule("0-99:300,100-199:150,200-:50")
+    assert s == [StepSleep(0, 99, 300.0), StepSleep(100, 199, 150.0),
+                 StepSleep(200, None, 50.0)]
+
+
+def test_parse_schedule_single_step_and_whitespace():
+    s = parse_schedule(" 5 : 25 , 10 - 20 : 75 ")
+    assert s == [StepSleep(5, 5, 25.0), StepSleep(10, 20, 75.0)]
+
+
+def test_parse_schedule_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_schedule("abc:10")
+    assert parse_schedule("") == []
+
+
+def test_disabled_governor_never_sleeps():
+    gov = StepGovernor(GovernorConfig(enable=False, schedule="0-:1000"))
+    assert gov.suggest_sleep_ms(0) == 0.0
+
+
+def test_schedule_overrides_telemetry():
+    cfg = GovernorConfig(enable=True, schedule="0-99:300,100-199:150,200-:50",
+                         manual_battery=5.0, manual_temp=90.0)
+    gov = StepGovernor(cfg)
+    assert gov.suggest_sleep_ms(0) == 300.0
+    assert gov.suggest_sleep_ms(99) == 300.0
+    assert gov.suggest_sleep_ms(100) == 150.0
+    assert gov.suggest_sleep_ms(250) == 50.0
+
+
+def test_telemetry_policy_healthy_fast():
+    cfg = GovernorConfig(enable=True, manual_battery=80.0, manual_temp=30.0,
+                         freq_batt_high=10.0, freq_temp_high=10.0)
+    gov = StepGovernor(cfg)
+    assert gov.suggest_sleep_ms(0) == pytest.approx(100.0)  # 1000/10
+
+
+def test_telemetry_low_battery_throttles():
+    cfg = GovernorConfig(enable=True, manual_battery=10.0, manual_temp=30.0,
+                         battery_threshold=20.0, freq_batt_low=1.0)
+    gov = StepGovernor(cfg)
+    assert gov.suggest_sleep_ms(0) == pytest.approx(1000.0)
+
+
+def test_telemetry_hot_takes_min_frequency():
+    # battery fine (f=10), temp hot (f=0.5) -> min wins -> 2000 ms
+    cfg = GovernorConfig(enable=True, manual_battery=80.0, manual_temp=55.0,
+                         temp_threshold=40.0, freq_temp_low=0.5)
+    gov = StepGovernor(cfg)
+    assert gov.suggest_sleep_ms(0) == pytest.approx(2000.0)
+
+
+def test_sleep_clamped_to_max():
+    cfg = GovernorConfig(enable=True, manual_temp=99.0, freq_temp_low=0.01)
+    gov = StepGovernor(cfg)
+    assert gov.suggest_sleep_ms(0) == MAX_SLEEP_MS
+    gov2 = StepGovernor(GovernorConfig(enable=True, schedule="0-:99999"))
+    assert gov2.suggest_sleep_ms(0) == MAX_SLEEP_MS
+
+
+def test_check_interval_caches_between_checks():
+    """Telemetry is only re-read every check_interval_steps
+    (power_monitor.cpp:72-96)."""
+    reads = []
+
+    def batt():
+        reads.append(1)
+        return 80.0
+
+    cfg = GovernorConfig(enable=True, check_interval_steps=10)
+    gov = StepGovernor(cfg, battery_fn=batt)
+    for step in range(10):
+        gov.suggest_sleep_ms(step)
+    assert len(reads) == 1
+    gov.suggest_sleep_ms(10)
+    assert len(reads) == 2
+
+
+def test_manual_injection_forces_recheck():
+    cfg = GovernorConfig(enable=True, check_interval_steps=100,
+                         manual_battery=80.0)
+    gov = StepGovernor(cfg)
+    fast = gov.suggest_sleep_ms(0)
+    gov.set_manual_telemetry(battery=5.0)
+    slow = gov.suggest_sleep_ms(1)
+    assert slow > fast
+
+
+def test_throttle_sleeps(monkeypatch):
+    slept = []
+    import mobilefinetuner_tpu.system.governor as G
+    monkeypatch.setattr(G.time, "sleep", lambda s: slept.append(s))
+    gov = StepGovernor(GovernorConfig(enable=True, schedule="0-:100"))
+    gov.throttle(0)
+    assert slept == [pytest.approx(0.1)]

@@ -1,0 +1,129 @@
+"""Host-offload placement tests — analog of the reference sharder tests
+(reference: opt_ops/sharding/test_parameter_sharder.cpp
+register->offload->reload->verify round trip; test_sharder_strict.cpp strict
+budget adherence), on the TPU memory hierarchy (HBM vs pinned host RAM)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mobilefinetuner_tpu.parallel.mesh import (make_mesh, params_shardings,
+                                               replicated_sharding)
+from mobilefinetuner_tpu.parallel.offload import (HOST, OffloadConfig,
+                                                  apply_placement, fetch,
+                                                  placement_stats,
+                                                  plan_placement)
+
+
+def tree(sizes):
+    return {f"p{i}": jnp.arange(n, dtype=jnp.float32)
+            for i, n in enumerate(sizes)}
+
+
+def test_disabled_plan_keeps_everything_resident():
+    t = tree([100, 200])
+    plan = plan_placement(t, OffloadConfig(enable=False))
+    assert not any(jax.tree.leaves(plan))
+
+
+def test_budget_spills_largest_first():
+    # 4 params of 4KiB/8KiB/16KiB/32KiB floats; budget 24KiB ->
+    # offload the 32KiB then the 16KiB leaf.
+    t = tree([1024, 2048, 4096, 8192])
+    cfg = OffloadConfig(enable=True, max_resident_bytes=24 * 1024,
+                        min_offload_size=1024)
+    plan = plan_placement(t, cfg)
+    assert plan == {"p0": False, "p1": False, "p2": True, "p3": True}
+    stats = placement_stats(t, plan, cfg)
+    assert stats["resident_bytes"] == (1024 + 2048) * 4
+    assert stats["n_offloaded"] == 2
+
+
+def test_strict_budget_zero_streams_everything():
+    """Strict budget adherence (test_sharder_strict.cpp analog): budget 0
+    offloads every leaf above min_offload_size."""
+    t = tree([1024, 8192])
+    cfg = OffloadConfig(enable=True, max_resident_bytes=0,
+                        min_offload_size=256)
+    plan = plan_placement(t, cfg)
+    assert plan == {"p0": True, "p1": True}
+
+
+def test_tiny_params_never_offloaded():
+    t = tree([8, 16, 8192])
+    cfg = OffloadConfig(enable=True, max_resident_bytes=0,
+                        min_offload_size=1024)
+    plan = plan_placement(t, cfg)
+    assert plan["p0"] is False and plan["p1"] is False
+
+
+def test_round_trip_values_preserved_f32():
+    t = tree([4096, 512])
+    cfg = OffloadConfig(enable=True, max_resident_bytes=1024,
+                        offload_dtype="float32", min_offload_size=256)
+    plan = plan_placement(t, cfg)
+    sh = replicated_sharding(make_mesh(1, 1, devices=jax.devices()[:1]))
+    placed = apply_placement(t, plan, sh, cfg)
+    # offloaded leaves actually live in host memory
+    for x, off in zip(jax.tree.leaves(placed), jax.tree.leaves(plan)):
+        if off:
+            assert x.sharding.memory_kind == HOST
+    back = fetch(placed, plan, sh)
+    for k in t:
+        np.testing.assert_array_equal(np.asarray(back[k]), np.asarray(t[k]))
+        assert back[k].sharding.memory_kind != HOST
+
+
+def test_bf16_offload_quantizes():
+    """offload_dtype=bfloat16 is the analog of the reference's
+    quantize_fp16_on_disk (parameter_sharder.cpp:215-232): storage is
+    16-bit, values round to bf16 precision."""
+    x = jnp.asarray([1.0, 1e-3, 12345.678], jnp.float32)
+    t = {"w": jnp.tile(x, 2048)}
+    cfg = OffloadConfig(enable=True, max_resident_bytes=0,
+                        offload_dtype="bfloat16", min_offload_size=16)
+    plan = plan_placement(t, cfg)
+    assert plan["w"]
+    sh = replicated_sharding(make_mesh(1, 1, devices=jax.devices()[:1]))
+    placed = apply_placement(t, plan, sh, cfg)
+    assert placed["w"].dtype == jnp.bfloat16
+    back = fetch(placed, plan, sh, compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(back["w"]), np.asarray(t["w"]),
+                               rtol=1e-2)
+
+
+def test_fetch_inside_jit_computes():
+    """The require()-analog works under jit: a host-resident param feeds a
+    compiled matmul (the H2D move is part of the XLA program)."""
+    t = {"w": jnp.ones((64, 64), jnp.float32)}
+    cfg = OffloadConfig(enable=True, max_resident_bytes=0,
+                        offload_dtype="float32", min_offload_size=16)
+    plan = plan_placement(t, cfg)
+    sh = replicated_sharding(make_mesh(1, 1, devices=jax.devices()[:1]))
+    placed = apply_placement(t, plan, sh, cfg)
+
+    @jax.jit
+    def f(p, x):
+        p = fetch(p, plan, sh)
+        return x @ p["w"]
+
+    out = f(placed, jnp.ones((2, 64)))
+    np.testing.assert_allclose(np.asarray(out), 64.0)
+
+
+def test_offload_composes_with_fsdp_mesh():
+    """A param can be FSDP-sharded across chips AND host-offloaded: the
+    partition spec survives with_memory_kind."""
+    mesh = make_mesh(data=1, fsdp=4, devices=jax.devices()[:4])
+    t = {"w": jnp.ones((256, 64), jnp.float32),
+         "b": jnp.ones((64,), jnp.float32)}
+    shardings = params_shardings(t, mesh, min_size=1024)
+    cfg = OffloadConfig(enable=True, max_resident_bytes=0,
+                        offload_dtype="float32", min_offload_size=1024)
+    plan = plan_placement(t, cfg)
+    placed = apply_placement(t, plan, shardings, cfg)
+    assert placed["w"].sharding.memory_kind == HOST
+    assert not placed["w"].sharding.is_fully_replicated  # still FSDP-sharded
+    back = fetch(placed, plan, shardings)
+    np.testing.assert_array_equal(np.asarray(back["w"]), np.ones((256, 64)))

@@ -114,6 +114,33 @@ def test_gemma_byte_fallback(gemma_file):
     assert ours.decode(got) == text.replace(" ", " ")
 
 
+def test_gemma_metaspace_first_after_special_token(tmp_path):
+    """Metaspace prepend_scheme='first' must NOT prepend the space marker to
+    text that follows a special token ('<bos>user' -> [bos, 'user'], not
+    [bos, '▁user']) — HF tokenizers parity."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+    from tokenizers.processors import TemplateProcessing  # noqa: F401
+    from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
+    tok = Tokenizer(models.BPE(unk_token="<unk>", byte_fallback=True))
+    tok.pre_tokenizer = pre_tokenizers.Metaspace(
+        replacement="▁", prepend_scheme="first")
+    byte_tokens = [f"<0x{b:02X}>" for b in range(256)]
+    trainer = trainers.BpeTrainer(
+        vocab_size=700,
+        special_tokens=["<pad>", "<eos>", "<bos>", "<unk>"] + byte_tokens,
+        show_progress=False)
+    tok.train_from_iterator(CORPUS, trainer)
+    path = str(tmp_path / "tokenizer.json")
+    tok.save(path)
+    oracle = Tokenizer.from_file(path)
+    ours = GemmaTokenizer(path)
+    for text in ["<bos>user", "hi<eos>there", "<bos> spaced", "plain text",
+                 "<bos><eos>tail"]:
+        expect = oracle.encode(text).ids
+        got = ours.encode(text, add_bos=False)
+        assert got == expect, (text, got, expect)
+
+
 def test_gemma_add_bos_and_special_ids(gemma_file):
     from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
     path, _ = gemma_file

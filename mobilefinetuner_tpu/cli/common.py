@@ -1,0 +1,363 @@
+"""Shared CLI machinery: flag groups mirroring the reference binaries'
+surfaces, governor/offload/mesh wiring, and the generic training loop driver.
+
+Reference flag surfaces: gpt2_lora_finetune/main.cpp:80-171 (CmdArgs
+defaults), train_lora_gemma.cpp parse block, eval_ppl.cpp, eval_mmlu.cpp.
+TPU-native additions beyond the reference: --dtype (bf16 compute), --remat
+(gradient checkpointing), --mesh_data/--mesh_fsdp (multi-chip mesh), and
+optimizer-state save/resume (the reference leaves Adam state unwired,
+SURVEY.md §5 Checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mobilefinetuner_tpu.core.logging import (JSONLWriter, MetricsLogger,
+                                              get_logger)
+from mobilefinetuner_tpu.data.wikitext2 import WT2Config, WikiText2Dataset
+from mobilefinetuner_tpu.ops.loss import perplexity_from_loss
+from mobilefinetuner_tpu.parallel import offload as offload_mod
+from mobilefinetuner_tpu.parallel.mesh import (batch_sharding, make_mesh,
+                                               params_shardings,
+                                               replicated_sharding,
+                                               shard_batch)
+from mobilefinetuner_tpu.parallel.offload import (OffloadConfig,
+                                                  apply_placement, fetch,
+                                                  placement_stats,
+                                                  plan_placement)
+from mobilefinetuner_tpu.system.governor import GovernorConfig, StepGovernor
+from mobilefinetuner_tpu.train.trainer import (TrainConfig, init_optimizer,
+                                               make_eval_step,
+                                               make_train_step)
+
+log = get_logger()
+
+
+# --------------------------- flag groups ------------------------------------
+
+def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
+                    seq_len: int = 128, batch_size: int = 1):
+    """Training hparams (gpt2_lora_finetune/main.cpp CmdArgs defaults)."""
+    g = p.add_argument_group("training")
+    g.add_argument("--epochs", type=int, default=0,
+                   help="epochs (overrides steps when > 0)")
+    g.add_argument("--steps", type=int, default=0, help="training steps")
+    g.add_argument("--batch_size", type=int, default=batch_size,
+                   help="micro-batch size per accumulation step")
+    g.add_argument("--grad_accum_steps", "--grad_accum", type=int, default=1)
+    g.add_argument("--seq_len", type=int, default=seq_len)
+    g.add_argument("--lr", type=float, default=lr)
+    g.add_argument("--weight_decay", type=float, default=0.0)
+    g.add_argument("--warmup_steps", type=int, default=0)
+    g.add_argument("--warmup_ratio", type=float, default=None,
+                   help="overrides warmup_steps when set")
+    g.add_argument("--clip_grad_norm", "--max_grad_norm", type=float,
+                   default=1.0)
+    g.add_argument("--lr_schedule", choices=["cosine", "linear", "constant"],
+                   default="cosine")
+    g.add_argument("--data_fraction", type=float, default=1.0)
+    g.add_argument("--log_interval", type=int, default=1)
+    g.add_argument("--eval_interval", type=int, default=0)
+    g.add_argument("--eval_batches", type=int, default=50)
+    g.add_argument("--eval_batch_size", type=int, default=2)
+    g.add_argument("--save_every", type=int, default=0)
+    g.add_argument("--ema_beta", type=float, default=0.9)
+    g.add_argument("--seed", type=int, default=42)
+    g.add_argument("--coupled_weight_decay", action="store_true",
+                   help="L2-into-gradient decay for reference parity "
+                        "(adam.cpp:65-67); default is decoupled AdamW")
+    g.add_argument("--metrics_csv", default="",
+                   help="CSV metrics sink (logger.h:131-190 analog)")
+    # TPU-native knobs
+    g.add_argument("--dtype", choices=["float32", "bfloat16"],
+                   default="float32", help="compute dtype")
+    g.add_argument("--remat", action="store_true",
+                   help="gradient checkpointing over the layer scan")
+
+
+def add_pm_flags(p: argparse.ArgumentParser):
+    """Energy-governor flags (CmdArgs pm_* block; pm_interval=0 disables)."""
+    g = p.add_argument_group("step governor (pm_*)")
+    g.add_argument("--pm_interval", type=int, default=0,
+                   help="telemetry check every K steps; 0 disables")
+    g.add_argument("--pm_batt_thresh", type=float, default=20.0)
+    g.add_argument("--pm_temp_thresh", type=float, default=42.0)
+    g.add_argument("--pm_fb_high", type=float, default=2.0)
+    g.add_argument("--pm_fb_low", type=float, default=0.5)
+    g.add_argument("--pm_ft_high", type=float, default=2.0)
+    g.add_argument("--pm_ft_low", type=float, default=0.5)
+    g.add_argument("--pm_manual_batt", type=float, default=100.0)
+    g.add_argument("--pm_manual_temp", type=float, default=30.0)
+    g.add_argument("--pm_disable_batt", action="store_true")
+    g.add_argument("--pm_disable_temp", action="store_true")
+    g.add_argument("--pm_schedule", default="",
+                   help='deterministic override, e.g. "0-99:300,100-:50"')
+
+
+def add_shard_flags(p: argparse.ArgumentParser):
+    """Offload flags (CmdArgs shard_* block). --shard_dir is accepted for
+    reference-CLI compatibility but unused: the offload tier is pinned host
+    RAM, not disk (parallel/offload.py)."""
+    g = p.add_argument_group("parameter offload (shard_*)")
+    g.add_argument("--shard_enable", action="store_true")
+    g.add_argument("--shard_dir", default="",
+                   help="ignored (offload targets host RAM, not disk)")
+    g.add_argument("--shard_budget_mb", type=int, default=512,
+                   help="HBM budget for resident frozen params")
+    g.add_argument("--shard_fp16_disk", type=int, default=1,
+                   help="1 = store offloaded params as bf16 (TPU-idiomatic "
+                        "16-bit; analog of fp16-on-disk quantization)")
+
+
+def add_mesh_flags(p: argparse.ArgumentParser):
+    g = p.add_argument_group("device mesh")
+    g.add_argument("--mesh_data", type=int, default=1,
+                   help="data-parallel mesh axis size")
+    g.add_argument("--mesh_fsdp", type=int, default=1,
+                   help="fsdp mesh axis size; 0 = all remaining devices "
+                        "(default 1 = single chip, like the reference; "
+                        "multi-chip is opt-in)")
+
+
+def governor_from_args(args) -> StepGovernor:
+    cfg = GovernorConfig(
+        enable=args.pm_interval > 0 or bool(args.pm_schedule),
+        check_interval_steps=max(args.pm_interval, 1),
+        battery_threshold=args.pm_batt_thresh,
+        temp_threshold=args.pm_temp_thresh,
+        freq_batt_high=args.pm_fb_high,
+        freq_batt_low=args.pm_fb_low,
+        freq_temp_high=args.pm_ft_high,
+        freq_temp_low=args.pm_ft_low,
+        schedule=args.pm_schedule,
+        manual_battery=None if args.pm_disable_batt else args.pm_manual_batt,
+        manual_temp=None if args.pm_disable_temp else args.pm_manual_temp,
+    )
+    return StepGovernor(cfg)
+
+
+def offload_config_from_args(args) -> OffloadConfig:
+    return OffloadConfig(
+        enable=bool(args.shard_enable),
+        max_resident_bytes=args.shard_budget_mb * 1024 * 1024,
+        offload_dtype="bfloat16" if args.shard_fp16_disk else "float32")
+
+
+def build_mesh(args):
+    n = len(jax.devices())
+    fsdp = args.mesh_fsdp or (n // max(args.mesh_data, 1))
+    mesh = make_mesh(data=args.mesh_data, fsdp=fsdp,
+                     devices=jax.devices()[:args.mesh_data * fsdp])
+    if args.mesh_data * fsdp > 1:
+        log.info(f"mesh: data={args.mesh_data} fsdp={fsdp}")
+        if args.batch_size % (args.mesh_data * fsdp) != 0:
+            raise SystemExit(
+                f"batch_size={args.batch_size} (the micro-batch) must be "
+                f"divisible by the mesh size {args.mesh_data * fsdp}")
+    return mesh
+
+
+# --------------------------- loop helpers -----------------------------------
+
+def resolve_total_steps(args, steps_per_epoch: int) -> int:
+    """epochs overrides steps (reference CmdArgs semantics)."""
+    if args.epochs > 0:
+        return max(args.epochs * steps_per_epoch, 1)
+    if args.steps > 0:
+        return args.steps
+    return max(steps_per_epoch, 1)  # default: one epoch
+
+
+def train_config_from_args(args, total_steps: int) -> TrainConfig:
+    if args.warmup_ratio is not None:
+        warmup_ratio = args.warmup_ratio
+    else:
+        warmup_ratio = args.warmup_steps / max(total_steps, 1)
+    return TrainConfig(
+        total_steps=total_steps, lr=args.lr, warmup_ratio=warmup_ratio,
+        schedule=args.lr_schedule, clip_grad_norm=args.clip_grad_norm,
+        grad_accum_steps=args.grad_accum_steps,
+        weight_decay=args.weight_decay,
+        coupled_weight_decay=args.coupled_weight_decay)
+
+
+def micro_batches(dataset: WikiText2Dataset, accum: int) -> Iterator[tuple]:
+    """Yield (epoch, [accum*micro_b, ...] step batch) forever, cycling
+    epochs (the reference's per-step micro-batch pulls, main.cpp:569-583)."""
+    epoch = 0
+    pending = []
+    while True:
+        for b in dataset.epoch(epoch):
+            pending.append(b)
+            if len(pending) == accum:
+                yield epoch, {k: np.concatenate([p[k] for p in pending])
+                              for k in pending[0]}
+                pending = []
+        epoch += 1
+
+
+def evaluate(eval_step, trainable, frozen, dataset: WikiText2Dataset,
+             max_batches: int) -> dict:
+    """Token-weighted mean NLL over the split -> {loss, ppl, tokens}
+    (eval_ppl.cpp:157-200 semantics), under the no-grad eval step."""
+    total, count, n = 0.0, 0, 0
+    for b in dataset.epoch(0):
+        s, c = eval_step(trainable, frozen, b)
+        total += float(s)
+        count += int(c)
+        n += 1
+        if max_batches and n >= max_batches:
+            break
+    mean = total / max(count, 1)
+    return {"loss": mean, "ppl": perplexity_from_loss(mean),
+            "tokens": count, "batches": n}
+
+
+class EMA:
+    """EMA-smoothed loss (CmdArgs ema_beta, default 0.9)."""
+
+    def __init__(self, beta: float):
+        self.beta = beta
+        self.value: Optional[float] = None
+
+    def update(self, x: float) -> float:
+        self.value = x if self.value is None else \
+            self.beta * self.value + (1 - self.beta) * x
+        return self.value
+
+
+def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
+                 train_ds: WikiText2Dataset,
+                 valid_ds: Optional[WikiText2Dataset],
+                 total_steps: int, tc: TrainConfig,
+                 mask=None, start_step: int = 0, opt_state=None,
+                 save_hook: Optional[Callable] = None,
+                 mesh=None, replicate_trainable: bool = True,
+                 dropout_rng=None):
+    """The shared optimizer-step loop: compiled step + eval cadence + EMA +
+    metrics CSV + JSONL eval records + governor throttle + periodic saves.
+
+    save_hook(step, trainable, opt_state, final) persists checkpoints.
+    dropout_rng: base PRNG key; when set, a fresh per-sample key array
+    folded with the step index rides in batch["dropout_rng"], so dropout
+    masks differ across steps AND micro-batches (a fixed closure key would
+    silently reuse one mask for the whole run).
+    Returns (trainable, opt_state, last_metrics).
+    """
+    governor = governor_from_args(args)
+    metrics_csv = MetricsLogger(args.metrics_csv) if args.metrics_csv \
+        else None
+    eval_jsonl = JSONLWriter(args.eval_out) if getattr(args, "eval_out", "") \
+        else None
+
+    step_fn = make_train_step(loss_fn, tc, mask=mask, donate=True)
+    eval_step = make_eval_step(nll_fn)
+    if opt_state is None:
+        opt_state = init_optimizer(trainable, tc, mask)
+
+    if mesh is not None and replicate_trainable:
+        # LoRA-style tiny trainables: replicate A/B + Adam state; FSDP'd
+        # trainables (full FT) arrive pre-placed and are left alone.
+        repl = replicated_sharding(mesh)
+        trainable = jax.device_put(
+            trainable, jax.tree.map(lambda _: repl, trainable))
+        opt_state = jax.device_put(
+            opt_state, jax.tree.map(lambda _: repl, opt_state))
+
+    ema = EMA(args.ema_beta)
+    batches = micro_batches(train_ds, tc.grad_accum_steps)
+    t_start = time.time()
+    metrics = {}
+    epoch = 0
+    for step in range(start_step, total_steps):
+        t0 = time.perf_counter()
+        epoch, batch = next(batches)
+        if dropout_rng is not None:
+            n = batch["input_ids"].shape[0]
+            batch["dropout_rng"] = jax.random.split(
+                jax.random.fold_in(dropout_rng, step), n)
+        if mesh is not None:
+            batch = shard_batch(batch, mesh)
+        trainable, opt_state, metrics = step_fn(
+            trainable, frozen, opt_state, batch, jnp.int32(step))
+        loss = float(metrics["loss"])  # host sync point
+        dt_ms = (time.perf_counter() - t0) * 1000
+        avg = ema.update(loss)
+
+        if args.log_interval and (step + 1) % args.log_interval == 0:
+            toks = batch["input_ids"].shape[0] * batch["input_ids"].shape[1]
+            log.info(
+                f"step {step + 1}/{total_steps} loss={loss:.4f} "
+                f"ema={avg:.4f} ppl={perplexity_from_loss(loss):.2f} "
+                f"grad_norm={float(metrics['grad_norm']):.3f} "
+                f"lr={float(metrics['lr']):.2e} "
+                f"{toks / (dt_ms / 1000):.0f} tok/s")
+        if metrics_csv:
+            metrics_csv.log(epoch=epoch, step=step + 1, loss=loss,
+                            avg_loss=avg, lr=float(metrics["lr"]),
+                            step_time_ms=dt_ms)
+
+        if (args.eval_interval and valid_ds is not None
+                and (step + 1) % args.eval_interval == 0):
+            ev = evaluate(eval_step, trainable, frozen, valid_ds,
+                          args.eval_batches)
+            log.info(f"eval @ step {step + 1}: loss={ev['loss']:.4f} "
+                     f"ppl={ev['ppl']:.2f} ({ev['tokens']} tokens)")
+            if eval_jsonl:
+                eval_jsonl.write({"type": "eval", "step": step + 1,
+                                  "loss": ev["loss"], "ppl": ev["ppl"],
+                                  "tokens": ev["tokens"],
+                                  "time": time.time() - t_start})
+
+        if args.save_every and save_hook and (step + 1) % args.save_every \
+                == 0 and (step + 1) < total_steps:
+            save_hook(step + 1, trainable, opt_state, final=False)
+
+        governor.throttle(step)
+
+    if valid_ds is not None and args.eval_interval:
+        ev = evaluate(eval_step, trainable, frozen, valid_ds,
+                      args.eval_batches)
+        log.info(f"final eval: loss={ev['loss']:.4f} ppl={ev['ppl']:.2f}")
+        if eval_jsonl:
+            eval_jsonl.write({"type": "final_eval", "step": total_steps,
+                              "loss": ev["loss"], "ppl": ev["ppl"],
+                              "tokens": ev["tokens"]})
+    if save_hook:
+        save_hook(total_steps, trainable, opt_state, final=True)
+    if metrics_csv:
+        metrics_csv.close()
+    return trainable, opt_state, metrics
+
+
+def setup_frozen_params(args, params, mesh):
+    """Place frozen base params: FSDP shardings + optional host offload.
+    Returns (placed_params, fetch_fn) where fetch_fn is applied inside the
+    jitted loss to pull offloaded leaves back to device memory."""
+    shardings = params_shardings(params, mesh)
+    ocfg = offload_config_from_args(args)
+    plan = plan_placement(params, ocfg)
+    placed = apply_placement(params, plan, shardings, ocfg)
+    if ocfg.enable:
+        stats = placement_stats(params, plan, ocfg)
+        log.info(
+            f"offload: {stats['n_offloaded']} params "
+            f"({stats['offloaded_bytes'] / 2**20:.0f} MB) -> host RAM, "
+            f"{stats['resident_bytes'] / 2**20:.0f} MB resident "
+            f"(budget {args.shard_budget_mb} MB)")
+    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" \
+        else jnp.float32
+
+    def fetch_fn(p):
+        return fetch(p, plan, shardings, compute_dtype=None)
+
+    return placed, fetch_fn

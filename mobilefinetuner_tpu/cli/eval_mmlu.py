@@ -1,0 +1,136 @@
+"""MMLU evaluation CLI.
+
+TPU-native rebuild of the reference `eval_mmlu` binary
+(reference: gpt2_lora_finetune/eval_mmlu.cpp + mmlu/mmlu_runner.{h,cpp}):
+load GPT-2 (+ optional merged adapter), evaluate 4-choice accuracy with
+k-shot prompts, report per-subject + macro/micro.
+
+Variable-length prompts vs XLA's static shapes: prompts are right-padded to
+power-of-two length buckets, so the whole eval compiles a handful of
+programs instead of one per length. The last REAL token's logits are
+selected by index (padding never shifts the prediction).
+
+Usage:
+  python -m mobilefinetuner_tpu.cli.eval_mmlu \
+      --pretrained_dir /path/gpt2 --mmlu_root /path/mmlu --split test \
+      [--fewshot 5] [--lora_path adapter.safetensors --lora_merge]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mobilefinetuner_tpu.core.logging import JSONLWriter, get_logger
+from mobilefinetuner_tpu.data.tokenizer_bpe import GPT2BPETokenizer
+from mobilefinetuner_tpu.eval import mmlu
+from mobilefinetuner_tpu.io.checkpoints import load_gpt2
+from mobilefinetuner_tpu.lora import peft_io
+from mobilefinetuner_tpu.lora.lora import merge_gpt2
+from mobilefinetuner_tpu.models import gpt2
+
+log = get_logger()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="eval_mmlu", description="MMLU 4-choice accuracy (TPU)")
+    p.add_argument("--pretrained_dir", required=True)
+    p.add_argument("--mmlu_root", required=True,
+                   help="dir containing <split>/ with per-subject CSVs")
+    p.add_argument("--split", default="test")
+    p.add_argument("--fewshot", type=int, default=0)
+    p.add_argument("--lora_path", default="")
+    p.add_argument("--lora_merge", action="store_true")
+    p.add_argument("--max_items", type=int, default=0,
+                   help="cap items per subject (debug)")
+    p.add_argument("--out", default="", help="JSON report path")
+    p.add_argument("--dtype", choices=["float32", "bfloat16"],
+                   default="float32")
+    return p
+
+
+def make_logits_fn(config, params, lora, compute_dtype):
+    """Bucketed-length last-token logits: np [1,S] -> np [V]."""
+
+    @jax.jit
+    def fwd(params, lora, ids, last_idx):
+        logits = gpt2.forward(config, params, ids, lora=lora,
+                              compute_dtype=compute_dtype)
+        return logits[0, last_idx, :]
+
+    def logits_fn(ids: np.ndarray) -> np.ndarray:
+        S = ids.shape[1]
+        if S > config.n_positions:  # keep the prompt tail
+            ids = ids[:, -config.n_positions:]
+            S = ids.shape[1]
+        bucket = 1 << (S - 1).bit_length()
+        bucket = min(max(bucket, 32), config.n_positions)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :S] = ids[0]
+        return np.asarray(fwd(params, lora, padded, jnp.int32(S - 1)))
+
+    return logits_fn
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    config, params = load_gpt2(args.pretrained_dir)
+
+    lora = None
+    if args.lora_path:
+        lora, spec = peft_io.load_adapter(args.lora_path)
+        log.info(f"adapter: r={spec.rank} "
+                 f"({'merged' if args.lora_merge else 'dynamic'})")
+        if args.lora_merge:
+            params = merge_gpt2(params, lora)
+            lora = None
+
+    tok = GPT2BPETokenizer.from_pretrained(args.pretrained_dir)
+    by_subject = mmlu.load_split(args.mmlu_root, args.split)
+    n_items = sum(len(v) for v in by_subject.values())
+    log.info(f"MMLU {args.split}: {len(by_subject)} subjects, "
+             f"{n_items} items, fewshot={args.fewshot}")
+
+    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    logits_fn = make_logits_fn(config, params, lora, compute_dtype)
+
+    done = [0]
+
+    def progress(subject, i, n):
+        done[0] += 1
+        if done[0] % 50 == 0:
+            log.info(f"{done[0]} items... ({subject} {i}/{n})")
+
+    result = mmlu.evaluate(by_subject, logits_fn, tok.encode,
+                           fewshot_k=args.fewshot, progress_fn=progress,
+                           max_items_per_subject=args.max_items)
+
+    report = {
+        "split": args.split, "fewshot": args.fewshot,
+        "macro_accuracy": round(result.macro, 4),
+        "micro_accuracy": round(result.micro, 4),
+        "total_items": result.total,
+        "per_subject": {r.subject: {"accuracy": round(r.accuracy, 4),
+                                    "correct": r.correct, "total": r.total}
+                        for r in result.per_subject},
+    }
+    for r in result.per_subject:
+        log.info(f"  {r.subject}: {r.accuracy:.3f} "
+                 f"({r.correct}/{r.total})")
+    log.info(f"macro={result.macro:.4f} micro={result.micro:.4f}")
+    if args.out:
+        JSONLWriter(args.out).write(report)
+    print(json.dumps({"macro_accuracy": report["macro_accuracy"],
+                      "micro_accuracy": report["micro_accuracy"],
+                      "total_items": result.total}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

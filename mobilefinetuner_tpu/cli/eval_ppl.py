@@ -1,0 +1,125 @@
+"""WikiText-2 perplexity evaluation CLI.
+
+TPU-native rebuild of the reference `eval_ppl` binary
+(reference: gpt2_lora_finetune/eval_ppl.cpp): load GPT-2 (+ optional LoRA
+adapter, merged into the base weights or applied dynamically,
+eval_ppl.cpp:110-127), run the split with token-weighted mean NLL
+(mean_nll = Σ(loss·tokens)/Σtokens; ppl = exp(mean_nll),
+eval_ppl.cpp:157-200), JSONL progress + final record, unmerge after
+(eval_ppl.cpp:222 — moot here: merge is functional, the base tree is never
+mutated).
+
+Usage:
+  python -m mobilefinetuner_tpu.cli.eval_ppl \
+      --pretrained_dir /path/gpt2 --data_root /path/wikitext-2 \
+      --split valid [--lora_path adapter.safetensors --lora_merge]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from mobilefinetuner_tpu.core.logging import JSONLWriter, get_logger
+from mobilefinetuner_tpu.data.tokenizer_bpe import GPT2BPETokenizer
+from mobilefinetuner_tpu.data.wikitext2 import WT2Config, WikiText2Dataset
+from mobilefinetuner_tpu.io.checkpoints import load_gpt2
+from mobilefinetuner_tpu.lora import peft_io
+from mobilefinetuner_tpu.lora.lora import merge_gpt2
+from mobilefinetuner_tpu.models import gpt2
+from mobilefinetuner_tpu.ops.loss import (lm_cross_entropy_sum,
+                                          perplexity_from_loss)
+
+log = get_logger()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="eval_ppl", description="WikiText-2 perplexity (TPU)")
+    p.add_argument("--pretrained_dir", required=True)
+    p.add_argument("--data_root", required=True)
+    p.add_argument("--split", default="valid", choices=["valid", "test"])
+    p.add_argument("--lora_path", default="")
+    p.add_argument("--lora_merge", action="store_true",
+                   help="fold the adapter into base weights instead of "
+                        "applying it dynamically")
+    p.add_argument("--batch_size", type=int, default=2)
+    p.add_argument("--seq_len", type=int, default=1024)
+    p.add_argument("--stride", type=int, default=0,
+                   help="chunk stride; 0 = seq_len (no overlap, the "
+                        "reference default stride=-1)")
+    p.add_argument("--max_batches", type=int, default=0)
+    p.add_argument("--log_every", type=int, default=20)
+    p.add_argument("--out", default="", help="JSONL output path")
+    p.add_argument("--dtype", choices=["float32", "bfloat16"],
+                   default="float32")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    config, params = load_gpt2(args.pretrained_dir)
+    args.seq_len = min(args.seq_len, config.n_positions)
+
+    lora = None
+    if args.lora_path:
+        lora, spec = peft_io.load_adapter(args.lora_path)
+        log.info(f"adapter: r={spec.rank} alpha={spec.alpha} "
+                 f"targets={spec.targets} "
+                 f"({'merged' if args.lora_merge else 'dynamic'})")
+        if args.lora_merge:
+            params = merge_gpt2(params, lora)
+            lora = None
+
+    tok = GPT2BPETokenizer.from_pretrained(args.pretrained_dir)
+    wt2 = WT2Config(seq_len=args.seq_len, batch_size=args.batch_size,
+                    stride=args.stride or None, shuffle=False,
+                    drop_last=False)
+    ds = WikiText2Dataset(args.data_root, args.split, wt2, tok.encode,
+                          tok.eos_id)
+    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+
+    @jax.jit
+    def step(params, lora, batch):
+        logits = gpt2.forward(config, params, batch["input_ids"],
+                              attention_mask=batch["attention_mask"],
+                              lora=lora, compute_dtype=compute_dtype)
+        return lm_cross_entropy_sum(logits, batch["labels"])
+
+    jsonl = JSONLWriter(args.out) if args.out else None
+    total, count = 0.0, 0
+    t0 = time.time()
+    for n, batch in enumerate(ds.epoch(0)):
+        s, c = step(params, lora, batch)
+        total += float(s)
+        count += int(c)
+        if args.log_every and (n + 1) % args.log_every == 0:
+            mean = total / max(count, 1)
+            log.info(f"batch {n + 1}/{ds.num_batches()} "
+                     f"nll={mean:.4f} ppl={perplexity_from_loss(mean):.2f}")
+            if jsonl:
+                jsonl.write({"type": "progress", "batch": n + 1,
+                             "nll": mean,
+                             "ppl": perplexity_from_loss(mean)})
+        if args.max_batches and n + 1 >= args.max_batches:
+            break
+    mean = total / max(count, 1)
+    ppl = perplexity_from_loss(mean)
+    record = {"type": "final", "split": args.split, "nll": mean, "ppl": ppl,
+              "tokens": count, "seq_len": args.seq_len,
+              "lora": bool(args.lora_path), "merged": args.lora_merge,
+              "seconds": round(time.time() - t0, 1)}
+    log.info(f"{args.split} ppl={ppl:.3f} nll={mean:.4f} ({count} tokens)")
+    if jsonl:
+        jsonl.write(record)
+    import json as _json
+    print(_json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,193 @@
+"""Gemma-3 LoRA fine-tuning CLI.
+
+TPU-native rebuild of the reference `train_lora_gemma` binary
+(reference: operators/finetune_ops/optim/train_lora_gemma.cpp — config/
+weights/tokenizer load :352-496, target presets + --lora_targets override
+:498-540, pretokenized-data mode :477-496, sharding registration :431-475,
+training via GemmaLoRATrainer). The 262k-vocab lm_head+CE runs through the
+chunked loss (ops/loss.py chunked_lm_cross_entropy) so [B,S,262144] fp32
+logits are never materialized (SURVEY.md §7 hard part (d)).
+
+Alignment-dump mode (--align_dump_dir) mirrors the reference's
+single-batch npy dumps (:620-920) via tools/align_dump.py.
+
+Usage (tiny smoke):
+  python -m mobilefinetuner_tpu.cli.train_lora_gemma \
+      --model_dir /path/gemma-3-270m --data_dir /path/wikitext-2 \
+      --max_steps 10 --batch 2 --output_dir out/
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from mobilefinetuner_tpu.cli import common
+from mobilefinetuner_tpu.core.config import Gemma3TextConfig
+from mobilefinetuner_tpu.core.logging import get_logger
+from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
+from mobilefinetuner_tpu.data.wikitext2 import WT2Config, WikiText2Dataset
+from mobilefinetuner_tpu.io.checkpoints import load_gemma3
+from mobilefinetuner_tpu.lora import peft_io
+from mobilefinetuner_tpu.lora.lora import (GEMMA_PRESETS, LoRASpec,
+                                           init_lora_gemma3, num_trainable,
+                                           trainable_mask)
+from mobilefinetuner_tpu.models import gemma3
+from mobilefinetuner_tpu.ops.loss import chunked_lm_cross_entropy_sum
+from mobilefinetuner_tpu.optim import adam as adam_mod
+from mobilefinetuner_tpu.train.trainer import init_optimizer
+
+log = get_logger()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="train_lora_gemma",
+        description="Gemma-3 LoRA fine-tuning on WikiText-2 (TPU)")
+    p.add_argument("--model_dir", required=True,
+                   help="HF Gemma-3 checkpoint dir")
+    p.add_argument("--data_dir", default="",
+                   help="WikiText-2 directory (or use --pretokenized_path)")
+    p.add_argument("--output_dir", default="gemma_lora_out")
+    p.add_argument("--resume_from", default="")
+    p.add_argument("--eval_out", default="")
+    p.add_argument("--rank", type=int, default=8)
+    p.add_argument("--alpha", type=float, default=32.0)
+    p.add_argument("--lora_dropout", type=float, default=0.0)
+    p.add_argument("--targets", default="full",
+                   choices=list(GEMMA_PRESETS),
+                   help="preset (gemma_lora_injector.h:9-34)")
+    p.add_argument("--lora_targets", default="",
+                   help="comma list overriding --targets "
+                        "(q_proj,k_proj,v_proj,o_proj,gate_proj,up_proj,"
+                        "down_proj)")
+    p.add_argument("--pretokenized_path", default="",
+                   help="pretokenized .bin (train split)")
+    p.add_argument("--pretokenized_meta", default="",
+                   help="(accepted for reference-CLI compat; the .bin's "
+                        "sidecar meta.json is found automatically)")
+    p.add_argument("--loss_chunks", type=int, default=8,
+                   help="sequence chunks for the 262k-vocab chunked CE")
+    p.add_argument("--peft_export_dir", default="")
+    p.add_argument("--max_steps", type=int, default=0,
+                   help="alias of --steps (reference flag name)")
+    common.add_train_flags(p, lr=1e-4, seq_len=256, batch_size=1)
+    common.add_pm_flags(p)
+    common.add_shard_flags(p)
+    common.add_mesh_flags(p)
+    # reference flag aliases
+    p.add_argument("--batch", type=int, default=None,
+                   help="alias of --batch_size")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.batch is not None:
+        args.batch_size = args.batch
+    if args.max_steps and not args.steps:
+        args.steps = args.max_steps
+
+    config, params = load_gemma3(args.model_dir)
+    log.info(f"Gemma-3: layers={config.num_hidden_layers} "
+             f"hidden={config.hidden_size} vocab={config.vocab_size} "
+             f"q/kv heads={config.num_attention_heads}/"
+             f"{config.num_key_value_heads}")
+
+    start_step = 0
+    opt_state = None
+    if args.resume_from:
+        lora, spec = peft_io.load_adapter(args.resume_from)
+        log.info(f"resumed adapter: r={spec.rank} targets={spec.targets}")
+    else:
+        targets = ([t for t in args.lora_targets.split(",") if t]
+                   or GEMMA_PRESETS[args.targets])
+        spec = LoRASpec(rank=args.rank, alpha=args.alpha,
+                        dropout=args.lora_dropout, targets=targets,
+                        init="peft")  # PEFT-default init (SURVEY §2.5)
+        lora = init_lora_gemma3(config, spec, jax.random.PRNGKey(args.seed))
+    mask = trainable_mask(lora)
+    log.info(f"trainable params: {num_trainable(lora):,}")
+
+    tok = GemmaTokenizer.from_pretrained(args.model_dir)
+    encode = lambda s: tok.encode(s, add_bos=False)
+    wt2 = WT2Config(seq_len=args.seq_len, batch_size=args.batch_size,
+                    data_fraction=args.data_fraction, seed=args.seed)
+    train_ds = WikiText2Dataset(
+        args.data_dir, "train", wt2, encode, tok.eos_id,
+        pad_id=tok.pad_id,
+        pretokenized_bin=args.pretokenized_path or None)
+    valid_ds = None
+    if args.eval_interval and args.data_dir:
+        wt2_eval = WT2Config(seq_len=args.seq_len,
+                             batch_size=args.eval_batch_size, shuffle=False)
+        valid_ds = WikiText2Dataset(args.data_dir, "valid", wt2_eval,
+                                    encode, tok.eos_id, pad_id=tok.pad_id)
+
+    steps_per_epoch = max(train_ds.num_batches() // args.grad_accum_steps, 1)
+    total_steps = common.resolve_total_steps(args, steps_per_epoch)
+    tc = common.train_config_from_args(args, total_steps)
+    log.info(f"{train_ds.num_chunks} chunks, {total_steps} total steps")
+
+    if args.resume_from and os.path.exists(args.resume_from + ".opt"):
+        template = init_optimizer(lora, tc, mask)
+        opt_state, _ = adam_mod.load_state(args.resume_from + ".opt",
+                                           template)
+        start_step = int(opt_state["step"])
+        log.info(f"restored optimizer state @ step {start_step}")
+
+    mesh = common.build_mesh(args)
+    params, fetch_fn = common.setup_frozen_params(args, params, mesh)
+    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    base_rng = (jax.random.PRNGKey(args.seed + 1)
+                if args.lora_dropout > 0 else None)
+
+    def loss_fn(lora_t, frozen, mb):
+        p = fetch_fn(frozen)
+        # per-(step, micro-batch) dropout key, threaded via the batch
+        rng = mb["dropout_rng"][0] if "dropout_rng" in mb else None
+        hidden = gemma3.hidden_states(
+            config, p, mb["input_ids"],
+            attention_mask=mb["attention_mask"], lora=lora_t,
+            compute_dtype=compute_dtype, remat=args.remat,
+            lora_dropout=args.lora_dropout, dropout_rng=rng)
+        # lm_head tied to embeddings; chunked CE avoids [B,S,262k] logits
+        return chunked_lm_cross_entropy_sum(
+            hidden, p["embed"], mb["labels"], num_chunks=args.loss_chunks)
+
+    def nll_fn(lora_t, frozen, mb):
+        p = fetch_fn(frozen)
+        hidden = gemma3.hidden_states(
+            config, p, mb["input_ids"],
+            attention_mask=mb["attention_mask"], lora=lora_t,
+            compute_dtype=compute_dtype)
+        return chunked_lm_cross_entropy_sum(
+            hidden, p["embed"], mb["labels"], num_chunks=args.loss_chunks)
+
+    def save_hook(step, lora_t, opt_st, final):
+        os.makedirs(args.output_dir, exist_ok=True)
+        name = "gemma_lora.safetensors" if final \
+            else f"gemma_lora_step{step}.safetensors"
+        path = os.path.join(args.output_dir, name)
+        peft_io.save_adapter(path, jax.device_get(lora_t), spec)
+        adam_mod.save_state(path + ".opt", jax.device_get(opt_st), tc.adam())
+        log.info(f"saved adapter -> {path}")
+        if final and args.peft_export_dir:
+            peft_io.export_peft(args.peft_export_dir,
+                                jax.device_get(lora_t), spec, "gemma",
+                                base_model_name=args.model_dir)
+
+    common.run_training(
+        args, trainable=lora, frozen=params, loss_fn=loss_fn, nll_fn=nll_fn,
+        train_ds=train_ds, valid_ds=valid_ds, total_steps=total_steps,
+        tc=tc, mask=mask, start_step=start_step, opt_state=opt_state,
+        save_hook=save_hook, mesh=mesh, dropout_rng=base_rng)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

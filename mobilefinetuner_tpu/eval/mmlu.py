@@ -1,0 +1,224 @@
+"""MMLU 4-choice evaluation: CSV loading, k-shot prompt building,
+letter-token argmax prediction, per-subject / macro / micro reporting.
+
+Behavioral spec mirrors the reference MMLURunner
+(reference: gpt2_lora_finetune/mmlu/mmlu_runner.{h,cpp}):
+  - every *.csv under <mmlu_root>/<split>/ is loaded; quoted CSV fields with
+    escaped double-quotes are handled (parse_csv_line);
+  - both headered CSVs (subject/question/a/b/c/d/answer columns) and the
+    headerless Hendrycks layout (question,A,B,C,D,answer with the subject
+    taken from the filename) are accepted;
+  - prompt = "Question: ...\nA. ...\nB. ...\nC. ...\nD. ...\nAnswer: "
+    with k-shot examples prefixed, answered, and separated by blank lines
+    (build_prompt, trailing space included);
+  - few-shot examples are the first k items of the same subject, excluding
+    the current item (no leakage; evaluate());
+  - prediction = argmax over the log-softmax of the LAST-token logits
+    restricted to the token ids of "A"/"B"/"C"/"D" (predict_letter);
+  - macro accuracy = mean of per-subject accuracies, micro = pooled
+    (mmlu_runner.h:12-54).
+
+Model access is through a `logits_fn(ids: np.ndarray[1,S]) -> np.ndarray[V]`
+callable (last-token logits), so the same runner drives GPT-2, Gemma, or any
+future model; the CLI builds a jitted, bucketed-length version.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class MCQItem:
+    subject: str
+    question: str
+    A: str
+    B: str
+    C: str
+    D: str
+    answer: str  # "A".."D"
+
+
+@dataclasses.dataclass
+class SubjectReport:
+    subject: str
+    correct: int
+    total: int
+
+    @property
+    def accuracy(self) -> float:
+        return self.correct / self.total if self.total else 0.0
+
+
+@dataclasses.dataclass
+class MMLUResult:
+    per_subject: List[SubjectReport]
+    macro: float
+    micro: float
+    total: int
+
+
+def parse_csv_line(line: str) -> List[str]:
+    """Minimal RFC-4180 field split: quotes + escaped double-quotes
+    (mmlu_runner.cpp parse_csv_line semantics)."""
+    fields, cur, in_quotes = [], [], False
+    i = 0
+    while i < len(line):
+        c = line[i]
+        if in_quotes:
+            if c == '"':
+                if i + 1 < len(line) and line[i + 1] == '"':
+                    cur.append('"')
+                    i += 1
+                else:
+                    in_quotes = False
+            else:
+                cur.append(c)
+        else:
+            if c == ",":
+                fields.append("".join(cur))
+                cur = []
+            elif c == '"':
+                in_quotes = True
+            else:
+                cur.append(c)
+        i += 1
+    fields.append("".join(cur))
+    return fields
+
+
+def _subject_from_filename(path: str) -> str:
+    """abstract_algebra_test.csv -> abstract_algebra."""
+    name = os.path.splitext(os.path.basename(path))[0]
+    for suffix in ("_test", "_val", "_dev", "_train"):
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+def read_mmlu_csv(path: str) -> List[MCQItem]:
+    """Load one CSV; headered or headerless-Hendrycks layouts."""
+    with open(path, encoding="utf-8") as f:
+        lines = [ln.rstrip("\n") for ln in f if ln.strip()]
+    if not lines:
+        return []
+    first = parse_csv_line(lines[0])
+    lowered = [c.strip().lower() for c in first]
+    headered = "question" in lowered and "answer" in lowered
+    items: List[MCQItem] = []
+    if headered:
+        idx = {name: lowered.index(name) for name in
+               ("question", "a", "b", "c", "d", "answer")
+               if name in lowered}
+        subj_idx = lowered.index("subject") if "subject" in lowered else None
+        rows = lines[1:]
+        for line in rows:
+            f2 = parse_csv_line(line)
+            if len(f2) <= max(idx.values()):
+                continue
+            subject = (f2[subj_idx].strip() if subj_idx is not None
+                       else _subject_from_filename(path)) or "unknown"
+            ans = f2[idx["answer"]].strip()
+            items.append(MCQItem(
+                subject=subject, question=f2[idx["question"]].strip(),
+                A=f2[idx["a"]].strip(), B=f2[idx["b"]].strip(),
+                C=f2[idx["c"]].strip(), D=f2[idx["d"]].strip(),
+                answer=(ans[:1].upper() or "A")))
+    else:
+        subject = _subject_from_filename(path)
+        for line in lines:
+            f2 = parse_csv_line(line)
+            if len(f2) < 6:
+                continue
+            items.append(MCQItem(
+                subject=subject, question=f2[0].strip(), A=f2[1].strip(),
+                B=f2[2].strip(), C=f2[3].strip(), D=f2[4].strip(),
+                answer=(f2[5].strip()[:1].upper() or "A")))
+    return items
+
+
+def load_split(mmlu_root: str, split: str) -> Dict[str, List[MCQItem]]:
+    """All *.csv under <root>/<split>/ grouped by subject."""
+    split_dir = os.path.join(mmlu_root, split)
+    by_subject: Dict[str, List[MCQItem]] = {}
+    for name in sorted(os.listdir(split_dir)):
+        if not name.endswith(".csv"):
+            continue
+        for item in read_mmlu_csv(os.path.join(split_dir, name)):
+            by_subject.setdefault(item.subject, []).append(item)
+    return by_subject
+
+
+def build_prompt(item: MCQItem,
+                 shots: Optional[Sequence[MCQItem]] = None) -> str:
+    def one(q: MCQItem) -> str:
+        return (f"Question: {q.question}\n"
+                f"A. {q.A}\nB. {q.B}\nC. {q.C}\nD. {q.D}\nAnswer: ")
+
+    prompt = ""
+    for s in shots or ():
+        prompt += one(s) + s.answer + "\n\n"
+    return prompt + one(item)
+
+
+LETTERS = ("A", "B", "C", "D")
+
+
+def predict_letter(prompt: str, logits_fn: Callable[[np.ndarray], np.ndarray],
+                   encode_fn: Callable[[str], List[int]],
+                   letter_ids: Sequence[int]) -> str:
+    """argmax over the last-token log-probs restricted to the A-D token ids.
+
+    log_softmax is rank-preserving over the restricted set, so raw logits
+    argmax is equivalent (the reference computes the full log_softmax first,
+    predict_letter; we skip the normalization)."""
+    ids = encode_fn(prompt) or [0]
+    logits = logits_fn(np.asarray(ids, np.int32)[None, :])
+    scores = [logits[i] if 0 <= i < logits.shape[-1] else -1e30
+              for i in letter_ids]
+    return LETTERS[int(np.argmax(scores))]
+
+
+def letter_token_ids(encode_fn: Callable[[str], List[int]]) -> List[int]:
+    """First token id of each letter (predict_letter id lookup)."""
+    out = []
+    for fallback, letter in enumerate(LETTERS):
+        ids = encode_fn(letter)
+        out.append(ids[0] if ids else fallback)
+    return out
+
+
+def evaluate(by_subject: Dict[str, List[MCQItem]],
+             logits_fn: Callable[[np.ndarray], np.ndarray],
+             encode_fn: Callable[[str], List[int]],
+             fewshot_k: int = 0,
+             progress_fn: Optional[Callable[[str, int, int], None]] = None,
+             max_items_per_subject: int = 0) -> MMLUResult:
+    letter_ids = letter_token_ids(encode_fn)
+    reports: List[SubjectReport] = []
+    total_correct = total = 0
+    for subject in sorted(by_subject):
+        items = by_subject[subject]
+        if max_items_per_subject:
+            items = items[:max_items_per_subject]
+        shots = items[:fewshot_k] if fewshot_k > 0 else []
+        correct = 0
+        for n, item in enumerate(items):
+            shots_ex = [s for s in shots if s is not item]
+            pred = predict_letter(build_prompt(item, shots_ex or None),
+                                  logits_fn, encode_fn, letter_ids)
+            correct += int(pred == item.answer)
+            if progress_fn:
+                progress_fn(subject, n + 1, len(items))
+        reports.append(SubjectReport(subject, correct, len(items)))
+        total_correct += correct
+        total += len(items)
+    macro = (sum(r.accuracy for r in reports) / len(reports)
+             if reports else 0.0)
+    micro = total_correct / total if total else 0.0
+    return MMLUResult(reports, macro, micro, total)

@@ -132,6 +132,16 @@ def chunked_lm_cross_entropy(hidden: jnp.ndarray, lm_head_w: jnp.ndarray,
     return total / jnp.maximum(count, 1).astype(jnp.float32)
 
 
+def chunked_lm_cross_entropy_sum(
+        hidden: jnp.ndarray, lm_head_w: jnp.ndarray, labels: jnp.ndarray,
+        ignore_index: int = IGNORE_INDEX,
+        num_chunks: int = 8) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(sum_nll, valid_token_count) form of the chunked loss — the
+    accumulation-friendly contract the train step uses (trainer.py)."""
+    return _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index,
+                            num_chunks)
+
+
 def perplexity_from_loss(loss) -> float:
     """ppl = exp(mean NLL) (reference: core/lm_loss.h:39-41)."""
     import math

@@ -1,0 +1,170 @@
+"""Tiny on-disk HF-format checkpoint + data fixtures for CLI tests.
+
+Builds what the CLIs expect to find in a real model dir: config.json,
+model.safetensors with HF key schemes, tokenizer files — all tiny enough
+for CPU test runs (the analog of the reference's committed small fixtures,
+SURVEY.md §4.2)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+
+from mobilefinetuner_tpu.core.config import GPT2Config, Gemma3TextConfig
+from mobilefinetuner_tpu.io.checkpoints import gpt2_params_to_hf
+from mobilefinetuner_tpu.io.safetensors_io import save_safetensors
+from mobilefinetuner_tpu.models import gemma3, gpt2
+
+WIKI_LINES = [
+    " = Heading = ",
+    " The quick brown fox jumps over the lazy dog . ",
+    " In 1984 , George Orwell wrote about surveillance states . ",
+    " Prices rose 3.5 % to $ 1,234.56 yesterday . ",
+    " Tokenization matters for language models . ",
+    " A small corpus still produces many chunks when repeated . ",
+] * 30
+
+
+def write_wikitext_dir(d: str) -> str:
+    os.makedirs(d, exist_ok=True)
+    for split, frac in (("train", 1.0), ("valid", 0.3), ("test", 0.3)):
+        n = int(len(WIKI_LINES) * frac)
+        with open(os.path.join(d, f"wiki.{split}.tokens"), "w") as f:
+            f.write("\n".join(WIKI_LINES[:n]) + "\n")
+    return d
+
+
+def train_tiny_gpt2_tokenizer(d: str):
+    """Train a tiny byte-level BPE with the HF tokenizers lib and save
+    vocab.json/merges.txt (the files GPT2BPETokenizer.from_pretrained
+    reads)."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    trainer = trainers.BpeTrainer(
+        vocab_size=600, special_tokens=["<|endoftext|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False)
+    tok.train_from_iterator(WIKI_LINES, trainer)
+    tok.model.save(d)
+    with open(os.path.join(d, "vocab.json")) as f:
+        return len(json.load(f))
+
+
+def write_tiny_gpt2_dir(d: str, seed: int = 0) -> GPT2Config:
+    """HF-format GPT-2 checkpoint dir: config.json + model.safetensors
+    (HF GPT2LMHeadModel keys, Conv1D [in, out] layout) + tokenizer files."""
+    os.makedirs(d, exist_ok=True)
+    vocab_size = train_tiny_gpt2_tokenizer(d)
+    config = GPT2Config.tiny(vocab_size=vocab_size)
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump({"model_type": "gpt2", "vocab_size": config.vocab_size,
+                   "n_positions": config.n_positions,
+                   "n_embd": config.n_embd, "n_layer": config.n_layer,
+                   "n_head": config.n_head,
+                   "layer_norm_epsilon": config.layer_norm_epsilon,
+                   "activation_function": "gelu_new"}, f)
+    params = gpt2.init_params(config, jax.random.PRNGKey(seed))
+    tensors = gpt2_params_to_hf(jax.tree.map(np.asarray, params))
+    save_safetensors(os.path.join(d, "model.safetensors"), tensors,
+                     metadata={"format": "pt"})
+    return config
+
+
+def gemma3_params_to_hf(params) -> dict:
+    """Stacked pytree -> HF Gemma3 text key scheme (inverse of
+    io/checkpoints.gemma3_params_from_hf; linear weights back to [out, in])."""
+    p = {"model.embed_tokens.weight": np.asarray(params["embed"])}
+    b = params["blocks"]
+    L = np.asarray(b["input_ln"]).shape[0]
+    a, m = "model.layers.{}.self_attn.", "model.layers.{}.mlp."
+    per_layer = [
+        ("model.layers.{}.input_layernorm.weight", b["input_ln"], False),
+        (a + "q_proj.weight", b["attn"]["q_w"], True),
+        (a + "k_proj.weight", b["attn"]["k_w"], True),
+        (a + "v_proj.weight", b["attn"]["v_w"], True),
+        (a + "o_proj.weight", b["attn"]["o_w"], True),
+        (a + "q_norm.weight", b["attn"]["q_norm"], False),
+        (a + "k_norm.weight", b["attn"]["k_norm"], False),
+        ("model.layers.{}.post_attention_layernorm.weight",
+         b["post_attn_ln"], False),
+        ("model.layers.{}.pre_feedforward_layernorm.weight",
+         b["pre_ffn_ln"], False),
+        (m + "gate_proj.weight", b["mlp"]["gate_w"], True),
+        (m + "up_proj.weight", b["mlp"]["up_w"], True),
+        (m + "down_proj.weight", b["mlp"]["down_w"], True),
+        ("model.layers.{}.post_feedforward_layernorm.weight",
+         b["post_ffn_ln"], False),
+    ]
+    for fmt, arr, is_linear in per_layer:
+        arr = np.asarray(arr)
+        for i in range(L):
+            p[fmt.format(i)] = arr[i].T if is_linear else arr[i]
+    p["model.norm.weight"] = np.asarray(params["final_norm"])
+    return p
+
+
+def train_tiny_gemma_tokenizer(path: str):
+    from tokenizers import Tokenizer, models, normalizers, trainers
+    byte_tokens = [f"<0x{b:02X}>" for b in range(256)]
+    tok = Tokenizer(models.BPE(unk_token="<unk>", byte_fallback=True))
+    tok.normalizer = normalizers.Replace(" ", "▁")
+    trainer = trainers.BpeTrainer(
+        vocab_size=700,
+        special_tokens=["<pad>", "<eos>", "<bos>", "<unk>"] + byte_tokens,
+        show_progress=False)
+    tok.train_from_iterator(WIKI_LINES, trainer)
+    tok.save(path)
+    return tok.get_vocab_size()
+
+
+def write_tiny_gemma3_dir(d: str, seed: int = 0) -> Gemma3TextConfig:
+    os.makedirs(d, exist_ok=True)
+    vocab_size = train_tiny_gemma_tokenizer(os.path.join(d,
+                                                         "tokenizer.json"))
+    config = Gemma3TextConfig.tiny(vocab_size=vocab_size)
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump({"model_type": "gemma3_text",
+                   "vocab_size": config.vocab_size,
+                   "hidden_size": config.hidden_size,
+                   "intermediate_size": config.intermediate_size,
+                   "num_hidden_layers": config.num_hidden_layers,
+                   "num_attention_heads": config.num_attention_heads,
+                   "num_key_value_heads": config.num_key_value_heads,
+                   "head_dim": config.head_dim,
+                   "max_position_embeddings":
+                       config.max_position_embeddings,
+                   "rms_norm_eps": config.rms_norm_eps,
+                   "rope_theta": config.rope_theta,
+                   "rope_local_base_freq": config.rope_local_base_freq,
+                   "sliding_window": config.sliding_window,
+                   "query_pre_attn_scalar": config.query_pre_attn_scalar,
+                   "sliding_window_pattern":
+                       config.sliding_window_pattern}, f)
+    params = gemma3.init_params(config, jax.random.PRNGKey(seed))
+    tensors = gemma3_params_to_hf(jax.tree.map(np.asarray, params))
+    save_safetensors(os.path.join(d, "model.safetensors"), tensors,
+                     metadata={"format": "pt"})
+    return config
+
+
+MMLU_ROWS = [
+    ("What is 2 + 2 ?", "3", "4", "5", "6", "B"),
+    ("The sky is usually what color ?", "green", "red", "blue", "yellow",
+     "C"),
+    ("Which animal barks ?", "dog", "cat", "fish", "bird", "A"),
+    ("How many days in a week ?", "five", "six", "eight", "seven", "D"),
+]
+
+
+def write_tiny_mmlu_dir(d: str, split: str = "test") -> str:
+    sd = os.path.join(d, split)
+    os.makedirs(sd, exist_ok=True)
+    for subject in ("toy_math", "toy_facts"):
+        with open(os.path.join(sd, f"{subject}_{split}.csv"), "w") as f:
+            for q, a, b, c, dd, ans in MMLU_ROWS:
+                f.write(f'"{q}",{a},{b},{c},{dd},{ans}\n')
+    return d

@@ -1,0 +1,109 @@
+"""MMLU runner unit tests (reference: mmlu/mmlu_runner.{h,cpp} behavior)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from fixtures import write_tiny_mmlu_dir
+
+from mobilefinetuner_tpu.eval.mmlu import (MCQItem, build_prompt, evaluate,
+                                           letter_token_ids, load_split,
+                                           parse_csv_line, read_mmlu_csv)
+
+ITEM = MCQItem("toy", "What is 2 + 2 ?", "3", "4", "5", "6", "B")
+
+
+def test_parse_csv_line_quotes():
+    assert parse_csv_line('a,"b, c",d') == ["a", "b, c", "d"]
+    assert parse_csv_line('"say ""hi""",x') == ['say "hi"', "x"]
+    assert parse_csv_line("plain,row") == ["plain", "row"]
+
+
+def test_build_prompt_zero_shot():
+    p = build_prompt(ITEM)
+    assert p == ("Question: What is 2 + 2 ?\n"
+                 "A. 3\nB. 4\nC. 5\nD. 6\nAnswer: ")
+
+
+def test_build_prompt_few_shot_separators():
+    shot = MCQItem("toy", "Which animal barks ?", "dog", "cat", "fish",
+                   "bird", "A")
+    p = build_prompt(ITEM, [shot])
+    # shot answered + blank-line separator, then the query with trailing
+    # space (mmlu_runner.cpp build_prompt)
+    assert p.startswith("Question: Which animal barks ?\n")
+    assert "Answer: A\n\nQuestion: What is 2 + 2 ?" in p
+    assert p.endswith("Answer: ")
+
+
+def test_headerless_csv_subject_from_filename(tmp_path):
+    root = write_tiny_mmlu_dir(str(tmp_path))
+    by_subject = load_split(root, "test")
+    assert set(by_subject) == {"toy_math", "toy_facts"}
+    assert all(len(v) == 4 for v in by_subject.values())
+    assert by_subject["toy_math"][0].answer == "B"
+
+
+def test_headered_csv(tmp_path):
+    p = tmp_path / "x.csv"
+    p.write_text("subject,question,A,B,C,D,answer\n"
+                 "astro,Is space big?,yes,no,maybe,unknown,A\n")
+    items = read_mmlu_csv(str(p))
+    assert items[0].subject == "astro" and items[0].answer == "A"
+
+
+def test_evaluate_with_oracle_logits(tmp_path):
+    """A logits_fn that always prefers the correct letter's token id gives
+    accuracy 1.0; one preferring a wrong letter gives 0."""
+    root = write_tiny_mmlu_dir(str(tmp_path))
+    by_subject = load_split(root, "test")
+    encode = lambda s: [ord(c) for c in s[-200:]]
+    lids = letter_token_ids(encode)
+    answers = {build_prompt(i, None): i.answer
+               for items in by_subject.values() for i in items}
+
+    def oracle(prompt_suffix_ids):
+        # recover which item this is by matching the prompt tail
+        text = "".join(chr(c) for c in prompt_suffix_ids[0])
+        logits = np.zeros(300, np.float32)
+        for p, ans in answers.items():
+            if text.endswith(p[-min(len(p), 200):]):
+                logits[lids["ABCD".index(ans)]] = 10.0
+                return logits
+        return logits
+
+    res = evaluate(by_subject, oracle, encode, fewshot_k=0)
+    assert res.macro == 1.0 and res.micro == 1.0 and res.total == 8
+
+    def always_wrong(ids):
+        logits = np.zeros(300, np.float32)
+        text = "".join(chr(c) for c in ids[0])
+        for p, ans in answers.items():
+            if text.endswith(p[-min(len(p), 200):]):
+                wrong = next(l for l in "ABCD" if l != ans)
+                logits[lids["ABCD".index(wrong)]] = 10.0
+        return logits
+
+    res2 = evaluate(by_subject, always_wrong, encode, fewshot_k=0)
+    assert res2.micro == 0.0
+
+
+def test_fewshot_excludes_current_item(tmp_path):
+    """Few-shot context must not contain the query itself (no-leak rule,
+    mmlu_runner.cpp evaluate)."""
+    root = write_tiny_mmlu_dir(str(tmp_path))
+    by_subject = load_split(root, "test")
+    seen_prompts = []
+    encode = lambda s: [ord(c) for c in s]
+
+    def spy(ids):
+        seen_prompts.append("".join(chr(c) for c in ids[0]))
+        return np.zeros(300, np.float32)
+
+    evaluate({"toy_math": by_subject["toy_math"]}, spy, encode, fewshot_k=2)
+    for prompt in seen_prompts:
+        q = prompt.rsplit("Question: ", 1)[1]
+        shots_part = prompt[: len(prompt) - len("Question: " + q)]
+        assert q.split("\n")[0] not in shots_part

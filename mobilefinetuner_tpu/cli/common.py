@@ -12,7 +12,6 @@ SURVEY.md §5 Checkpoint/resume).
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import time
 from typing import Callable, Iterator, Optional
@@ -23,10 +22,9 @@ import numpy as np
 
 from mobilefinetuner_tpu.core.logging import (JSONLWriter, MetricsLogger,
                                               get_logger)
-from mobilefinetuner_tpu.data.wikitext2 import WT2Config, WikiText2Dataset
+from mobilefinetuner_tpu.data.wikitext2 import WikiText2Dataset
 from mobilefinetuner_tpu.ops.loss import perplexity_from_loss
-from mobilefinetuner_tpu.parallel import offload as offload_mod
-from mobilefinetuner_tpu.parallel.mesh import (batch_sharding, make_mesh,
+from mobilefinetuner_tpu.parallel.mesh import (make_mesh,
                                                params_shardings,
                                                replicated_sharding,
                                                shard_batch)
@@ -82,6 +80,10 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
                    default="float32", help="compute dtype")
     g.add_argument("--remat", action="store_true",
                    help="gradient checkpointing over the layer scan")
+    g.add_argument("--attention_impl", choices=["xla", "flash"],
+                   default="xla",
+                   help="'flash' = Pallas fused kernel (wins for S >~ 512; "
+                        "XLA's fused attention is faster at short S)")
 
 
 def add_pm_flags(p: argparse.ArgumentParser):
@@ -190,18 +192,29 @@ def train_config_from_args(args, total_steps: int) -> TrainConfig:
         coupled_weight_decay=args.coupled_weight_decay)
 
 
-def micro_batches(dataset: WikiText2Dataset, accum: int) -> Iterator[tuple]:
+def micro_batches(dataset: WikiText2Dataset, accum: int,
+                  skip_steps: int = 0) -> Iterator[tuple]:
     """Yield (epoch, [accum*micro_b, ...] step batch) forever, cycling
-    epochs (the reference's per-step micro-batch pulls, main.cpp:569-583)."""
-    epoch = 0
+    epochs (the reference's per-step micro-batch pulls, main.cpp:569-583).
+
+    skip_steps fast-forwards the stream past batches an interrupted run
+    already consumed, WITHOUT building them — a resumed run continues the
+    exact data order of an uninterrupted one (same seed => same per-epoch
+    shuffles) instead of replaying epoch 0 from the top."""
+    nb = max(dataset.num_batches(), 1)
+    # the stream is continuous across epochs (a partial accumulation at an
+    # epoch boundary carries into the next epoch), so step s consumes
+    # micro-batches [s*accum, (s+1)*accum) of the concatenated stream
+    epoch, start_batch = divmod(skip_steps * accum, nb)
     pending = []
     while True:
-        for b in dataset.epoch(epoch):
+        for b in dataset.epoch(epoch, start_batch=start_batch):
             pending.append(b)
             if len(pending) == accum:
                 yield epoch, {k: np.concatenate([p[k] for p in pending])
                               for k in pending[0]}
                 pending = []
+        start_batch = 0
         epoch += 1
 
 
@@ -220,6 +233,27 @@ def evaluate(eval_step, trainable, frozen, dataset: WikiText2Dataset,
     mean = total / max(count, 1)
     return {"loss": mean, "ppl": perplexity_from_loss(mean),
             "tokens": count, "batches": n}
+
+
+def compute_dtype_from_args(args):
+    return jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+
+
+def maybe_resume_opt_state(args, trainable, tc: TrainConfig, mask=None):
+    """(opt_state, start_step) from the .opt sidecar next to
+    --resume_from, or (None, 0). The sidecar carries Adam m/v AND the step
+    counter — restoring both is an improvement over the reference, which
+    never wires Adam::save/load into any CLI (SURVEY.md §5)."""
+    from mobilefinetuner_tpu.optim import adam as adam_mod
+    from mobilefinetuner_tpu.train.trainer import init_optimizer
+    path = getattr(args, "resume_from", "")
+    if not path or not os.path.exists(path + ".opt"):
+        return None, 0
+    template = init_optimizer(trainable, tc, mask)
+    opt_state, _ = adam_mod.load_state(path + ".opt", template)
+    start_step = int(opt_state["step"])
+    log.info(f"restored optimizer state @ step {start_step}")
+    return opt_state, start_step
 
 
 class EMA:
@@ -274,7 +308,8 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
             opt_state, jax.tree.map(lambda _: repl, opt_state))
 
     ema = EMA(args.ema_beta)
-    batches = micro_batches(train_ds, tc.grad_accum_steps)
+    batches = micro_batches(train_ds, tc.grad_accum_steps,
+                            skip_steps=start_step)
     t_start = time.time()
     metrics = {}
     epoch = 0
@@ -354,8 +389,6 @@ def setup_frozen_params(args, params, mesh):
             f"({stats['offloaded_bytes'] / 2**20:.0f} MB) -> host RAM, "
             f"{stats['resident_bytes'] / 2**20:.0f} MB resident "
             f"(budget {args.shard_budget_mb} MB)")
-    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" \
-        else jnp.float32
 
     def fetch_fn(p):
         return fetch(p, plan, shardings, compute_dtype=None)

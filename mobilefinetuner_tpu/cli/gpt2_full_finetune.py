@@ -17,11 +17,11 @@ Usage (tiny smoke):
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import os
 import sys
 
 import jax
-import jax.numpy as jnp
 
 from mobilefinetuner_tpu.cli import common
 from mobilefinetuner_tpu.core.logging import get_logger
@@ -34,7 +34,6 @@ from mobilefinetuner_tpu.models import gpt2
 from mobilefinetuner_tpu.ops.loss import lm_cross_entropy_sum
 from mobilefinetuner_tpu.optim import adam as adam_mod
 from mobilefinetuner_tpu.parallel.mesh import params_shardings
-from mobilefinetuner_tpu.train.trainer import init_optimizer
 
 log = get_logger()
 
@@ -59,6 +58,8 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
     config, params = load_gpt2(args.pretrained_dir)
+    config = dataclasses.replace(
+        config, attention_impl=args.attention_impl)
     if args.resume_from:
         if os.path.isdir(args.resume_from):
             tensors = load_hf_state_dict(args.resume_from)
@@ -90,21 +91,15 @@ def main(argv=None) -> int:
     log.info(f"full FT: {gpt2.param_count(params):,} trainable params, "
              f"{total_steps} steps")
 
-    start_step = 0
-    opt_state = None
-    if args.resume_from and os.path.exists(args.resume_from + ".opt"):
-        template = init_optimizer(params, tc, None)
-        opt_state, _ = adam_mod.load_state(args.resume_from + ".opt",
-                                           template)
-        start_step = int(opt_state["step"])
-        log.info(f"restored optimizer state @ step {start_step}")
+    opt_state, start_step = common.maybe_resume_opt_state(
+        args, params, tc, None)
 
     # Full FT: params themselves are the trainable tree — FSDP-shard them
     # (and thus Adam m/v) over the mesh; no host offload of trainables.
     mesh = common.build_mesh(args)
     shardings = params_shardings(params, mesh)
     params = jax.device_put(params, shardings)
-    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    compute_dtype = common.compute_dtype_from_args(args)
 
     def loss_fn(params_t, _unused, mb):
         logits = gpt2.forward(config, params_t, mb["input_ids"],

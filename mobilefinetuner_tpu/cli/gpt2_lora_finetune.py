@@ -25,11 +25,11 @@ Usage (tiny smoke):
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import os
 import sys
 
 import jax
-import jax.numpy as jnp
 
 from mobilefinetuner_tpu.cli import common
 from mobilefinetuner_tpu.core.logging import get_logger
@@ -42,7 +42,6 @@ from mobilefinetuner_tpu.lora.lora import (LoRASpec, init_lora_gpt2,
 from mobilefinetuner_tpu.models import gpt2
 from mobilefinetuner_tpu.ops.loss import lm_cross_entropy_sum
 from mobilefinetuner_tpu.optim import adam as adam_mod
-from mobilefinetuner_tpu.train.trainer import init_optimizer
 
 log = get_logger()
 
@@ -80,6 +79,8 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
     config, params = load_gpt2(args.pretrained_dir)
+    config = dataclasses.replace(
+        config, attention_impl=args.attention_impl)
     if args.seq_len > config.n_positions:
         log.warning(f"seq_len({args.seq_len}) > n_positions"
                     f"({config.n_positions}), clamped")
@@ -88,8 +89,6 @@ def main(argv=None) -> int:
              f"heads={config.n_head}")
 
     # LoRA: fresh init or resume (main.cpp:340-400)
-    start_step = 0
-    opt_state = None
     if args.resume_from:
         lora, spec = peft_io.load_adapter(args.resume_from)
         log.info(f"resumed adapter: r={spec.rank} alpha={spec.alpha} "
@@ -121,16 +120,12 @@ def main(argv=None) -> int:
     log.info(f"{train_ds.num_chunks} chunks, {steps_per_epoch} steps/epoch, "
              f"{total_steps} total steps")
 
-    if args.resume_from and os.path.exists(args.resume_from + ".opt"):
-        template = init_optimizer(lora, tc, mask)
-        opt_state, _ = adam_mod.load_state(args.resume_from + ".opt",
-                                           template)
-        start_step = int(opt_state["step"])
-        log.info(f"restored optimizer state @ step {start_step}")
+    opt_state, start_step = common.maybe_resume_opt_state(
+        args, lora, tc, mask)
 
     mesh = common.build_mesh(args)
     params, fetch_fn = common.setup_frozen_params(args, params, mesh)
-    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    compute_dtype = common.compute_dtype_from_args(args)
     base_rng = (jax.random.PRNGKey(args.seed + 1)
                 if args.lora_dropout > 0 else None)
 

@@ -20,14 +20,13 @@ Usage (tiny smoke):
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import os
 import sys
 
 import jax
-import jax.numpy as jnp
 
 from mobilefinetuner_tpu.cli import common
-from mobilefinetuner_tpu.core.config import Gemma3TextConfig
 from mobilefinetuner_tpu.core.logging import get_logger
 from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
 from mobilefinetuner_tpu.data.wikitext2 import WT2Config, WikiText2Dataset
@@ -39,7 +38,6 @@ from mobilefinetuner_tpu.lora.lora import (GEMMA_PRESETS, LoRASpec,
 from mobilefinetuner_tpu.models import gemma3
 from mobilefinetuner_tpu.ops.loss import chunked_lm_cross_entropy_sum
 from mobilefinetuner_tpu.optim import adam as adam_mod
-from mobilefinetuner_tpu.train.trainer import init_optimizer
 
 log = get_logger()
 
@@ -93,13 +91,13 @@ def main(argv=None) -> int:
         args.steps = args.max_steps
 
     config, params = load_gemma3(args.model_dir)
+    config = dataclasses.replace(
+        config, attention_impl=args.attention_impl)
     log.info(f"Gemma-3: layers={config.num_hidden_layers} "
              f"hidden={config.hidden_size} vocab={config.vocab_size} "
              f"q/kv heads={config.num_attention_heads}/"
              f"{config.num_key_value_heads}")
 
-    start_step = 0
-    opt_state = None
     if args.resume_from:
         lora, spec = peft_io.load_adapter(args.resume_from)
         log.info(f"resumed adapter: r={spec.rank} targets={spec.targets}")
@@ -133,16 +131,12 @@ def main(argv=None) -> int:
     tc = common.train_config_from_args(args, total_steps)
     log.info(f"{train_ds.num_chunks} chunks, {total_steps} total steps")
 
-    if args.resume_from and os.path.exists(args.resume_from + ".opt"):
-        template = init_optimizer(lora, tc, mask)
-        opt_state, _ = adam_mod.load_state(args.resume_from + ".opt",
-                                           template)
-        start_step = int(opt_state["step"])
-        log.info(f"restored optimizer state @ step {start_step}")
+    opt_state, start_step = common.maybe_resume_opt_state(
+        args, lora, tc, mask)
 
     mesh = common.build_mesh(args)
     params, fetch_fn = common.setup_frozen_params(args, params, mesh)
-    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    compute_dtype = common.compute_dtype_from_args(args)
     base_rng = (jax.random.PRNGKey(args.seed + 1)
                 if args.lora_dropout > 0 else None)
 

@@ -205,9 +205,11 @@ class WikiText2Dataset:
             return self.num_chunks // b
         return (self.num_chunks + b - 1) // b
 
-    def epoch(self, epoch: Optional[int] = None) -> Iterator[dict]:
+    def epoch(self, epoch: Optional[int] = None,
+              start_batch: int = 0) -> Iterator[dict]:
         """Yield batches for one epoch; chunk order reshuffled per epoch
-        from (seed, epoch)."""
+        from (seed, epoch). start_batch skips ahead without building the
+        skipped batches (checkpoint-resume fast-forward)."""
         if epoch is None:
             epoch = self._epoch
             self._epoch += 1
@@ -233,7 +235,7 @@ class WikiText2Dataset:
                 rng.shuffle(order)
         b = self.config.batch_size
         nb = self.num_batches()
-        for bi in range(nb):
+        for bi in range(start_batch, nb):
             idxs = order[bi * b:(bi + 1) * b]
             rows = [self.chunk(int(i)) for i in idxs]
             yield {

@@ -122,11 +122,27 @@ def _block(c: Gemma3TextConfig, bp, x, padding_mask, masks, ropes,
     sin = jnp.where(is_global[i], ropes["sin_g"], ropes["sin_l"])
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    mask = jnp.where(is_global[i], masks["global"], masks["local"])
-    ctx = attention(q, k, v, impl=c.attention_impl,
-                    scale=c.query_pre_attn_scalar ** -0.5,
-                    is_causal=False, attn_mask=mask,
-                    padding_mask=padding_mask)
+    scale = c.query_pre_attn_scalar ** -0.5
+    if c.attention_impl == "flash":
+        # The Pallas kernel takes causal/sliding-window as STATIC config,
+        # not a mask matrix; under the layer scan the global/local choice is
+        # a traced bool, so branch with lax.cond (each branch compiles its
+        # own kernel variant).
+        ctx = jax.lax.cond(
+            is_global[i],
+            lambda ops: attention(*ops, impl="flash", scale=scale,
+                                  is_causal=True,
+                                  padding_mask=padding_mask),
+            lambda ops: attention(*ops, impl="flash", scale=scale,
+                                  is_causal=True,
+                                  sliding_window=c.sliding_window,
+                                  padding_mask=padding_mask),
+            (q, k, v))
+    else:
+        mask = jnp.where(is_global[i], masks["global"], masks["local"])
+        ctx = attention(q, k, v, impl=c.attention_impl, scale=scale,
+                        is_causal=False, attn_mask=mask,
+                        padding_mask=padding_mask)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, nq * D)
     attn_out = lora(ctx @ g(a["o_w"]), ctx, "o_proj", 3)
     attn_out = rms_norm(attn_out, g(bp["post_attn_ln"]), eps)

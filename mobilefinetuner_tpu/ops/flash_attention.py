@@ -1,0 +1,282 @@
+"""Pallas TPU flash attention: fused, O(S) HBM, differentiable.
+
+Replaces the reference's forward-only streaming-softmax attention
+(reference: core/memory_efficient_attention.{h,cpp} — FlashAttention-style
+two-pass row streaming, scalar loops, registers NO backward node, SURVEY.md
+§2.12.1) with a TPU-native block kernel that IS differentiable: a
+custom_vjp whose forward saves only (out, logsumexp) and whose backward
+recomputes probabilities blockwise — activation memory stays O(B·H·S·D),
+never O(B·H·S²), in HBM.
+
+Design (sized for the fine-tuning regime S ≤ ~2k, D ≤ 256):
+  - grid (B, Hq, S/BQ); each program computes one [BQ, D] query block;
+  - K/V for the (batch, kv-head) live whole in VMEM (S·D·4B ≤ ~2 MB at
+    S=2048 D=256), so scores are one [BQ, S] MXU matmul — no inner online-
+    softmax loop; [BQ, S] fp32 stays in VMEM and never reaches HBM;
+  - GQA by BlockSpec index mapping: q-head h reads kv-head h // group —
+    K/V are never materialized per-q-head (the reference materializes via
+    repeat_kv_heads, core/ops.cpp:2072);
+  - causal + sliding-window + key-padding masks built from broadcasted
+    iotas inside the kernel;
+  - backward: one kernel per (b, h, q-block) computing dQ and accumulating
+    dK/dV into revisited output blocks across the sequential ("arbitrary")
+    grid dims — the standard dS = P∘(dO·Vᵀ − Δ) recomputation with the
+    saved logsumexp.
+
+For shapes the kernel doesn't support (S not a multiple of the block, tiny
+D), ops/attention.py's XLA path is the fallback — same numerics, same mask
+semantics (it is the oracle the kernel is tested against).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    """Pallas interpret mode off-TPU (CPU test mesh, SURVEY.md §4.6)."""
+    return jax.default_backend() != "tpu"
+
+
+# --------------------------------- forward ----------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, pad_ref, o_ref, lse_ref, *,
+                scale, block_q, causal, window, S):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)           # [BQ, D]
+    k = k_ref[0, 0].astype(jnp.float32)           # [S, D]
+    v = v_ref[0, 0].astype(jnp.float32)           # [S, D]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+
+    rows = (jax.lax.broadcasted_iota(jnp.int32, (block_q, S), 0)
+            + qi * block_q)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, S), 1)
+    mask = jnp.ones((block_q, S), jnp.bool_)
+    if causal:
+        mask &= cols <= rows
+    if window is not None:
+        mask &= cols > rows - window
+    mask &= pad_ref[0] > 0                         # key padding [1, S]
+    s = jnp.where(mask, s, NEG_INF)
+
+    m = jnp.max(s, axis=-1, keepdims=True)         # [BQ, 1]
+    p = jnp.exp(s - m)
+    p = jnp.where(mask, p, 0.0)                    # exp(NEG_INF-m) underflow
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l_safe = jnp.maximum(l, 1e-30)
+    o = jax.lax.dot_general(p / l_safe, v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l_safe)            # [BQ, 1]
+
+
+def _fwd(q, k, v, padding_mask, *, scale, causal, window, block_q):
+    B, Hq, S, D = q.shape
+    Hkv = k.shape[1]
+    G = Hq // Hkv
+    grid = (B, Hq, S // block_q)
+    pad3 = padding_mask.reshape(B, 1, S)
+    kernel = functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
+                               causal=causal, window=window, S=S)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // G, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // G, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, Hq, S, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel")),
+        interpret=_interpret(),
+    )(q, k, v, pad3)
+    return out, lse
+
+
+# --------------------------------- backward ---------------------------------
+
+def _bwd_kernel(q_ref, k_ref, v_ref, pad_ref, o_ref, lse_ref, do_ref,
+                dq_ref, dk_ref, dv_ref, *, scale, block_q, causal, window,
+                S, G):
+    h = pl.program_id(1)
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)            # [BQ, D]
+    k = k_ref[0, 0].astype(jnp.float32)            # [S, D]
+    v = v_ref[0, 0].astype(jnp.float32)
+    o = o_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]                            # [BQ, 1]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    rows = (jax.lax.broadcasted_iota(jnp.int32, (block_q, S), 0)
+            + qi * block_q)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, S), 1)
+    mask = jnp.ones((block_q, S), jnp.bool_)
+    if causal:
+        mask &= cols <= rows
+    if window is not None:
+        mask &= cols > rows - window
+    mask &= pad_ref[0] > 0
+    p = jnp.where(mask, jnp.exp(s - lse), 0.0)             # [BQ, S]
+
+    delta = jnp.sum(do * o, axis=-1, keepdims=True)        # [BQ, 1]
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale                          # [BQ, S]
+
+    dq = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+    dk = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # [S, D]
+    dv = jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+
+    # dK/dV accumulate across the G q-heads of this kv-head and the q
+    # blocks; first visit initializes.
+    @pl.when(jnp.logical_and(h % G == 0, qi == 0))
+    def _init():
+        dk_ref[0, 0] = jnp.zeros_like(dk_ref[0, 0])
+        dv_ref[0, 0] = jnp.zeros_like(dv_ref[0, 0])
+
+    dk_ref[0, 0] += dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] += dv.astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, window, block_q, res, g):
+    q, k, v, padding_mask, out, lse = res
+    do = g[0]  # cotangent of (out, lse); lse cotangent unused
+    B, Hq, S, D = q.shape
+    Hkv = k.shape[1]
+    G = Hq // Hkv
+    grid = (B, Hq, S // block_q)
+    pad3 = padding_mask.reshape(B, 1, S)
+    kernel = functools.partial(_bwd_kernel, scale=scale, block_q=block_q,
+                               causal=causal, window=window, S=S, G=G)
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // G, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // G, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // G, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // G, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, S, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hkv, S, D), jnp.float32),
+        ],
+        # h and q-block dims revisit dK/dV blocks -> must run sequentially
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v, pad3, out, lse, do)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype), None
+
+
+# ------------------------------- public API ---------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, padding_mask, scale, causal, window, block_q):
+    out, _ = _fwd(q, k, v, padding_mask, scale=scale, causal=causal,
+                  window=window, block_q=block_q)
+    return out
+
+
+def _flash_fwd(q, k, v, padding_mask, scale, causal, window, block_q):
+    out, lse = _fwd(q, k, v, padding_mask, scale=scale, causal=causal,
+                    window=window, block_q=block_q)
+    return out, (q, k, v, padding_mask, out, lse)
+
+
+def _flash_bwd(scale, causal, window, block_q, res, g):
+    return _bwd(scale, causal, window, block_q, res, (g,))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *,
+                    scale: Optional[float] = None,
+                    is_causal: bool = True,
+                    sliding_window: Optional[int] = None,
+                    padding_mask: Optional[jnp.ndarray] = None,
+                    attn_mask: Optional[jnp.ndarray] = None,
+                    logits_dtype=jnp.float32,
+                    block_q: int = 128) -> jnp.ndarray:
+    """Drop-in for ops.attention.dot_product_attention (same signature).
+
+    attn_mask (a precomputed [S, S] matrix) has no blockwise structure the
+    kernel can exploit, so that case falls back to the XLA path — model code
+    passes is_causal/sliding_window instead (gemma3 selects masks per layer
+    by flags, not matrices, when using the flash impl).
+    """
+    from mobilefinetuner_tpu.ops.attention import dot_product_attention
+    B, Hq, S, D = q.shape
+    if (attn_mask is not None or S % block_q != 0
+            or D not in (64, 128, 256)):
+        return dot_product_attention(
+            q, k, v, scale=scale, is_causal=is_causal,
+            sliding_window=sliding_window, padding_mask=padding_mask,
+            attn_mask=attn_mask, logits_dtype=logits_dtype)
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if padding_mask is None:
+        pad = jnp.ones((B, S), jnp.float32)
+    else:
+        pad = padding_mask.astype(jnp.float32)
+    return _flash(q, k, v, pad, float(scale), bool(is_causal),
+                  None if sliding_window is None else int(sliding_window),
+                  int(block_q))

@@ -66,6 +66,21 @@ def test_gpt2_lora_resume_restores_step(gpt2_dir, wiki_dir, tmp_path):
     assert int(step) == 4
 
 
+def test_micro_batches_resume_continues_data_order(wiki_dir):
+    """A resumed stream must continue where the interrupted one stopped,
+    not replay epoch 0 (data-replay regression)."""
+    from mobilefinetuner_tpu.cli.common import micro_batches
+    from mobilefinetuner_tpu.data.wikitext2 import WT2Config, WikiText2Dataset
+    enc = lambda s: [ord(c) % 97 for c in s][:20]
+    cfg = WT2Config(seq_len=16, batch_size=2, seed=7)
+    mk = lambda: WikiText2Dataset(wiki_dir, "train", cfg, enc, 96)
+    full = [b for _, b in zip(range(8), micro_batches(mk(), 2))]
+    resumed = [b for _, b in zip(range(3), micro_batches(mk(), 2,
+                                                         skip_steps=5))]
+    for a, b in zip(full[5:], resumed):
+        np.testing.assert_array_equal(a["input_ids"], b["input_ids"])
+
+
 def test_gpt2_lora_checkpoint_suffix(gpt2_dir, wiki_dir, tmp_path):
     from mobilefinetuner_tpu.cli.gpt2_lora_finetune import main
     out = str(tmp_path / "a.safetensors")

@@ -1,0 +1,180 @@
+"""Pallas flash-attention kernel vs the XLA oracle (ops/attention.py) —
+forward AND backward (the reference's kernel is forward-only, SURVEY.md
+§2.12.1; ours must match the oracle's gradients too). Runs in Pallas
+interpret mode on the CPU test mesh."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mobilefinetuner_tpu.ops.attention import dot_product_attention
+from mobilefinetuner_tpu.ops.flash_attention import flash_attention
+
+
+def make_qkv(key, B=2, Hq=4, Hkv=2, S=128, D=64, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    q = jax.random.normal(k1, (B, Hq, S, D), dtype)
+    k = jax.random.normal(k2, (B, Hkv, S, D), dtype)
+    v = jax.random.normal(k3, (B, Hkv, S, D), dtype)
+    return q, k, v
+
+
+CASES = [
+    dict(),                                   # causal, MHA-as-GQA
+    dict(sliding_window=32),                  # local attention
+    dict(Hkv=1),                              # extreme GQA (Gemma-270M)
+    dict(scale=0.25),                         # explicit scale
+    dict(D=128),
+    dict(S=256),                              # multi-q-block grid (qi > 0)
+    dict(S=256, Hkv=1, sliding_window=64),    # multi-block + GQA + window
+]
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_forward_matches_oracle(case):
+    case = dict(case)
+    kw = {k: case.pop(k) for k in ("sliding_window", "scale")
+          if k in case}
+    q, k, v = make_qkv(jax.random.PRNGKey(0), **case)
+    ours = flash_attention(q, k, v, is_causal=True, **kw)
+    ref = dot_product_attention(q, k, v, is_causal=True, **kw)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_forward_with_padding_mask():
+    q, k, v = make_qkv(jax.random.PRNGKey(1))
+    B, S = q.shape[0], q.shape[2]
+    pad = np.ones((B, S), np.float32)
+    pad[0, 100:] = 0.0
+    pad[1, 64:] = 0.0
+    pad = jnp.asarray(pad)
+    ours = flash_attention(q, k, v, padding_mask=pad)
+    ref = dot_product_attention(q, k, v, padding_mask=pad)
+    # compare only valid query rows (padded queries are don't-cares and the
+    # ref puts uniform-softmax garbage there; ours puts zeros)
+    np.testing.assert_allclose(np.asarray(ours)[0, :, :100],
+                               np.asarray(ref)[0, :, :100],
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(ours)[1, :, :64],
+                               np.asarray(ref)[1, :, :64],
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("case", [dict(), dict(sliding_window=32),
+                                  dict(Hkv=1),
+                                  # multi-q-block: exercises the qi>0 row
+                                  # offsets and the dK/dV accumulation
+                                  # across q blocks and GQA group heads
+                                  dict(S=256, Hkv=2),
+                                  dict(S=256, Hkv=1, sliding_window=64)])
+def test_gradients_match_oracle(case):
+    case = dict(case)
+    kw = {k: case.pop(k) for k in ("sliding_window",) if k in case}
+    q, k, v = make_qkv(jax.random.PRNGKey(2), **case)
+
+    def loss(fn, q, k, v):
+        out = fn(q, k, v, is_causal=True, **kw)
+        return jnp.sum(out * jnp.cos(out))  # nontrivial cotangent
+
+    g_ours = jax.grad(functools.partial(loss, flash_attention),
+                      argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(functools.partial(loss, dot_product_attention),
+                     argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_ours, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5, err_msg=name)
+
+
+def test_gradients_with_padding_mask():
+    q, k, v = make_qkv(jax.random.PRNGKey(3))
+    B, S = q.shape[0], q.shape[2]
+    pad = np.ones((B, S), np.float32)
+    pad[:, 96:] = 0.0
+    pad = jnp.asarray(pad)
+    valid = pad.astype(bool)[:, None, :, None]
+
+    def loss(fn, q, k, v):
+        out = fn(q, k, v, is_causal=True, padding_mask=pad)
+        return jnp.sum(jnp.where(valid, out, 0.0) ** 2)
+
+    g_ours = jax.grad(functools.partial(loss, flash_attention),
+                      argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(functools.partial(loss, dot_product_attention),
+                     argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_ours, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5, err_msg=name)
+
+
+def test_unsupported_shapes_fall_back():
+    # S=100 not a block multiple; D=8 unsupported -> XLA path, still correct
+    q, k, v = make_qkv(jax.random.PRNGKey(4), S=100, D=8)
+    ours = flash_attention(q, k, v, is_causal=True)
+    ref = dot_product_attention(q, k, v, is_causal=True)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_attention_dispatcher_flash():
+    from mobilefinetuner_tpu.ops.attention import attention
+    q, k, v = make_qkv(jax.random.PRNGKey(5))
+    out = attention(q, k, v, impl="flash", is_causal=True)
+    ref = attention(q, k, v, impl="xla", is_causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_gpt2_model_flash_matches_xla():
+    """Whole-model parity: GPT-2 forward with attention_impl='flash' equals
+    the XLA path (flash-eligible head_dim=64)."""
+    import dataclasses
+    from mobilefinetuner_tpu.core.config import GPT2Config
+    from mobilefinetuner_tpu.models import gpt2
+    cfg = dataclasses.replace(GPT2Config.tiny(vocab_size=512),
+                              n_embd=128, n_head=2, n_positions=128)
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0, 512)
+    ref = gpt2.forward(cfg, params, ids)
+    cfg_f = dataclasses.replace(cfg, attention_impl="flash")
+    out = gpt2.forward(cfg_f, params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_gemma3_model_flash_matches_xla():
+    """Gemma: the flash path must reproduce the per-layer global/local mask
+    interleave (lax.cond branch) including sliding-window layers."""
+    import dataclasses
+    from mobilefinetuner_tpu.core.config import Gemma3TextConfig
+    from mobilefinetuner_tpu.models import gemma3
+    cfg = Gemma3TextConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=2, num_key_value_heads=1,
+        head_dim=64, max_position_embeddings=256, sliding_window=32,
+        query_pre_attn_scalar=64.0, sliding_window_pattern=3)
+    params = gemma3.init_params(cfg, jax.random.PRNGKey(0))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0, 512)
+    mask = jnp.ones((2, 128))
+    ref = gemma3.forward(cfg, params, ids, attention_mask=mask)
+    cfg_f = dataclasses.replace(cfg, attention_impl="flash")
+    out = gemma3.forward(cfg_f, params, ids, attention_mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_flash_under_jit_and_scan():
+    """The kernel must trace under jit (model stacks run it inside
+    lax.scan)."""
+    q, k, v = make_qkv(jax.random.PRNGKey(6))
+
+    @jax.jit
+    def f(q, k, v):
+        return flash_attention(q, k, v, is_causal=True, sliding_window=64)
+
+    ref = dot_product_attention(q, k, v, is_causal=True, sliding_window=64)
+    np.testing.assert_allclose(np.asarray(f(q, k, v)), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

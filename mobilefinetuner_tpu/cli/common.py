@@ -133,7 +133,10 @@ def add_mesh_flags(p: argparse.ArgumentParser):
 def governor_from_args(args) -> StepGovernor:
     cfg = GovernorConfig(
         enable=args.pm_interval > 0 or bool(args.pm_schedule),
-        check_interval_steps=max(args.pm_interval, 1),
+        # 0 = telemetry disabled: a schedule-only run stays full speed on
+        # uncovered steps; pm_interval > 0 makes uncovered steps fall
+        # through to the telemetry policy (reference PowerMonitor).
+        check_interval_steps=args.pm_interval,
         battery_threshold=args.pm_batt_thresh,
         temp_threshold=args.pm_temp_thresh,
         freq_batt_high=args.pm_fb_high,
@@ -201,7 +204,12 @@ def micro_batches(dataset: WikiText2Dataset, accum: int,
     already consumed, WITHOUT building them — a resumed run continues the
     exact data order of an uninterrupted one (same seed => same per-epoch
     shuffles) instead of replaying epoch 0 from the top."""
-    nb = max(dataset.num_batches(), 1)
+    nb = dataset.num_batches()
+    if nb == 0:
+        raise ValueError(
+            "dataset yields zero batches (num_chunks < batch_size with "
+            "drop_last=True — seq_len/batch_size too large or "
+            "--data_fraction too small for this split)")
     # the stream is continuous across epochs (a partial accumulation at an
     # epoch boundary carries into the next epoch), so step s consumes
     # micro-batches [s*accum, (s+1)*accum) of the concatenated stream
@@ -313,8 +321,54 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     t_start = time.time()
     metrics = {}
     epoch = 0
+
+    # Per-step metrics stay on device; they are buffered and pulled to host
+    # in ONE device_get per log boundary. An unconditional per-step
+    # float(loss) would sync the dispatch queue every step and serialize
+    # the pipeline (the reference has no such concern: it is synchronous
+    # CPU code; on TPU async dispatch is the throughput lever).
+    buffered = []  # [(step, epoch, tokens, device_metrics), ...]
+    t_interval = time.perf_counter()
+    slept_ms = 0.0  # governor sleep inside the interval, excluded from dt
+    # flush cadence: the log interval; if step logging is off but a CSV was
+    # requested, flush every 50 steps so rows survive a crash; 1000-step
+    # hard cap bounds the device-metrics buffer in all cases.
+    flush_every = (min(args.log_interval, 1000) if args.log_interval
+                   else (50 if metrics_csv else 1000))
+
+    def flush_metrics(emit_log=True):
+        """One host sync for everything buffered since the last flush.
+        Rows in a flush share the interval-averaged step_time_ms (per-step
+        wall time under async dispatch measures only dispatch latency, so
+        the average over a synced interval is the honest number)."""
+        nonlocal t_interval, slept_ms
+        if not buffered:
+            return
+        fetched = jax.device_get([m for _, _, _, m in buffered])
+        dt_ms = ((time.perf_counter() - t_interval) * 1000 - slept_ms) \
+            / len(buffered)
+        for (s, ep, toks, _), m in zip(buffered, fetched):
+            loss = float(m["loss"])
+            avg = ema.update(loss)
+            if metrics_csv:
+                metrics_csv.log(epoch=ep, step=s + 1, loss=loss,
+                                avg_loss=avg, lr=float(m["lr"]),
+                                step_time_ms=dt_ms)
+        s, ep, toks, _ = buffered[-1]
+        m = fetched[-1]
+        if emit_log and args.log_interval:
+            log.info(
+                f"step {s + 1}/{total_steps} loss={float(m['loss']):.4f} "
+                f"ema={ema.value:.4f} "
+                f"ppl={perplexity_from_loss(float(m['loss'])):.2f} "
+                f"grad_norm={float(m['grad_norm']):.3f} "
+                f"lr={float(m['lr']):.2e} "
+                f"{toks / (dt_ms / 1000):.0f} tok/s")
+        buffered.clear()
+        slept_ms = 0.0
+        t_interval = time.perf_counter()
+
     for step in range(start_step, total_steps):
-        t0 = time.perf_counter()
         epoch, batch = next(batches)
         if dropout_rng is not None:
             n = batch["input_ids"].shape[0]
@@ -324,25 +378,14 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
             batch = shard_batch(batch, mesh)
         trainable, opt_state, metrics = step_fn(
             trainable, frozen, opt_state, batch, jnp.int32(step))
-        loss = float(metrics["loss"])  # host sync point
-        dt_ms = (time.perf_counter() - t0) * 1000
-        avg = ema.update(loss)
-
-        if args.log_interval and (step + 1) % args.log_interval == 0:
-            toks = batch["input_ids"].shape[0] * batch["input_ids"].shape[1]
-            log.info(
-                f"step {step + 1}/{total_steps} loss={loss:.4f} "
-                f"ema={avg:.4f} ppl={perplexity_from_loss(loss):.2f} "
-                f"grad_norm={float(metrics['grad_norm']):.3f} "
-                f"lr={float(metrics['lr']):.2e} "
-                f"{toks / (dt_ms / 1000):.0f} tok/s")
-        if metrics_csv:
-            metrics_csv.log(epoch=epoch, step=step + 1, loss=loss,
-                            avg_loss=avg, lr=float(metrics["lr"]),
-                            step_time_ms=dt_ms)
+        toks = batch["input_ids"].shape[0] * batch["input_ids"].shape[1]
+        buffered.append((step, epoch, toks, metrics))
+        if (step + 1) % flush_every == 0:
+            flush_metrics()
 
         if (args.eval_interval and valid_ds is not None
                 and (step + 1) % args.eval_interval == 0):
+            flush_metrics()
             ev = evaluate(eval_step, trainable, frozen, valid_ds,
                           args.eval_batches)
             log.info(f"eval @ step {step + 1}: loss={ev['loss']:.4f} "
@@ -352,13 +395,17 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                                   "loss": ev["loss"], "ppl": ev["ppl"],
                                   "tokens": ev["tokens"],
                                   "time": time.time() - t_start})
+            t_interval = time.perf_counter()  # eval time is not step time
 
         if args.save_every and save_hook and (step + 1) % args.save_every \
                 == 0 and (step + 1) < total_steps:
+            flush_metrics()
             save_hook(step + 1, trainable, opt_state, final=False)
+            t_interval = time.perf_counter()  # save time is not step time
 
-        governor.throttle(step)
+        slept_ms += governor.throttle(step)
 
+    flush_metrics()
     if valid_ds is not None and args.eval_interval:
         ev = evaluate(eval_step, trainable, frozen, valid_ds,
                       args.eval_batches)

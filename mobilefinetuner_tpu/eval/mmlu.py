@@ -109,12 +109,20 @@ def read_mmlu_csv(path: str) -> List[MCQItem]:
         return []
     first = parse_csv_line(lines[0])
     lowered = [c.strip().lower() for c in first]
-    headered = "question" in lowered and "answer" in lowered
+    required = ("question", "a", "b", "c", "d", "answer")
+    # header detection needs BOTH marker columns (a lone 'answer' cell in a
+    # headerless data row must not trigger it); a detected header must then
+    # carry every required column or the file is malformed.
+    looks_headered = "question" in lowered and "answer" in lowered
+    headered = looks_headered and all(n in lowered for n in required)
+    if looks_headered and not headered:
+        missing = [n for n in required if n not in lowered]
+        raise ValueError(
+            f"{path}: headered MMLU CSV is missing column(s) "
+            f"{missing}; need all of {list(required)}")
     items: List[MCQItem] = []
     if headered:
-        idx = {name: lowered.index(name) for name in
-               ("question", "a", "b", "c", "d", "answer")
-               if name in lowered}
+        idx = {name: lowered.index(name) for name in required}
         subj_idx = lowered.index("subject") if "subject" in lowered else None
         rows = lines[1:]
         for line in rows:

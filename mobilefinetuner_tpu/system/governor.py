@@ -75,7 +75,7 @@ def parse_schedule(spec: str) -> List[StepSleep]:
 class GovernorConfig:
     """Mirror of the reference PowerConfig (power_monitor.h:20-35)."""
     enable: bool = False
-    check_interval_steps: int = 10
+    check_interval_steps: int = 10    # <= 0 disables the telemetry policy
     battery_threshold: float = 20.0   # percent
     temp_threshold: float = 40.0      # celsius
     freq_batt_high: float = 10.0      # steps/sec when battery healthy
@@ -145,8 +145,13 @@ class StepGovernor:
         for rng in self._schedule:  # schedule overrides telemetry
             if rng.covers(step):
                 return min(rng.sleep_ms, MAX_SLEEP_MS)
-        if self._schedule:
-            return 0.0  # explicit schedule, step uncovered -> full speed
+        # Uncovered steps fall through to the telemetry policy (the
+        # reference PowerMonitor does the same, power_monitor.cpp
+        # suggest_sleep_ms), so --pm_schedule composes with --pm_interval.
+        # check_interval_steps <= 0 disables telemetry entirely, so a
+        # schedule-only config runs uncovered steps at full speed.
+        if self.config.check_interval_steps <= 0:
+            return 0.0
         k = max(self.config.check_interval_steps, 1)
         if (self._last_check_step is None
                 or step - self._last_check_step >= k):

@@ -74,9 +74,9 @@ def test_micro_batches_resume_continues_data_order(wiki_dir):
     enc = lambda s: [ord(c) % 97 for c in s][:20]
     cfg = WT2Config(seq_len=16, batch_size=2, seed=7)
     mk = lambda: WikiText2Dataset(wiki_dir, "train", cfg, enc, 96)
-    full = [b for _, b in zip(range(8), micro_batches(mk(), 2))]
-    resumed = [b for _, b in zip(range(3), micro_batches(mk(), 2,
-                                                         skip_steps=5))]
+    full = [b for _, (_, b) in zip(range(8), micro_batches(mk(), 2))]
+    resumed = [b for _, (_, b) in zip(range(3), micro_batches(mk(), 2,
+                                                              skip_steps=5))]
     for a, b in zip(full[5:], resumed):
         np.testing.assert_array_equal(a["input_ids"], b["input_ids"])
 

@@ -118,6 +118,13 @@ def add_shard_flags(p: argparse.ArgumentParser):
     g.add_argument("--shard_fp16_disk", type=int, default=1,
                    help="1 = store offloaded params as bf16 (TPU-idiomatic "
                         "16-bit; analog of fp16-on-disk quantization)")
+    g.add_argument("--shard_stream", type=int, default=1,
+                   help="1 = stream offloaded block weights host->HBM one "
+                        "layer at a time inside the layer scan (bounds peak "
+                        "HBM like the reference's per-layer require(), "
+                        "parameter_sharder.cpp:242-271); 0 = whole-tree "
+                        "fetch per step (budget governs idle placement "
+                        "only)")
 
 
 def add_mesh_flags(p: argparse.ArgumentParser):
@@ -381,11 +388,14 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
         toks = batch["input_ids"].shape[0] * batch["input_ids"].shape[1]
         buffered.append((step, epoch, toks, metrics))
         if (step + 1) % flush_every == 0:
-            flush_metrics()
+            # a capped flush (flush_every < log_interval) only writes CSV
+            # rows; the log line keeps the requested cadence
+            flush_metrics(emit_log=bool(args.log_interval)
+                          and (step + 1) % args.log_interval == 0)
 
         if (args.eval_interval and valid_ds is not None
                 and (step + 1) % args.eval_interval == 0):
-            flush_metrics()
+            flush_metrics(emit_log=False)  # off-cadence boundary flush
             ev = evaluate(eval_step, trainable, frozen, valid_ds,
                           args.eval_batches)
             log.info(f"eval @ step {step + 1}: loss={ev['loss']:.4f} "
@@ -399,7 +409,7 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
 
         if args.save_every and save_hook and (step + 1) % args.save_every \
                 == 0 and (step + 1) < total_steps:
-            flush_metrics()
+            flush_metrics(emit_log=False)  # off-cadence boundary flush
             save_hook(step + 1, trainable, opt_state, final=False)
             t_interval = time.perf_counter()  # save time is not step time
 
@@ -423,8 +433,16 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
 
 def setup_frozen_params(args, params, mesh):
     """Place frozen base params: FSDP shardings + optional host offload.
-    Returns (placed_params, fetch_fn) where fetch_fn is applied inside the
-    jitted loss to pull offloaded leaves back to device memory."""
+
+    Returns (placed_params, fetch_fn, offload_arg):
+      - fetch_fn pulls ALL offloaded leaves to device at once (the
+        --shard_stream 0 path: fast, but the whole fetched tree is
+        HBM-resident for the step);
+      - offload_arg is the (plan, shardings) pair the model forwards accept
+        to stream block weights per layer instead (default; the budget then
+        bounds peak HBM, not just idle placement). None when offload is
+        disabled or streaming is turned off.
+    """
     shardings = params_shardings(params, mesh)
     ocfg = offload_config_from_args(args)
     plan = plan_placement(params, ocfg)
@@ -435,9 +453,13 @@ def setup_frozen_params(args, params, mesh):
             f"offload: {stats['n_offloaded']} params "
             f"({stats['offloaded_bytes'] / 2**20:.0f} MB) -> host RAM, "
             f"{stats['resident_bytes'] / 2**20:.0f} MB resident "
-            f"(budget {args.shard_budget_mb} MB)")
+            f"(budget {args.shard_budget_mb} MB, "
+            f"stream={'on' if getattr(args, 'shard_stream', 1) else 'off'})")
 
     def fetch_fn(p):
         return fetch(p, plan, shardings, compute_dtype=None)
 
-    return placed, fetch_fn
+    offload_arg = ((plan, shardings)
+                   if ocfg.enable and getattr(args, "shard_stream", 1)
+                   else None)
+    return placed, fetch_fn, offload_arg

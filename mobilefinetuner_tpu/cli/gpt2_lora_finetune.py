@@ -124,7 +124,8 @@ def main(argv=None) -> int:
         args, lora, tc, mask)
 
     mesh = common.build_mesh(args)
-    params, fetch_fn = common.setup_frozen_params(args, params, mesh)
+    params, fetch_fn, offload_arg = common.setup_frozen_params(
+        args, params, mesh)
     compute_dtype = common.compute_dtype_from_args(args)
     base_rng = (jax.random.PRNGKey(args.seed + 1)
                 if args.lora_dropout > 0 else None)
@@ -132,18 +133,21 @@ def main(argv=None) -> int:
     def loss_fn(lora_t, frozen, mb):
         # per-(step, micro-batch) dropout key, threaded via the batch
         rng = mb["dropout_rng"][0] if "dropout_rng" in mb else None
-        logits = gpt2.forward(config, fetch_fn(frozen), mb["input_ids"],
+        p = frozen if offload_arg is not None else fetch_fn(frozen)
+        logits = gpt2.forward(config, p, mb["input_ids"],
                               attention_mask=mb["attention_mask"],
                               lora=lora_t, compute_dtype=compute_dtype,
-                              remat=args.remat,
+                              remat=args.remat, offload=offload_arg,
                               lora_dropout=args.lora_dropout,
                               dropout_rng=rng)
         return lm_cross_entropy_sum(logits, mb["labels"])
 
     def nll_fn(lora_t, frozen, mb):
-        logits = gpt2.forward(config, fetch_fn(frozen), mb["input_ids"],
+        p = frozen if offload_arg is not None else fetch_fn(frozen)
+        logits = gpt2.forward(config, p, mb["input_ids"],
                               attention_mask=mb["attention_mask"],
-                              lora=lora_t, compute_dtype=compute_dtype)
+                              lora=lora_t, compute_dtype=compute_dtype,
+                              offload=offload_arg)
         return lm_cross_entropy_sum(logits, mb["labels"])
 
     def save_hook(step, lora_t, opt_st, final):

@@ -135,30 +135,41 @@ def main(argv=None) -> int:
         args, lora, tc, mask)
 
     mesh = common.build_mesh(args)
-    params, fetch_fn = common.setup_frozen_params(args, params, mesh)
+    params, fetch_fn, offload_arg = common.setup_frozen_params(
+        args, params, mesh)
     compute_dtype = common.compute_dtype_from_args(args)
     base_rng = (jax.random.PRNGKey(args.seed + 1)
                 if args.lora_dropout > 0 else None)
 
+    def resolve(frozen):
+        """Fetch offloaded top-level leaves (incl. the embed table, reused
+        by the tied-lm-head chunked CE) once; block weights stream per
+        layer via the returned stream fn."""
+        from mobilefinetuner_tpu.parallel.offload import resolve_offload
+        if offload_arg is None:
+            return fetch_fn(frozen), None
+        return resolve_offload(frozen, offload_arg)
+
     def loss_fn(lora_t, frozen, mb):
-        p = fetch_fn(frozen)
+        p, stream = resolve(frozen)
         # per-(step, micro-batch) dropout key, threaded via the batch
         rng = mb["dropout_rng"][0] if "dropout_rng" in mb else None
         hidden = gemma3.hidden_states(
             config, p, mb["input_ids"],
             attention_mask=mb["attention_mask"], lora=lora_t,
             compute_dtype=compute_dtype, remat=args.remat,
-            lora_dropout=args.lora_dropout, dropout_rng=rng)
+            lora_dropout=args.lora_dropout, dropout_rng=rng,
+            block_stream=stream)
         # lm_head tied to embeddings; chunked CE avoids [B,S,262k] logits
         return chunked_lm_cross_entropy_sum(
             hidden, p["embed"], mb["labels"], num_chunks=args.loss_chunks)
 
     def nll_fn(lora_t, frozen, mb):
-        p = fetch_fn(frozen)
+        p, stream = resolve(frozen)
         hidden = gemma3.hidden_states(
             config, p, mb["input_ids"],
             attention_mask=mb["attention_mask"], lora=lora_t,
-            compute_dtype=compute_dtype)
+            compute_dtype=compute_dtype, block_stream=stream)
         return chunked_lm_cross_entropy_sum(
             hidden, p["embed"], mb["labels"], num_chunks=args.loss_chunks)
 

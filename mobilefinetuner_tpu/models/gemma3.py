@@ -93,11 +93,12 @@ from mobilefinetuner_tpu.models.lora_apply import maybe_lora
 
 def _block(c: Gemma3TextConfig, bp, x, padding_mask, masks, ropes,
            is_global, lora_b, i, lora_dropout=0.0, dropout_rng=None):
-    """One Gemma-3 block; bp leaves are [L, ...]-stacked, indexed at i."""
+    """One Gemma-3 block; bp leaves are THIS layer's weights (sliced out of
+    the [L, ...] stacks by the scan body); i (traced scalar) indexes the
+    still-stacked LoRA leaves, RoPE tables, and masks."""
     eps = c.rms_norm_eps
     B, S, H = x.shape
     nq, nkv, D = (c.num_attention_heads, c.num_key_value_heads, c.head_dim)
-    g = lambda t: t[i]
     rng = None if dropout_rng is None else jax.random.fold_in(dropout_rng, i)
 
     def lora(y, x_in, name, site):
@@ -109,15 +110,15 @@ def _block(c: Gemma3TextConfig, bp, x, padding_mask, masks, ropes,
     a = bp["attn"]
 
     # --- attention, sandwich-normed
-    h = rms_norm(x, g(bp["input_ln"]), eps)
-    q = lora(h @ g(a["q_w"]), h, "q_proj", 0)
-    k = lora(h @ g(a["k_w"]), h, "k_proj", 1)
-    v = lora(h @ g(a["v_w"]), h, "v_proj", 2)
+    h = rms_norm(x, bp["input_ln"], eps)
+    q = lora(h @ a["q_w"], h, "q_proj", 0)
+    k = lora(h @ a["k_w"], h, "k_proj", 1)
+    v = lora(h @ a["v_w"], h, "v_proj", 2)
     q = q.reshape(B, S, nq, D).transpose(0, 2, 1, 3)
     k = k.reshape(B, S, nkv, D).transpose(0, 2, 1, 3)
     v = v.reshape(B, S, nkv, D).transpose(0, 2, 1, 3)
-    q = rms_norm(q, g(a["q_norm"]), eps)
-    k = rms_norm(k, g(a["k_norm"]), eps)
+    q = rms_norm(q, a["q_norm"], eps)
+    k = rms_norm(k, a["k_norm"], eps)
     cos = jnp.where(is_global[i], ropes["cos_g"], ropes["cos_l"])
     sin = jnp.where(is_global[i], ropes["sin_g"], ropes["sin_l"])
     q = apply_rope(q, cos, sin)
@@ -144,27 +145,37 @@ def _block(c: Gemma3TextConfig, bp, x, padding_mask, masks, ropes,
                         is_causal=False, attn_mask=mask,
                         padding_mask=padding_mask)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, nq * D)
-    attn_out = lora(ctx @ g(a["o_w"]), ctx, "o_proj", 3)
-    attn_out = rms_norm(attn_out, g(bp["post_attn_ln"]), eps)
+    attn_out = lora(ctx @ a["o_w"], ctx, "o_proj", 3)
+    attn_out = rms_norm(attn_out, bp["post_attn_ln"], eps)
     x = x + attn_out
 
     # --- MLP, sandwich-normed
-    h = rms_norm(x, g(bp["pre_ffn_ln"]), eps)
-    gate = lora(h @ g(bp["mlp"]["gate_w"]), h, "gate_proj", 4)
-    up = lora(h @ g(bp["mlp"]["up_w"]), h, "up_proj", 5)
+    h = rms_norm(x, bp["pre_ffn_ln"], eps)
+    gate = lora(h @ bp["mlp"]["gate_w"], h, "gate_proj", 4)
+    up = lora(h @ bp["mlp"]["up_w"], h, "up_proj", 5)
     act = gelu_tanh(gate) * up
-    down = lora(act @ g(bp["mlp"]["down_w"]), act, "down_proj", 6)
-    down = rms_norm(down, g(bp["post_ffn_ln"]), eps)
+    down = lora(act @ bp["mlp"]["down_w"], act, "down_proj", 6)
+    down = rms_norm(down, bp["post_ffn_ln"], eps)
     return x + down
 
 
 def hidden_states(config: Gemma3TextConfig, params, input_ids,
                   attention_mask=None, lora=None,
                   compute_dtype=jnp.float32, remat: bool = False,
-                  lora_dropout: float = 0.0, dropout_rng=None):
+                  lora_dropout: float = 0.0, dropout_rng=None,
+                  offload=None, block_stream=None):
+    """offload: optional (plan, shardings) pair matching `params`; offloaded
+    block weights stream host->HBM per layer inside the scan (forces remat
+    of the block body) — see parallel/offload.py. block_stream: pre-resolved
+    stream fn for callers that already ran resolve_offload (so the fetched
+    embedding table is reused by the tied lm_head, not fetched twice)."""
+    from mobilefinetuner_tpu.parallel.offload import resolve_offload
     c = config
     B, S = input_ids.shape
     params = jax.tree.map(jnp.asarray, params)
+    if offload is not None:
+        params, block_stream = resolve_offload(params, offload)
+    stream = block_stream
     x = params["embed"][input_ids].astype(compute_dtype)
     # sqrt(hidden) embedding scaling, computed in the embed dtype as HF does
     normalizer = jnp.asarray(c.hidden_size ** 0.5, compute_dtype)
@@ -186,14 +197,14 @@ def hidden_states(config: Gemma3TextConfig, params, input_ids,
     is_global = jnp.asarray([c.is_global_layer(i)
                              for i in range(c.num_hidden_layers)])
 
-    bp = jax.tree.map(lambda t: jnp.asarray(t).astype(compute_dtype),
-                      params["blocks"])
+    from mobilefinetuner_tpu.parallel.offload import layer_slicer
+    slice_layer = layer_slicer(params["blocks"], stream, compute_dtype)
     lora_b = None if lora is None else lora.get("blocks")
 
     def body(x, i):
-        return _block(c, bp, x, attention_mask, masks, ropes, is_global,
-                      lora_b, i, lora_dropout, dropout_rng), None
-    if remat:
+        return _block(c, slice_layer(i), x, attention_mask, masks, ropes,
+                      is_global, lora_b, i, lora_dropout, dropout_rng), None
+    if remat or stream is not None:
         body = jax.checkpoint(body)
     x, _ = jax.lax.scan(body, x, jnp.arange(c.num_hidden_layers))
     return rms_norm(x, params["final_norm"].astype(compute_dtype),
@@ -203,8 +214,11 @@ def hidden_states(config: Gemma3TextConfig, params, input_ids,
 def forward(config: Gemma3TextConfig, params, input_ids,
             attention_mask=None, lora=None, compute_dtype=jnp.float32,
             remat: bool = False, lora_dropout: float = 0.0,
-            dropout_rng=None) -> jnp.ndarray:
+            dropout_rng=None, offload=None) -> jnp.ndarray:
     """Logits [B, S, V]; lm_head tied to the embedding table."""
+    from mobilefinetuner_tpu.parallel.offload import resolve_offload
+    params, stream = resolve_offload(params, offload)
     x = hidden_states(config, params, input_ids, attention_mask, lora,
-                      compute_dtype, remat, lora_dropout, dropout_rng)
+                      compute_dtype, remat, lora_dropout, dropout_rng,
+                      block_stream=stream)
     return x @ params["embed"].astype(compute_dtype).T

@@ -82,12 +82,13 @@ def init_params(config: GPT2Config, key: jax.Array,
 
 def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx,
            lora_dropout=0.0, dropout_rng=None):
-    """One pre-LN transformer block. bp leaves are [L, ...]-stacked and
-    indexed by layer_idx (traced scalar under scan)."""
+    """One pre-LN transformer block. bp leaves are THIS layer's weights
+    (already sliced out of the [L, ...] stacks by the scan body); layer_idx
+    (traced scalar) indexes the still-stacked LoRA leaves and salts
+    dropout keys."""
     eps = config.layer_norm_epsilon
     H, D = config.n_head, config.head_dim
     B, S, E = x.shape
-    g = lambda t: t[layer_idx]
     rng = (None if dropout_rng is None
            else jax.random.fold_in(dropout_rng, layer_idx))
 
@@ -97,8 +98,8 @@ def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx,
                           None if rng is None
                           else jax.random.fold_in(rng, site))
 
-    h = layer_norm(x, g(bp["ln_1"]["g"]), g(bp["ln_1"]["b"]), eps)
-    qkv = h @ g(bp["attn"]["qkv_w"]) + g(bp["attn"]["qkv_b"])
+    h = layer_norm(x, bp["ln_1"]["g"], bp["ln_1"]["b"], eps)
+    qkv = h @ bp["attn"]["qkv_w"] + bp["attn"]["qkv_b"]
     qkv = lora(qkv, h, "attn_qkv", 0)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     to_heads = lambda t: t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
@@ -106,15 +107,15 @@ def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx,
                     impl=config.attention_impl, is_causal=True,
                     padding_mask=padding_mask)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, E)
-    proj = ctx @ g(bp["attn"]["proj_w"]) + g(bp["attn"]["proj_b"])
+    proj = ctx @ bp["attn"]["proj_w"] + bp["attn"]["proj_b"]
     proj = lora(proj, ctx, "attn_proj", 1)
     x = x + proj
 
-    h = layer_norm(x, g(bp["ln_2"]["g"]), g(bp["ln_2"]["b"]), eps)
-    fc = h @ g(bp["mlp"]["fc_w"]) + g(bp["mlp"]["fc_b"])
+    h = layer_norm(x, bp["ln_2"]["g"], bp["ln_2"]["b"], eps)
+    fc = h @ bp["mlp"]["fc_w"] + bp["mlp"]["fc_b"]
     fc = lora(fc, h, "mlp_fc_in", 2)
     act = gelu_new(fc)
-    out = act @ g(bp["mlp"]["proj_w"]) + g(bp["mlp"]["proj_b"])
+    out = act @ bp["mlp"]["proj_w"] + bp["mlp"]["proj_b"]
     out = lora(out, act, "mlp_fc_out", 3)
     return x + out
 
@@ -122,10 +123,25 @@ def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx,
 def hidden_states(config: GPT2Config, params, input_ids,
                   attention_mask=None, lora=None,
                   compute_dtype=jnp.float32, remat: bool = False,
-                  lora_dropout: float = 0.0, dropout_rng=None):
-    """Final-LN hidden states [B, S, E] (pre lm_head)."""
+                  lora_dropout: float = 0.0, dropout_rng=None,
+                  offload=None, block_stream=None):
+    """Final-LN hidden states [B, S, E] (pre lm_head).
+
+    offload: optional (plan, shardings) pytree pair matching `params`
+    (parallel/offload.py). Offloaded block weights are streamed host->HBM
+    one layer at a time inside the scan; streaming forces remat of the
+    block body so the backward re-fetches layers instead of keeping every
+    layer's weights alive as residuals (which would defeat the budget).
+    block_stream: pre-resolved stream fn from resolve_offload, for callers
+    that already fetched the top-level leaves themselves (e.g. forward,
+    which reuses the fetched wte for the tied lm_head).
+    """
+    from mobilefinetuner_tpu.parallel.offload import resolve_offload
     B, S = input_ids.shape
     params = jax.tree.map(jnp.asarray, params)
+    if offload is not None:
+        params, block_stream = resolve_offload(params, offload)
+    stream = block_stream
     if attention_mask is not None:
         # HF convention: position ids count only unmasked tokens, so
         # left-padded batches line up with HF GPT-2 exactly.
@@ -137,14 +153,13 @@ def hidden_states(config: GPT2Config, params, input_ids,
     x = params["wte"][input_ids] + pos_emb
     x = x.astype(compute_dtype)
     padding_mask = attention_mask
-    bp = jax.tree.map(lambda t: t.astype(compute_dtype)
-                      if jnp.issubdtype(t.dtype, jnp.floating) else t,
-                      params["blocks"])
+    from mobilefinetuner_tpu.parallel.offload import layer_slicer
+    slice_layer = layer_slicer(params["blocks"], stream, compute_dtype)
     lora_b = None if lora is None else lora.get("blocks")
 
-    body = lambda x, i: (_block(config, bp, x, padding_mask, lora_b, i,
-                                lora_dropout, dropout_rng), None)
-    if remat:
+    body = lambda x, i: (_block(config, slice_layer(i), x, padding_mask,
+                                lora_b, i, lora_dropout, dropout_rng), None)
+    if remat or stream is not None:
         body = jax.checkpoint(body)
     x, _ = jax.lax.scan(body, x, jnp.arange(config.n_layer))
     x = layer_norm(x, params["ln_f"]["g"].astype(compute_dtype),
@@ -155,16 +170,19 @@ def hidden_states(config: GPT2Config, params, input_ids,
 
 def forward(config: GPT2Config, params, input_ids, attention_mask=None,
             lora=None, compute_dtype=jnp.float32, remat: bool = False,
-            lora_dropout: float = 0.0, dropout_rng=None) -> jnp.ndarray:
+            lora_dropout: float = 0.0, dropout_rng=None,
+            offload=None) -> jnp.ndarray:
     """Logits [B, S, V]. Tied lm_head: x @ wte^T (gpt2_model.cpp:421-440).
 
     The reference caches wte^T when embeddings are frozen (SURVEY.md
     §2.12.5); under XLA the transpose is a free layout change, so no cache.
     """
+    from mobilefinetuner_tpu.parallel.offload import resolve_offload
+    params, stream = resolve_offload(params, offload)
     x = hidden_states(config, params, input_ids, attention_mask, lora,
-                      compute_dtype, remat, lora_dropout, dropout_rng)
-    wte = params["wte"].astype(compute_dtype)
-    logits = x @ wte.T
+                      compute_dtype, remat, lora_dropout, dropout_rng,
+                      block_stream=stream)
+    logits = x @ params["wte"].astype(compute_dtype).T
     return logits
 
 

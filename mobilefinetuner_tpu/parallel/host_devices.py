@@ -8,9 +8,16 @@ workarounds below live in exactly one place:
   - XLA reads --xla_force_host_platform_device_count from XLA_FLAGS at
     backend init; an existing entry with a DIFFERENT value must be rewritten,
     not just detected by substring.
-  - The TPU plugin may pin jax_platforms programmatically at interpreter
-    start, shadowing the JAX_PLATFORMS env var; forcing CPU requires
-    jax.config.update BEFORE any backend init (best-effort after).
+  - TPU images may PRELOAD jax at interpreter start (sitecustomize) with
+    JAX_PLATFORMS preset to the TPU plugin — the config default is captured
+    then, so setting the env var afterwards does nothing and
+    jax.config.update is required. But selecting cpu via config.update
+    leaves the backend without host/device memory-space accounting
+    (host-placed arguments get billed as device memory in compiled
+    memory_analysis()). In fact the CPU backend never separates the two
+    (host RAM IS its device memory), so the offload peak-memory proof
+    (tools/check_stream_memory.py) runs on the machine's default
+    accelerator platform in a subprocess and skips on cpu.
 """
 
 from __future__ import annotations
@@ -35,10 +42,13 @@ def force_host_devices(n: int) -> None:
     else:
         flags = (flags + f" {flag}={n}").strip()
     os.environ["XLA_FLAGS"] = flags
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except RuntimeError:
-        pass  # backend already initialized; use whatever devices exist
+    if jax.config.jax_platforms != "cpu":
+        # jax was imported before the env override took effect (interpreter
+        # preload); force via config — see module docstring for the cost.
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass

@@ -16,14 +16,29 @@ is a compiled H2D transfer XLA can overlap with compute. The mapping:
   max_resident_bytes budget         OffloadConfig.max_resident_bytes
   quantize_fp16_on_disk             OffloadConfig.offload_dtype="bfloat16"
                                     (bf16 is the TPU-idiomatic 16-bit type)
-  require(name) disk->RAM load      fetch(...) inside the jitted step:
+  require(name) disk->RAM load      fetch(...) whole-tree, or fetch_layer(...)
+                                    per layer inside the model's lax.scan:
                                     jax.device_put back to "device" memory
+  per-layer require() in the model  fetch_layer(blocks, plan, i, ...) —
+  (gpt2_model.cpp:536-549)          slices layer i out of the [L, ...]-stacked
+                                    host arrays; XLA emits an async host->HBM
+                                    dynamic-slice DMA it overlaps with the
+                                    previous layer's compute
   LRU eviction                      static largest-first spill plan (the
                                     whole step's working set is known at
                                     trace time — no runtime eviction needed)
   offload_all()                     apply_placement(...)
   owner_ptr nulling                 functional pytrees: the host copy IS the
                                     storage; nothing to null
+
+Peak-HBM semantics: `fetch` pulls the whole tree, so fetched weights are
+device-resident for the entire step — the budget then governs only idle
+placement. `fetch_layer` is the reference's actual working-set bound
+(parameter_sharder.cpp:242-271): only ~one layer of offloaded weights is
+HBM-resident at a time, provided the layer scan body is rematerialized
+(jax.checkpoint) so the backward re-fetches instead of keeping every
+layer's weights alive as saved residuals. The model forwards handle both
+(models/gpt2.py, models/gemma3.py `offload=` argument).
 
 Budget semantics are strict (test_sharder_strict.cpp analog): the PLANNED
 resident set never exceeds `max_resident_bytes`. The reference must auto-raise
@@ -160,3 +175,96 @@ def fetch(params, plan, shardings, compute_dtype=None):
         return x
 
     return jax.tree.map(pull, params, plan, shardings)
+
+
+def _slice_sharding(sh):
+    """Device-memory sharding for a leaf sliced out of a [L, ...] stack:
+    drop the leading (layer) axis of the partition spec. If the stack was
+    FSDP-sharded on the layer axis itself, the slice falls back to
+    replicated (a single layer cannot be partitioned along L)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    if isinstance(sh, NamedSharding):
+        rest = tuple(sh.spec)[1:]
+        return NamedSharding(sh.mesh, PartitionSpec(*rest),
+                             memory_kind=DEVICE)
+    return sh.with_memory_kind(DEVICE)
+
+
+def fetch_layer(blocks, plan, i, shardings, compute_dtype=None):
+    """Per-layer `require()` (parameter_sharder.cpp:242-271 analog), usable
+    inside the model's layer scan: slice layer `i` out of each [L, ...]-
+    stacked leaf, pulling offloaded leaves host->HBM one layer at a time.
+
+    `i` is a traced scalar (the scan induction variable). For an offloaded
+    leaf the slice's operand lives in host memory, so XLA lowers it to an
+    async dynamic-slice DMA out of host RAM — only the single layer ever
+    occupies HBM, and the latency-hiding scheduler overlaps the transfer of
+    layer i with the compute of layer i-1. Resident leaves are sliced in
+    HBM as usual.
+    """
+    if not isinstance(shardings, (dict, list, tuple)):
+        shardings = jax.tree.map(lambda _: shardings, blocks)
+
+    def pull(t, off, sh):
+        x = jax.lax.dynamic_index_in_dim(t, i, 0, keepdims=False)
+        if off:
+            x = jax.device_put(x, _slice_sharding(sh))
+        if compute_dtype is not None and jnp.issubdtype(x.dtype,
+                                                        jnp.floating):
+            x = x.astype(compute_dtype)
+        return x
+
+    return jax.tree.map(pull, blocks, plan, shardings)
+
+
+def any_offloaded(plan) -> bool:
+    return any(map(bool, jax.tree.leaves(plan)))
+
+
+def resolve_offload(params, offload, blocks_key: str = "blocks"):
+    """Split an offload spec for a stacked-layer model tree: non-block
+    leaves are fetched whole up front; block leaves stream per layer inside
+    the model's scan (see module docstring for the peak-HBM semantics).
+
+    params: model tree whose `blocks_key` entry holds [L, ...]-stacked
+    leaves. offload: None or (plan, shardings) pytrees matching params.
+    Returns (params_with_top_leaves_fetched, stream_fn_or_None) where
+    stream_fn(blocks, i, compute_dtype) is fetch_layer bound to the block
+    plan. Call it ONCE per jitted function and reuse the returned tree —
+    e.g. the tied lm_head should read the already-fetched embedding table,
+    not re-fetch it.
+    """
+    if offload is None:
+        return params, None
+    plan, shardings = offload
+    top = {k: v for k, v in params.items() if k != blocks_key}
+    top = fetch(top, {k: plan[k] for k in top},
+                {k: shardings[k] for k in top})
+    params = dict(top, **{blocks_key: params[blocks_key]})
+    if not any_offloaded(plan[blocks_key]):
+        return params, None
+
+    def stream(blocks, i, compute_dtype):
+        return fetch_layer(blocks, plan[blocks_key], i,
+                           shardings[blocks_key], compute_dtype)
+    return params, stream
+
+
+def layer_slicer(blocks, stream, compute_dtype):
+    """The scan-body slice function shared by the model forwards:
+    slice_layer(i) -> this layer's weight subtree in compute_dtype.
+
+    Resident path (stream=None): cast the whole stacked tree once, slice in
+    HBM per layer. Streaming path: slice+fetch+cast per layer (a whole-tree
+    cast would materialize the host-resident stacks in HBM). Callers MUST
+    remat the scan body when stream is not None, or the backward keeps all
+    fetched layers alive as residuals.
+    """
+    if stream is None:
+        cast = lambda t: (t.astype(compute_dtype)
+                          if jnp.issubdtype(t.dtype, jnp.floating) else t)
+        bp = jax.tree.map(cast, blocks)
+        return lambda i: jax.tree.map(
+            lambda t: jax.lax.dynamic_index_in_dim(t, i, 0, keepdims=False),
+            bp)
+    return lambda i: stream(blocks, i, compute_dtype)

@@ -1,0 +1,95 @@
+"""Peak-HBM proof for per-layer offload streaming (run on a real TPU).
+
+Compiles the LoRA train loss+grad for a GPT-2-medium-shaped stack twice —
+fully resident vs budget-0 streamed — and reports XLA's compiled memory
+analysis. On TPU, host-placed arguments are billed to host memory and the
+streamed program's device footprint is ~one layer of weights + activations;
+this is the rebuild's analog of the reference's RSS benchmark for the
+ParameterSharder (reference: scripts/Finetune/measure_rss.sh:22-42,
+parameter_sharder.cpp:242-271 per-layer require()).
+
+Prints one JSON line:
+  {"ok": bool, "blocks_bytes": N, "resident": {...}, "streamed": {...}}
+
+Used by tests/test_offload.py (subprocess, skipped when no TPU) and
+runnable standalone:  python tools/check_stream_memory.py
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> int:
+    if jax.devices()[0].platform == "cpu":
+        print(json.dumps({"ok": False,
+                          "reason": "cpu backend has no host/device "
+                                    "memory-space accounting"}))
+        return 2
+
+    from mobilefinetuner_tpu.core.config import GPT2Config
+    from mobilefinetuner_tpu.lora.lora import LoRASpec, init_lora_gpt2
+    from mobilefinetuner_tpu.models import gpt2
+    from mobilefinetuner_tpu.ops.loss import lm_cross_entropy_sum
+    from mobilefinetuner_tpu.parallel.mesh import (make_mesh,
+                                                   replicated_sharding)
+    from mobilefinetuner_tpu.parallel.offload import (OffloadConfig,
+                                                      apply_placement,
+                                                      plan_placement)
+
+    config = GPT2Config(n_embd=512, n_layer=8, n_head=8, vocab_size=2048,
+                        n_positions=64)
+    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    ocfg = OffloadConfig(enable=True, max_resident_bytes=0,
+                         offload_dtype="float32", min_offload_size=1024)
+    plan = plan_placement(params, ocfg)
+    sh = replicated_sharding(make_mesh(1, 1, devices=jax.devices()[:1]))
+    shardings = jax.tree.map(lambda _: sh, params)
+    placed = apply_placement(params, plan, shardings, ocfg)
+    offload = (plan, shardings)
+
+    ids = jnp.zeros((2, 32), jnp.int32)
+    labels = jnp.zeros((2, 32), jnp.int32)
+    spec = LoRASpec(rank=4, alpha=8.0, targets=["attn_qkv"], init="gpt2")
+    lora = init_lora_gpt2(config, spec, jax.random.PRNGKey(1))
+
+    def make(off):
+        def loss(lora_t, p):
+            logits = gpt2.forward(config, p, ids, lora=lora_t, offload=off)
+            s, w = lm_cross_entropy_sum(logits, labels)
+            return s / w
+        return jax.jit(jax.grad(loss))
+
+    def stats(ma):
+        return {"dev_args": int(ma.argument_size_in_bytes),
+                "host_args": int(ma.host_argument_size_in_bytes),
+                "temp": int(ma.temp_size_in_bytes),
+                "output": int(ma.output_size_in_bytes)}
+
+    res = stats(make(None).lower(lora, params).compile().memory_analysis())
+    stm = stats(make(offload).lower(lora, placed).compile()
+                .memory_analysis())
+
+    blocks_bytes = sum(int(np.prod(x.shape)) * 4
+                       for x in jax.tree.leaves(params["blocks"]))
+    per_layer = blocks_bytes / config.n_layer
+    dev_peak_res = res["dev_args"] + res["temp"]
+    dev_peak_stm = stm["dev_args"] + stm["temp"]
+    ok = (stm["dev_args"] < blocks_bytes / 10
+          and stm["host_args"] > 0.8 * blocks_bytes
+          and stm["temp"] < 3 * per_layer + 32 * 2 ** 20
+          and dev_peak_stm < dev_peak_res / 2)
+    print(json.dumps({"ok": bool(ok), "blocks_bytes": blocks_bytes,
+                      "per_layer_bytes": int(per_layer),
+                      "resident": res, "streamed": stm}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,10 +80,12 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
                    default="float32", help="compute dtype")
     g.add_argument("--remat", action="store_true",
                    help="gradient checkpointing over the layer scan")
-    g.add_argument("--attention_impl", choices=["xla", "flash"],
-                   default="xla",
-                   help="'flash' = Pallas fused kernel (wins for S >~ 512; "
-                        "XLA's fused attention is faster at short S)")
+    g.add_argument("--attention_impl", choices=["auto", "xla", "flash"],
+                   default="auto",
+                   help="'auto' picks per shape (flash for S >= 1024, "
+                        "measured on v5e, tools/bench_attention.py); "
+                        "'flash' = Pallas block-sparse kernel; 'xla' = "
+                        "plain fused attention")
 
 
 def add_pm_flags(p: argparse.ArgumentParser):
@@ -387,11 +389,12 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
             trainable, frozen, opt_state, batch, jnp.int32(step))
         toks = batch["input_ids"].shape[0] * batch["input_ids"].shape[1]
         buffered.append((step, epoch, toks, metrics))
-        if (step + 1) % flush_every == 0:
-            # a capped flush (flush_every < log_interval) only writes CSV
-            # rows; the log line keeps the requested cadence
-            flush_metrics(emit_log=bool(args.log_interval)
-                          and (step + 1) % args.log_interval == 0)
+        log_boundary = bool(args.log_interval) \
+            and (step + 1) % args.log_interval == 0
+        if log_boundary or (step + 1) % flush_every == 0:
+            # capped flushes (flush_every < log_interval) only write CSV
+            # rows; the log line fires exactly on the requested cadence
+            flush_metrics(emit_log=log_boundary)
 
         if (args.eval_interval and valid_ds is not None
                 and (step + 1) % args.eval_interval == 0):

@@ -41,8 +41,9 @@ class GPT2Config:
     resid_pdrop: float = 0.0
     attn_pdrop: float = 0.0
     tie_word_embeddings: bool = True
-    # Attention impl: "flash" (Pallas kernel), "xla" (plain jnp reference).
-    attention_impl: str = "xla"
+    # Attention impl: "auto" (flash for S >= 1024, measured on v5e — see
+    # ops/attention.py), "flash" (Pallas kernel), "xla" (jnp reference).
+    attention_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -121,7 +122,7 @@ class Gemma3TextConfig:
     hidden_activation: str = "gelu_pytorch_tanh"
     tie_word_embeddings: bool = True
     sliding_window_pattern: int = 6
-    attention_impl: str = "xla"
+    attention_impl: str = "auto"
 
     def __post_init__(self):
         if self.layer_types is None:

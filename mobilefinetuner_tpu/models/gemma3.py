@@ -124,7 +124,13 @@ def _block(c: Gemma3TextConfig, bp, x, padding_mask, masks, ropes,
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     scale = c.query_pre_attn_scalar ** -0.5
-    if c.attention_impl == "flash":
+    impl = c.attention_impl
+    if impl == "auto":
+        # resolved here (not inside attention()) because the flash path
+        # needs the flag-based branch below instead of mask matrices
+        from mobilefinetuner_tpu.ops.attention import resolve_impl
+        impl = resolve_impl(S, D)
+    if impl == "flash":
         # The Pallas kernel takes causal/sliding-window as STATIC config,
         # not a mask matrix; under the layer scan the global/local choice is
         # a traced bool, so branch with lax.cond (each branch compiles its
@@ -141,7 +147,7 @@ def _block(c: Gemma3TextConfig, bp, x, padding_mask, masks, ropes,
             (q, k, v))
     else:
         mask = jnp.where(is_global[i], masks["global"], masks["local"])
-        ctx = attention(q, k, v, impl=c.attention_impl, scale=scale,
+        ctx = attention(q, k, v, impl=impl, scale=scale,
                         is_causal=False, attn_mask=mask,
                         padding_mask=padding_mask)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, nq * D)

@@ -91,8 +91,26 @@ def dot_product_attention(
     return out.reshape(B, Hq, S, D)
 
 
-def attention(q, k, v, *, impl: str = "xla", **kwargs):
-    """Dispatch between the XLA reference and the Pallas flash kernel."""
+def resolve_impl(S: int, D: int) -> str:
+    """The 'auto' dispatch rule, from TPU v5e measurements
+    (tools/bench_attention_v5e.json): the flash kernel wins 1.5-3× (fwd
+    and fwd+bwd) from S >= 1024 at small head dim (GPT-2, D=64) and from
+    S >= 2048 at large head dim (Gemma, D=256), thanks to causal/sliding-
+    window block skipping; XLA's fused attention keeps a slight edge below
+    those sizes. Shared by attention() and the model blocks that branch on
+    the impl themselves (models/gemma3.py) — retune in ONE place.
+    """
+    return "flash" if S >= (1024 if D <= 128 else 2048) else "xla"
+
+
+def attention(q, k, v, *, impl: str = "auto", **kwargs):
+    """Dispatch between the XLA reference and the Pallas flash kernel.
+
+    impl='auto' picks per shape (resolve_impl); 'flash' / 'xla' force the
+    respective path.
+    """
+    if impl == "auto":
+        impl = resolve_impl(q.shape[2], q.shape[3])
     if impl == "flash":
         try:
             from mobilefinetuner_tpu.ops import flash_attention

@@ -28,8 +28,12 @@ CASES = [
     dict(Hkv=1),                              # extreme GQA (Gemma-270M)
     dict(scale=0.25),                         # explicit scale
     dict(D=128),
-    dict(S=256),                              # multi-q-block grid (qi > 0)
-    dict(S=256, Hkv=1, sliding_window=64),    # multi-block + GQA + window
+    # 64-blocks at S=256: 4x4 block grid — exercises qi>0 row offsets, the
+    # multi-iteration online-softmax k-loop, and causal block skipping
+    # (default 512-blocks would degenerate these to a single block)
+    dict(S=256, block=64),
+    dict(S=256, Hkv=1, sliding_window=64, block=64),
+    dict(S=256, sliding_window=96, block=64),  # window not block-aligned
 ]
 
 
@@ -38,11 +42,26 @@ def test_forward_matches_oracle(case):
     case = dict(case)
     kw = {k: case.pop(k) for k in ("sliding_window", "scale")
           if k in case}
+    bkw = {}
+    if "block" in case:
+        b = case.pop("block")
+        bkw = dict(block_q=b, block_k=b)
     q, k, v = make_qkv(jax.random.PRNGKey(0), **case)
-    ours = flash_attention(q, k, v, is_causal=True, **kw)
+    ours = flash_attention(q, k, v, is_causal=True, **kw, **bkw)
     ref = dot_product_attention(q, k, v, is_causal=True, **kw)
     np.testing.assert_allclose(np.asarray(ours), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_pick_block_keeps_odd_lengths_on_kernel():
+    """Raising the default block must not drop 128-multiples off the
+    kernel: S=1280 gets 256-blocks; non-multiples fall back (None)."""
+    from mobilefinetuner_tpu.ops.flash_attention import _pick_block
+    assert _pick_block(1280, 512) == 256
+    assert _pick_block(1024, 512) == 512
+    assert _pick_block(1664, 512) == 128
+    assert _pick_block(64, 512) == 64
+    assert _pick_block(130, 512) is None
 
 
 def test_forward_with_padding_mask():
@@ -52,7 +71,10 @@ def test_forward_with_padding_mask():
     pad[0, 100:] = 0.0
     pad[1, 64:] = 0.0
     pad = jnp.asarray(pad)
-    ours = flash_attention(q, k, v, padding_mask=pad)
+    # 64-blocks: padding boundary (100) falls inside a block AND whole
+    # blocks (cols >= 128 for row < 64 via causal) are skipped
+    ours = flash_attention(q, k, v, padding_mask=pad, block_q=64,
+                           block_k=64)
     ref = dot_product_attention(q, k, v, padding_mask=pad)
     # compare only valid query rows (padded queries are don't-cares and the
     # ref puts uniform-softmax garbage there; ours puts zeros)
@@ -66,18 +88,24 @@ def test_forward_with_padding_mask():
 
 @pytest.mark.parametrize("case", [dict(), dict(sliding_window=32),
                                   dict(Hkv=1),
-                                  # multi-q-block: exercises the qi>0 row
-                                  # offsets and the dK/dV accumulation
-                                  # across q blocks and GQA group heads
-                                  dict(S=256, Hkv=2),
-                                  dict(S=256, Hkv=1, sliding_window=64)])
+                                  # 64-blocks: exercise the qi>0 offsets,
+                                  # the dKdV kernel's q-block loop bounds,
+                                  # and GQA group-head accumulation
+                                  dict(S=256, Hkv=2, block=64),
+                                  dict(S=256, Hkv=1, sliding_window=64,
+                                       block=64)])
 def test_gradients_match_oracle(case):
     case = dict(case)
     kw = {k: case.pop(k) for k in ("sliding_window",) if k in case}
+    bkw = {}
+    if "block" in case:
+        b = case.pop("block")
+        bkw = dict(block_q=b, block_k=b)
     q, k, v = make_qkv(jax.random.PRNGKey(2), **case)
 
     def loss(fn, q, k, v):
-        out = fn(q, k, v, is_causal=True, **kw)
+        extra = bkw if fn is flash_attention else {}
+        out = fn(q, k, v, is_causal=True, **kw, **extra)
         return jnp.sum(out * jnp.cos(out))  # nontrivial cotangent
 
     g_ours = jax.grad(functools.partial(loss, flash_attention),
@@ -98,7 +126,8 @@ def test_gradients_with_padding_mask():
     valid = pad.astype(bool)[:, None, :, None]
 
     def loss(fn, q, k, v):
-        out = fn(q, k, v, is_causal=True, padding_mask=pad)
+        kw = {"block_q": 64, "block_k": 64} if fn is flash_attention else {}
+        out = fn(q, k, v, is_causal=True, padding_mask=pad, **kw)
         return jnp.sum(jnp.where(valid, out, 0.0) ** 2)
 
     g_ours = jax.grad(functools.partial(loss, flash_attention),

@@ -1,0 +1,129 @@
+"""Flash-vs-XLA attention benchmark on the real chip (fwd + bwd).
+
+Measures the Pallas kernel against the XLA oracle across the fine-tuning
+shapes (GPT-2 small head layout and Gemma-3 270M GQA layout) at
+S ∈ {512, 1024, 2048}, causal and sliding-window, and checks numerics
+while at it. The reference's analog is memory_efficient_attention vs
+standard attention timing (core/memory_efficient_attention.cpp); ours must
+also win on the BACKWARD, which the reference does not implement.
+
+Sync note: on the tunneled TPU platform, block_until_ready does not wait —
+every timing reads a scalar back to host instead.
+
+Prints one JSON line per config; exit 0 iff all numerics agree.
+"""
+
+import functools
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+CHAIN = 32  # iterations fused into ONE jitted program: the tunneled TPU
+            # has ~6 ms per-dispatch latency, so per-op time must be
+            # measured as a serial in-graph chain, not a Python loop
+
+
+def timeit(fn, *args, iters=5, warmup=2):
+    for _ in range(warmup):
+        r = fn(*args)
+        float(jax.tree.leaves(r)[0].sum())  # host sync (axon gotcha)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn(*args)
+    float(jax.tree.leaves(r)[0].sum())
+    return (time.perf_counter() - t0) / iters / CHAIN * 1e3  # ms per op
+
+
+def run(name, B, Hq, Hkv, S, D, window, dtype=jnp.bfloat16):
+    from mobilefinetuner_tpu.ops.attention import dot_product_attention
+    from mobilefinetuner_tpu.ops.flash_attention import flash_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = jax.random.normal(ks[0], (B, Hq, S, D), dtype)
+    k = jax.random.normal(ks[1], (B, Hkv, S, D), dtype)
+    v = jax.random.normal(ks[2], (B, Hkv, S, D), dtype)
+    do = jax.random.normal(ks[3], (B, Hq, S, D), dtype)
+
+    def make(impl):
+        f = flash_attention if impl == "flash" else dot_product_attention
+
+        def att(q, k, v):
+            return f(q, k, v, is_causal=True, sliding_window=window)
+
+        @jax.jit
+        def fwd(q, k, v):
+            # serial chain: each iteration's output feeds the next query,
+            # so XLA cannot overlap or CSE the calls
+            def body(c, _):
+                return att(c, k, v).astype(c.dtype), None
+            out, _ = jax.lax.scan(body, q, None, length=CHAIN)
+            return out
+
+        @jax.jit
+        def fwdbwd(q, k, v, do):
+            def body(c, _):
+                out, vjp = jax.vjp(att, c, k, v)
+                dq, dk, dv = vjp(do)
+                # fold all grads back into the carry to serialize
+                return (out + 1e-3 * dq + 1e-6 * (dk.sum() + dv.sum())
+                        ).astype(c.dtype), None
+            out, _ = jax.lax.scan(body, q, None, length=CHAIN)
+            return out
+        return fwd, fwdbwd
+
+    f_fwd, f_bwd = make("flash")
+    x_fwd, x_bwd = make("xla")
+
+    def one_bwd(f):
+        @jax.jit
+        def g(q, k, v, do):
+            out, vjp = jax.vjp(
+                lambda q, k, v: f(q, k, v, is_causal=True,
+                                  sliding_window=window), q, k, v)
+            return out, vjp(do)
+        return g
+
+    # numerics vs the oracle (fwd + all three grads), single call
+    of, gf = one_bwd(flash_attention)(q, k, v, do)
+    ox, gx = one_bwd(dot_product_attention)(q, k, v, do)
+    errs = [float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                  - b.astype(jnp.float32))))
+            for a, b in zip((of, *gf), (ox, *gx))]
+    scale_ref = [float(jnp.max(jnp.abs(b.astype(jnp.float32))))
+                 for b in (ox, *gx)]
+    rel = max(e / max(s, 1e-6) for e, s in zip(errs, scale_ref))
+    ok = rel < 0.05  # bf16 tolerance
+
+    r = {"config": name, "B": B, "Hq": Hq, "Hkv": Hkv, "S": S, "D": D,
+         "window": window,
+         "flash_fwd_ms": round(timeit(f_fwd, q, k, v), 3),
+         "xla_fwd_ms": round(timeit(x_fwd, q, k, v), 3),
+         "flash_fwdbwd_ms": round(timeit(f_bwd, q, k, v, do), 3),
+         "xla_fwdbwd_ms": round(timeit(x_bwd, q, k, v, do), 3),
+         "max_rel_err": round(rel, 5), "numerics_ok": ok}
+    r["fwd_speedup"] = round(r["xla_fwd_ms"] / r["flash_fwd_ms"], 2)
+    r["fwdbwd_speedup"] = round(r["xla_fwdbwd_ms"] / r["flash_fwdbwd_ms"],
+                                2)
+    print(json.dumps(r))
+    return ok
+
+
+def main():
+    ok = True
+    for S in (512, 1024, 2048):
+        ok &= run(f"gpt2s_causal_S{S}", 8, 12, 12, S, 64, None)
+    for S in (1024, 2048):
+        ok &= run(f"gemma270m_global_S{S}", 4, 4, 1, S, 256, None)
+        ok &= run(f"gemma270m_sliding512_S{S}", 4, 4, 1, S, 256, 512)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,166 @@
+"""Alignment-dump harness: npy snapshots of one training step for
+comparison against a PyTorch/PEFT mirror.
+
+Rebuild of the reference's align mode
+(reference: operators/finetune_ops/optim/train_lora_gemma.cpp:620-920 —
+single-batch forward/backward dumping activations, per-layer grads, and
+post-step weights as .npy; plus graph/save_pt_gold.py and the
+pytorch_alignment/ mirror scripts). The dump side is framework-native
+(this module, wired to the train CLIs via --align_dump_dir); the torch
+side is tools/align_torch_mirror.py, which loads the same checkpoint +
+batch, recomputes every tensor with HF transformers + PEFT, and reports
+max abs/rel errors.
+
+Dump layout (all .npy unless noted):
+  batch_input_ids, batch_attention_mask, batch_labels
+  act_embed            [B, S, E]   post-embedding activations
+  act_layer_{i:02d}    [B, S, E]   post-block activations, per layer
+  logits               [B, S, V]
+  loss                 []          mean CE over valid tokens (HF semantics)
+  losses               [N]         loss per step over N steps on the batch
+  grads/{dotted}.npy               d(loss)/d(adapter), our key scheme
+  adapter_pre/{dotted}.npy         adapter before the first step
+  adapter_post/{dotted}.npy        adapter after ONE optimizer step
+  peft/                            HF-PEFT export of adapter_pre (the
+                                   mirror loads this to start identical)
+  meta.json                        hparams the mirror needs
+
+Align runs force a CONSTANT learning rate (no warmup/decay) so the mirror
+only needs torch.optim.AdamW with the same lr — schedule parity is covered
+by the optimizer unit tests instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mobilefinetuner_tpu.core.logging import get_logger
+from mobilefinetuner_tpu.lora import peft_io
+from mobilefinetuner_tpu.train.trainer import (TrainConfig, init_optimizer,
+                                               make_train_step)
+
+log = get_logger()
+
+
+def _dotted(tree) -> Dict[str, np.ndarray]:
+    flat = {}
+
+    def walk(prefix, t):
+        if isinstance(t, dict):
+            for k, v in t.items():
+                walk(f"{prefix}.{k}" if prefix else k, v)
+        else:
+            flat[prefix] = np.asarray(t)
+    walk("", tree)
+    return flat
+
+
+def _save_tree(d: str, tree) -> None:
+    for name, arr in _dotted(tree).items():
+        path = os.path.join(d, *name.split(".")) + ".npy"
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        np.save(path, arr)
+
+
+def run_align_dump(out_dir: str, *,
+                   trace_fn: Callable,
+                   loss_fn: Callable,
+                   trainable, frozen, batch: dict,
+                   tc: TrainConfig, mask,
+                   spec, family: str, model_dir: str,
+                   steps: int = 5,
+                   meta_extra: dict | None = None) -> dict:
+    """Execute the align protocol and write the dump directory.
+
+    trace_fn(trainable, frozen, batch) -> (logits, {"embed", "layers"})
+    loss_fn: the trainer contract loss (sum_nll, weight).
+    batch: ONE micro-batch (input_ids/attention_mask/labels).
+    Returns the meta dict (also written to meta.json).
+    """
+    with jax.default_matmul_precision("highest"):
+        return _run_align_dump(
+            out_dir, trace_fn=trace_fn, loss_fn=loss_fn,
+            trainable=trainable, frozen=frozen, batch=batch, tc=tc,
+            mask=mask, spec=spec, family=family, model_dir=model_dir,
+            steps=steps, meta_extra=meta_extra)
+
+
+def _run_align_dump(out_dir, *, trace_fn, loss_fn, trainable, frozen,
+                    batch, tc, mask, spec, family, model_dir, steps,
+                    meta_extra):
+    # Full-precision matmuls (caller's context manager): TPU's default
+    # bf16-pass matmuls perturb near-zero gradients enough to flip signs,
+    # and Adam's first step turns a sign flip on a zero-init B into a
+    # +/-lr disagreement with the torch mirror.
+    os.makedirs(out_dir, exist_ok=True)
+    for k in ("input_ids", "attention_mask", "labels"):
+        np.save(os.path.join(out_dir, f"batch_{k}.npy"),
+                np.asarray(batch[k]))
+
+    # ---- forward trace
+    logits, acts = jax.jit(trace_fn)(trainable, frozen, batch)
+    np.save(os.path.join(out_dir, "act_embed.npy"),
+            np.asarray(acts["embed"], np.float32))
+    layers = np.asarray(acts["layers"], np.float32)
+    for i in range(layers.shape[0]):
+        np.save(os.path.join(out_dir, f"act_layer_{i:02d}.npy"), layers[i])
+    np.save(os.path.join(out_dir, "logits.npy"),
+            np.asarray(logits, np.float32))
+
+    # ---- loss + adapter grads (of the MEAN loss, matching HF reduction)
+    def mean_loss(tr):
+        s, w = loss_fn(tr, frozen, batch)
+        return s / jnp.maximum(w, 1.0)
+
+    loss0, grads = jax.jit(jax.value_and_grad(mean_loss))(trainable)
+    np.save(os.path.join(out_dir, "loss.npy"),
+            np.asarray(loss0, np.float32))
+    _save_tree(os.path.join(out_dir, "grads"), grads)
+
+    # ---- adapter pre + PEFT export for the mirror
+    _save_tree(os.path.join(out_dir, "adapter_pre"),
+               jax.device_get(trainable))
+    peft_io.export_peft(os.path.join(out_dir, "peft"),
+                        jax.device_get(trainable), spec, family,
+                        base_model_name=model_dir)
+
+    # ---- N steps on the SAME batch: post-step adapter + loss curve
+    align_tc = dataclasses.replace(tc, schedule="constant",
+                                   warmup_ratio=0.0, grad_accum_steps=1)
+    step_fn = make_train_step(loss_fn, align_tc, mask=mask, donate=False)
+    opt_state = init_optimizer(trainable, align_tc, mask)
+    tr = trainable
+    losses = []
+    for s in range(max(steps, 1)):
+        tr, opt_state, metrics = step_fn(tr, frozen, opt_state, batch,
+                                         jnp.int32(s))
+        losses.append(float(metrics["loss"]))
+        if s == 0:
+            _save_tree(os.path.join(out_dir, "adapter_post"),
+                       jax.device_get(tr))
+    np.save(os.path.join(out_dir, "losses.npy"),
+            np.asarray(losses, np.float32))
+
+    meta = {
+        "family": family, "model_dir": os.path.abspath(model_dir),
+        "lr": align_tc.lr, "weight_decay": align_tc.weight_decay,
+        "clip_grad_norm": align_tc.clip_grad_norm,
+        "coupled_weight_decay": align_tc.coupled_weight_decay,
+        "steps": max(steps, 1), "rank": spec.rank, "alpha": spec.alpha,
+        "targets": list(spec.targets or []),
+        "n_layers": int(layers.shape[0]),
+        "loss": float(loss0), "losses": [float(x) for x in losses],
+    }
+    meta.update(meta_extra or {})
+    with open(os.path.join(out_dir, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    log.info(f"align dump -> {out_dir} (loss={float(loss0):.6f}, "
+             f"{steps} steps: {losses[0]:.6f} -> {losses[-1]:.6f})")
+    return meta

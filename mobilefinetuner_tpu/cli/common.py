@@ -88,6 +88,17 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
                         "plain fused attention")
 
 
+def add_align_flags(p: argparse.ArgumentParser):
+    """Alignment-harness flags (train_lora_gemma.cpp:620-920 analog)."""
+    g = p.add_argument_group("alignment harness")
+    g.add_argument("--align_dump_dir", default="",
+                   help="align mode: dump one batch's activations/grads/"
+                        "post-step adapter as npy and exit; compare with "
+                        "tools/align_torch_mirror.py")
+    g.add_argument("--align_steps", type=int, default=5,
+                   help="steps of the align-mode loss curve")
+
+
 def add_pm_flags(p: argparse.ArgumentParser):
     """Energy-governor flags (CmdArgs pm_* block; pm_interval=0 disables)."""
     g = p.add_argument_group("step governor (pm_*)")

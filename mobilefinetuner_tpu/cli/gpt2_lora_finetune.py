@@ -68,6 +68,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "c_proj, main.cpp:381-390)")
     p.add_argument("--peft_export_dir", default="",
                    help="also export an HF-PEFT adapter directory")
+    common.add_align_flags(p)
     common.add_train_flags(p, lr=1e-4, seq_len=128, batch_size=1)
     common.add_pm_flags(p)
     common.add_shard_flags(p)
@@ -149,6 +150,26 @@ def main(argv=None) -> int:
                               lora=lora_t, compute_dtype=compute_dtype,
                               offload=offload_arg)
         return lm_cross_entropy_sum(logits, mb["labels"])
+
+    if args.align_dump_dir:
+        from mobilefinetuner_tpu.align.dump import run_align_dump
+
+        def trace_fn(lora_t, frozen, mb):
+            p = fetch_fn(frozen)
+            x, acts = gpt2.hidden_states(
+                config, p, mb["input_ids"],
+                attention_mask=mb["attention_mask"], lora=lora_t,
+                compute_dtype=compute_dtype, collect_layers=True)
+            logits = x @ p["wte"].astype(compute_dtype).T
+            return logits, acts
+
+        _, batch = next(common.micro_batches(train_ds, 1))
+        run_align_dump(
+            args.align_dump_dir, trace_fn=trace_fn, loss_fn=loss_fn,
+            trainable=lora, frozen=params, batch=batch, tc=tc, mask=mask,
+            spec=spec, family="gpt2", model_dir=args.pretrained_dir,
+            steps=args.align_steps)
+        return 0
 
     def save_hook(step, lora_t, opt_st, final):
         path = args.lora_out

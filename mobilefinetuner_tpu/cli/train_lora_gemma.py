@@ -9,7 +9,8 @@ chunked loss (ops/loss.py chunked_lm_cross_entropy) so [B,S,262144] fp32
 logits are never materialized (SURVEY.md §7 hard part (d)).
 
 Alignment-dump mode (--align_dump_dir) mirrors the reference's
-single-batch npy dumps (:620-920) via tools/align_dump.py.
+single-batch npy dumps (:620-920) via align/dump.py; compare with the
+torch/PEFT mirror tools/align_torch_mirror.py.
 
 Usage (tiny smoke):
   python -m mobilefinetuner_tpu.cli.train_lora_gemma \
@@ -71,6 +72,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--loss_chunks", type=int, default=8,
                    help="sequence chunks for the 262k-vocab chunked CE")
     p.add_argument("--peft_export_dir", default="")
+    common.add_align_flags(p)
     p.add_argument("--max_steps", type=int, default=0,
                    help="alias of --steps (reference flag name)")
     common.add_train_flags(p, lr=1e-4, seq_len=256, batch_size=1)
@@ -172,6 +174,26 @@ def main(argv=None) -> int:
             compute_dtype=compute_dtype, block_stream=stream)
         return chunked_lm_cross_entropy_sum(
             hidden, p["embed"], mb["labels"], num_chunks=args.loss_chunks)
+
+    if args.align_dump_dir:
+        from mobilefinetuner_tpu.align.dump import run_align_dump
+
+        def trace_fn(lora_t, frozen, mb):
+            p = fetch_fn(frozen)
+            x, acts = gemma3.hidden_states(
+                config, p, mb["input_ids"],
+                attention_mask=mb["attention_mask"], lora=lora_t,
+                compute_dtype=compute_dtype, collect_layers=True)
+            logits = x @ p["embed"].astype(compute_dtype).T
+            return logits, acts
+
+        _, batch = next(common.micro_batches(train_ds, 1))
+        run_align_dump(
+            args.align_dump_dir, trace_fn=trace_fn, loss_fn=loss_fn,
+            trainable=lora, frozen=params, batch=batch, tc=tc, mask=mask,
+            spec=spec, family="gemma", model_dir=args.model_dir,
+            steps=args.align_steps)
+        return 0
 
     def save_hook(step, lora_t, opt_st, final):
         os.makedirs(args.output_dir, exist_ok=True)

@@ -8,8 +8,8 @@ eos=bos=pad=unk=50256 (tokenizer_bpe.h:29-33)), itself aligned with the
 public GPT-2 tokenizer algorithm. Implemented from the public algorithm, not
 ported. Uses the `regex` module for \\p{L}/\\p{N} unicode categories.
 
-A native C++ fast path (native/fast_bpe) is used automatically when built;
-this Python implementation is the reference and fallback.
+This Python implementation is the reference; a native C++ fast path is
+planned but not yet built (do not advertise components that don't exist).
 """
 
 from __future__ import annotations

@@ -169,12 +169,16 @@ def hidden_states(config: Gemma3TextConfig, params, input_ids,
                   attention_mask=None, lora=None,
                   compute_dtype=jnp.float32, remat: bool = False,
                   lora_dropout: float = 0.0, dropout_rng=None,
-                  offload=None, block_stream=None):
+                  offload=None, block_stream=None,
+                  collect_layers: bool = False):
     """offload: optional (plan, shardings) pair matching `params`; offloaded
     block weights stream host->HBM per layer inside the scan (forces remat
     of the block body) — see parallel/offload.py. block_stream: pre-resolved
     stream fn for callers that already ran resolve_offload (so the fetched
-    embedding table is reused by the tied lm_head, not fetched twice)."""
+    embedding table is reused by the tied lm_head, not fetched twice).
+    collect_layers: also return {"embed", "layers"} activations for the
+    alignment harness (reference: train_lora_gemma.cpp:620-920 npy dumps,
+    gemma_model.h:100-143 per-layer dump requests)."""
     from mobilefinetuner_tpu.parallel.offload import resolve_offload
     c = config
     B, S = input_ids.shape
@@ -207,14 +211,20 @@ def hidden_states(config: Gemma3TextConfig, params, input_ids,
     slice_layer = layer_slicer(params["blocks"], stream, compute_dtype)
     lora_b = None if lora is None else lora.get("blocks")
 
+    embed_out = x
+
     def body(x, i):
-        return _block(c, slice_layer(i), x, attention_mask, masks, ropes,
-                      is_global, lora_b, i, lora_dropout, dropout_rng), None
+        x2 = _block(c, slice_layer(i), x, attention_mask, masks, ropes,
+                    is_global, lora_b, i, lora_dropout, dropout_rng)
+        return x2, (x2 if collect_layers else None)
     if remat or stream is not None:
         body = jax.checkpoint(body)
-    x, _ = jax.lax.scan(body, x, jnp.arange(c.num_hidden_layers))
-    return rms_norm(x, params["final_norm"].astype(compute_dtype),
-                    c.rms_norm_eps)
+    x, layer_acts = jax.lax.scan(body, x, jnp.arange(c.num_hidden_layers))
+    x = rms_norm(x, params["final_norm"].astype(compute_dtype),
+                 c.rms_norm_eps)
+    if collect_layers:
+        return x, {"embed": embed_out, "layers": layer_acts}
+    return x
 
 
 def forward(config: Gemma3TextConfig, params, input_ids,

@@ -124,7 +124,8 @@ def hidden_states(config: GPT2Config, params, input_ids,
                   attention_mask=None, lora=None,
                   compute_dtype=jnp.float32, remat: bool = False,
                   lora_dropout: float = 0.0, dropout_rng=None,
-                  offload=None, block_stream=None):
+                  offload=None, block_stream=None,
+                  collect_layers: bool = False):
     """Final-LN hidden states [B, S, E] (pre lm_head).
 
     offload: optional (plan, shardings) pytree pair matching `params`
@@ -135,6 +136,10 @@ def hidden_states(config: GPT2Config, params, input_ids,
     block_stream: pre-resolved stream fn from resolve_offload, for callers
     that already fetched the top-level leaves themselves (e.g. forward,
     which reuses the fetched wte for the tied lm_head).
+    collect_layers: also return {"embed": [B,S,E], "layers": [L,B,S,E]}
+    (post-embedding and post-block activations) for the alignment harness
+    (reference: train_lora_gemma.cpp:620-920 npy dumps, GPT2_ALIGN_DUMP_DIR
+    in gpt2_model.cpp:327-399).
     """
     from mobilefinetuner_tpu.parallel.offload import resolve_offload
     B, S = input_ids.shape
@@ -157,14 +162,20 @@ def hidden_states(config: GPT2Config, params, input_ids,
     slice_layer = layer_slicer(params["blocks"], stream, compute_dtype)
     lora_b = None if lora is None else lora.get("blocks")
 
-    body = lambda x, i: (_block(config, slice_layer(i), x, padding_mask,
-                                lora_b, i, lora_dropout, dropout_rng), None)
+    embed_out = x
+
+    def body(x, i):
+        x2 = _block(config, slice_layer(i), x, padding_mask, lora_b, i,
+                    lora_dropout, dropout_rng)
+        return x2, (x2 if collect_layers else None)
     if remat or stream is not None:
         body = jax.checkpoint(body)
-    x, _ = jax.lax.scan(body, x, jnp.arange(config.n_layer))
+    x, layer_acts = jax.lax.scan(body, x, jnp.arange(config.n_layer))
     x = layer_norm(x, params["ln_f"]["g"].astype(compute_dtype),
                    params["ln_f"]["b"].astype(compute_dtype),
                    config.layer_norm_epsilon)
+    if collect_layers:
+        return x, {"embed": embed_out, "layers": layer_acts}
     return x
 
 

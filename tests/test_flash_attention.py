@@ -53,15 +53,25 @@ def test_forward_matches_oracle(case):
                                atol=2e-5, rtol=2e-5)
 
 
-def test_pick_block_keeps_odd_lengths_on_kernel():
-    """Raising the default block must not drop 128-multiples off the
-    kernel: S=1280 gets 256-blocks; non-multiples fall back (None)."""
-    from mobilefinetuner_tpu.ops.flash_attention import _pick_block
-    assert _pick_block(1280, 512) == 256
-    assert _pick_block(1024, 512) == 512
-    assert _pick_block(1664, 512) == 128
-    assert _pick_block(64, 512) == 64
-    assert _pick_block(130, 512) is None
+def test_valid_blocks_covers_odd_lengths():
+    """Raising the default block must not drop previously-supported S off
+    the kernel, and every picked block must satisfy the Mosaic alignment
+    rules (block_q % 8, block_k % 128 or whole-S static block)."""
+    from mobilefinetuner_tpu.ops.flash_attention import _valid_blocks
+    assert _valid_blocks(1280, 512, 512) == (256, 256)
+    assert _valid_blocks(1024, 512, 512) == (512, 512)
+    assert _valid_blocks(1664, 512, 512) == (128, 128)
+    # short/odd S: whole-S single block (statically indexed)
+    assert _valid_blocks(64, 512, 512) == (64, 64)
+    assert _valid_blocks(192, 512, 512) == (192, 192)
+    # not 8-aligned -> XLA fallback
+    assert _valid_blocks(130, 512, 512) is None
+    # 8-aligned but no 128-divisor and > 1024: whole-S block would blow
+    # VMEM -> fallback (1288 % 8 == 0, 1288 % 128 != 0)
+    assert _valid_blocks(1288, 512, 512) is None
+    for S in (256, 512, 1024, 2048):
+        bq, bk = _valid_blocks(S, 512, 512)
+        assert bq % 8 == 0 and (bk % 128 == 0 or bk == S)
 
 
 def test_forward_with_padding_mask():

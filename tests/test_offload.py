@@ -201,8 +201,10 @@ def test_streaming_bounds_compiled_peak_memory():
         # (e.g. the parent pytest process holds the TPU) is a skip; any
         # other crash is a real failure
         err = proc.stderr.lower()
-        if any(s in err for s in ("already in use", "unable to initialize",
-                                  "failed to", "device or resource busy")):
+        if any(s in err for s in ("already in use",
+                                  "unable to initialize backend",
+                                  "failed to initialize",
+                                  "device or resource busy")):
             pytest.skip(f"accelerator unavailable in subprocess: "
                         f"{proc.stderr.strip().splitlines()[-1][:200]}")
         raise AssertionError((proc.returncode, proc.stderr[-2000:]))

@@ -1,0 +1,200 @@
+"""PyTorch/PEFT mirror for the alignment harness.
+
+Counterpart of align/dump.py (reference: pytorch_alignment/
+gpt2_lora_finetune.py + gemma_lora_finetune.py and the npy comparison flow
+of train_lora_gemma.cpp:620-920): loads the SAME checkpoint, the SAME
+dumped batch, and the SAME adapter (via the dump's PEFT export), recomputes
+every dumped tensor with HF transformers + PEFT + torch.optim.AdamW, and
+reports max abs/rel errors per tensor plus the N-step loss-curve gap.
+
+Usage:
+  python tools/align_torch_mirror.py --dump_dir DUMP [--tol 2e-3]
+
+The model dir, family, and hyperparameters come from DUMP/meta.json.
+Prints one JSON report line; exit 0 iff every tensor is within --tol
+relative error (relative to the torch reference's max |value|).
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def load_dump(d):
+    meta = json.load(open(os.path.join(d, "meta.json")))
+    arr = lambda n: np.load(os.path.join(d, n + ".npy"))
+    batch = {k: arr("batch_" + k)
+             for k in ("input_ids", "attention_mask", "labels")}
+    return meta, batch, arr
+
+
+def build_model(meta):
+    import torch
+    from peft import PeftModel
+    from transformers import AutoModelForCausalLM
+    torch.manual_seed(0)
+    model = AutoModelForCausalLM.from_pretrained(
+        meta["model_dir"], torch_dtype=torch.float32,
+        attn_implementation="eager")
+    model = PeftModel.from_pretrained(model, meta["peft_dir"],
+                                      is_trainable=True)
+    model.eval()  # deterministic: all dropout off (align runs use p=0)
+    return model
+
+
+def block_modules(model, family):
+    """Ordered per-layer block modules + the module path templates used by
+    the PEFT export (lora/peft_io.py mapping tables)."""
+    pat = re.compile(r"\.transformer\.h\.(\d+)$" if family == "gpt2"
+                     else r"\.model\.layers\.(\d+)$")
+    blocks = {}
+    for name, mod in model.named_modules():
+        m = pat.search(name)
+        if m:
+            blocks[int(m.group(1))] = mod
+    return [blocks[i] for i in range(len(blocks))]
+
+
+def lora_param(params_by_name, family, target, layer, which):
+    """The torch Parameter for our (target, layer) A/B leaf."""
+    from mobilefinetuner_tpu.lora.peft_io import (GEMMA_PEFT_MODULES,
+                                                  GPT2_PEFT_MODULES)
+    modules = GPT2_PEFT_MODULES if family == "gpt2" else GEMMA_PEFT_MODULES
+    path = ("base_model.model." + modules[target].format(layer)
+            + f".lora_{which}.default.weight")
+    return params_by_name[path]
+
+
+def stacked_lora(params_by_name, family, target, which, n_layers,
+                 grad=False):
+    """[L, ...] array in OUR layout (A [L,in,r], B [L,r,out]) from the
+    torch per-layer [r,in]/[out,r] parameters (or their grads)."""
+    outs = []
+    for i in range(n_layers):
+        p = lora_param(params_by_name, family, target, i, which)
+        t = p.grad if grad else p.detach()
+        outs.append(t.numpy().T)
+    return np.stack(outs)
+
+
+def rel_err(ours, ref):
+    ref = np.asarray(ref, np.float32)
+    ours = np.asarray(ours, np.float32)
+    denom = max(float(np.max(np.abs(ref))), 1e-8)
+    return float(np.max(np.abs(ours - ref))) / denom
+
+
+def main(argv=None):
+    import torch
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dump_dir", required=True)
+    ap.add_argument("--tol", type=float, default=2e-3)
+    args = ap.parse_args(argv)
+
+    meta, batch, arr = load_dump(args.dump_dir)
+    meta.setdefault("peft_dir", os.path.join(args.dump_dir, "peft"))
+    family, L = meta["family"], meta["n_layers"]
+    model = build_model(meta)
+    blocks = block_modules(model, family)
+    assert len(blocks) == L, (len(blocks), L)
+    params_by_name = dict(model.named_parameters())
+
+    acts = {}
+    hooks = [blocks[0].register_forward_pre_hook(
+        lambda mod, a: acts.__setitem__("embed", a[0].detach().numpy()))]
+    for i, blk in enumerate(blocks):
+        hooks.append(blk.register_forward_hook(
+            (lambda i: lambda mod, a, out:
+             acts.__setitem__(i, out[0].detach().numpy()))(i)))
+
+    ids = torch.tensor(batch["input_ids"], dtype=torch.long)
+    am = torch.tensor(batch["attention_mask"], dtype=torch.long)
+    labels = torch.tensor(batch["labels"], dtype=torch.long)
+
+    out = model(input_ids=ids, attention_mask=am, labels=labels)
+    for h in hooks:
+        h.remove()
+
+    report = {"tensors": {}}
+
+    def cmp(name, ours_file_or_arr, ref):
+        ours = (arr(ours_file_or_arr)
+                if isinstance(ours_file_or_arr, str) else ours_file_or_arr)
+        report["tensors"][name] = round(rel_err(ours, ref), 6)
+
+    cmp("act_embed", "act_embed", acts["embed"])
+    for i in range(L):
+        cmp(f"act_layer_{i:02d}", f"act_layer_{i:02d}", acts[i])
+    cmp("logits", "logits", out.logits.detach().numpy())
+    cmp("loss", "loss", out.loss.detach().numpy())
+
+    # ---- adapter grads of the mean loss
+    out.loss.backward()
+    grads_dir = os.path.join(args.dump_dir, "grads", "blocks")
+    for target in meta["targets"]:
+        for which in ("A", "B"):
+            ours = np.load(os.path.join(grads_dir, target,
+                                        which + ".npy"))
+            ref = stacked_lora(params_by_name, family, target, which, L,
+                               grad=True)
+            cmp(f"grad.{target}.{which}", ours, ref)
+
+    # ---- N optimizer steps on the same batch: post-step adapter + curve.
+    # coupled mode = L2-into-gradient decay, which is torch.optim.Adam's
+    # weight_decay semantics; decoupled = torch.optim.AdamW.
+    lora_params = [p for n, p in params_by_name.items()
+                   if "lora_" in n and p.requires_grad]
+    opt_cls = (torch.optim.Adam if meta.get("coupled_weight_decay")
+               else torch.optim.AdamW)
+    opt = opt_cls(lora_params, lr=meta["lr"], betas=(0.9, 0.999),
+                  eps=1e-8, weight_decay=meta["weight_decay"])
+    losses = []
+    for s in range(meta["steps"]):
+        if s > 0:
+            opt.zero_grad()
+            loss = model(input_ids=ids, attention_mask=am,
+                         labels=labels).loss
+            loss.backward()
+        else:
+            loss = out.loss  # grads already computed above
+        losses.append(float(loss.detach()))
+        if meta["clip_grad_norm"]:
+            torch.nn.utils.clip_grad_norm_(lora_params,
+                                           meta["clip_grad_norm"])
+        opt.step()
+        if s == 0:
+            post_dir = os.path.join(args.dump_dir, "adapter_post",
+                                    "blocks")
+            for target in meta["targets"]:
+                for which in ("A", "B"):
+                    ours = np.load(os.path.join(post_dir, target,
+                                                which + ".npy"))
+                    ref = stacked_lora(params_by_name, family, target,
+                                       which, L)
+                    cmp(f"post_step.{target}.{which}", ours, ref)
+
+    ours_losses = arr("losses")
+    report["loss_curve"] = {
+        "ours": [round(float(x), 6) for x in ours_losses],
+        "torch": [round(x, 6) for x in losses],
+        "max_abs_diff": round(float(np.max(np.abs(
+            ours_losses - np.asarray(losses, np.float32)))), 6),
+    }
+    worst = max(report["tensors"].items(), key=lambda kv: kv[1])
+    report["worst"] = {"tensor": worst[0], "rel_err": worst[1]}
+    report["tol"] = args.tol
+    report["pass"] = bool(worst[1] < args.tol
+                          and report["loss_curve"]["max_abs_diff"]
+                          < args.tol * 10)
+    print(json.dumps(report))
+    return 0 if report["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,82 +1,276 @@
-"""Headline benchmark: GPT-2-small LoRA training throughput (tokens/sec/chip).
+"""Benchmark suite: the driver's BASELINE configs on one chip.
 
-Config mirrors the driver's primary config (BASELINE.json): GPT-2-small
-124M, LoRA r=8 alpha=16, seq_len=128, WikiText-2-shaped batches. Baseline is
-the reference's published epoch time — 4-6 h/epoch at batch=4, S=128 on a
-mobile SoC (reference README.md:419), i.e. ~2.39M-token WikiText-2 train
-split / 18000 s midpoint ≈ 133 tokens/sec.
+Covers the three driver configs (BASELINE.md): GPT-2-small LoRA (r=8 α=16
+S=128), GPT-2-small full fine-tune, and Gemma-3-270M LoRA (r=8 α=32 S=256,
+full targets, chunked 262k-vocab CE) — each with bf16/f32, grad-accum, and
+host-offload-streaming variants, plus a long-context config where the
+Pallas flash kernel (auto-dispatched) is measured against the forced XLA
+path. Per config: tokens/sec/chip, an MFU estimate, and the compiled peak
+device memory (XLA memory analysis: device args + temps + outputs − donated
+aliases; runtime memory_stats is not exposed on the tunneled platform).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference's analog is scripts/benchmark/ (wall-time + peak RSS over
+baseline-vs-sharded configs, measure_rss.sh:22-42) — peak compiled HBM is
+the TPU-native RSS, and the offload variants are the sharded runs.
+
+stdout: ONE JSON line (the headline GPT-2s LoRA config; driver contract).
+The full suite is written to BENCH_SUITE.json and summarized on stderr.
+Baseline: the reference's 4-6 h/epoch (batch=4, S=128, mobile SoC,
+README.md:419) ≈ 2.39M-token epoch / 18000 s ≈ 133 tokens/sec.
+
+Sync note: timings read a scalar back to host; block_until_ready does not
+wait on the tunneled TPU platform.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mobilefinetuner_tpu.core.config import GPT2Config
-from mobilefinetuner_tpu.lora.lora import (LoRASpec, init_lora_gpt2,
-                                           trainable_mask)
-from mobilefinetuner_tpu.models import gpt2
-from mobilefinetuner_tpu.ops.loss import lm_cross_entropy_sum
+from mobilefinetuner_tpu.core.config import GPT2Config, Gemma3TextConfig
+from mobilefinetuner_tpu.lora.lora import (LoRASpec, init_lora_gemma3,
+                                           init_lora_gpt2, trainable_mask)
+from mobilefinetuner_tpu.models import gemma3, gpt2
+from mobilefinetuner_tpu.ops.loss import (chunked_lm_cross_entropy_sum,
+                                          lm_cross_entropy_sum)
+from mobilefinetuner_tpu.parallel.mesh import (make_mesh,
+                                               replicated_sharding)
+from mobilefinetuner_tpu.parallel.offload import (OffloadConfig,
+                                                  apply_placement,
+                                                  plan_placement,
+                                                  resolve_offload)
 from mobilefinetuner_tpu.train.trainer import (TrainConfig, init_optimizer,
                                                make_train_step)
 
 BASELINE_TOKENS_PER_SEC = 2_391_884 / 18_000.0  # ≈ 132.9 (reference CPU)
+# TPU v5e (lite) peak: 197 TFLOP/s bf16 per chip (public spec). The same
+# number applies to "float32" configs: XLA's default matmul precision on
+# TPU runs f32 matmuls as bf16 passes on the MXU, so the available peak is
+# the bf16 one (measured f32 MFU vs a hypothetical smaller f32 peak came
+# out >1, confirming the default-precision lowering).
+PEAK_FLOPS = {"bfloat16": 197e12, "float32": 197e12}
 
 
-def main():
-    config = GPT2Config.gpt2_small()
-    on_tpu = jax.devices()[0].platform == "tpu"
-    batch, seq = (32, 128) if on_tpu else (4, 64)
-    compute_dtype = jnp.bfloat16 if on_tpu else jnp.float32
-    steps = 50 if on_tpu else 3
+def transformer_flops(n_params_active, n_params_frozen, B, S, n_layer,
+                      n_head, head_dim, full_ft):
+    """FLOPs per optimizer step (forward+backward), standard estimate:
+    matmul fwd = 2*N*T; backward dx = 2*N*T always (the loss gradient
+    flows through frozen weights to reach LoRA/embedding sites), dW only
+    for trained weights; + attention 2*2*B*H*S^2*D fwd, doubled in bwd."""
+    T = B * S
+    N = n_params_active + n_params_frozen
+    fwd = 2 * N * T
+    bwd = 2 * N * T + 2 * (n_params_active if not full_ft else N) * T
+    attn = 4 * B * n_layer * n_head * S * S * head_dim
+    return fwd + bwd + 3 * attn
 
+
+def measure(step_fn, trainable, frozen, opt, batch, steps) -> dict:
+    from mobilefinetuner_tpu.core.xla_stats import compiled_peak_bytes
+    # AOT-compile once and call the executable directly (jit dispatch
+    # would recompile: AOT results don't populate the jit cache), reusing
+    # the same compiled object for the memory analysis.
+    compiled = step_fn.lower(trainable, frozen, opt, batch,
+                             jnp.int32(0)).compile()
+    peak = compiled_peak_bytes(compiled)
+    tr, op = trainable, opt
+    for s in range(3):
+        tr, op, m = compiled(tr, frozen, op, batch, jnp.int32(s))
+    float(m["loss"])  # host sync
+    t0 = time.perf_counter()
+    for s in range(steps):
+        tr, op, m = compiled(tr, frozen, op, batch, jnp.int32(s + 3))
+    loss = float(m["loss"])
+    dt = time.perf_counter() - t0
+    return {"dt": dt, "loss": loss, "peak_bytes": peak}
+
+
+def synth_batch(vocab, B, S, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = jnp.asarray(rng.integers(0, vocab, (B, S)), jnp.int32)
+    return {"input_ids": ids, "attention_mask": jnp.ones_like(ids),
+            "labels": ids}
+
+
+def offload_setup(params, budget_bytes=0):
+    ocfg = OffloadConfig(enable=True, max_resident_bytes=budget_bytes,
+                         offload_dtype="bfloat16")
+    plan = plan_placement(params, ocfg)
+    sh = replicated_sharding(make_mesh(1, 1, devices=jax.devices()[:1]))
+    shardings = jax.tree.map(lambda _: sh, params)
+    placed = apply_placement(params, plan, shardings, ocfg)
+    return placed, (plan, shardings)
+
+
+def bench_gpt2_lora(B, S, dtype, accum=1, offload=False, impl="auto",
+                    steps=40):
+    config = dataclasses.replace(GPT2Config.gpt2_small(),
+                                 attention_impl=impl)
     params = gpt2.init_params(config, jax.random.PRNGKey(0))
     spec = LoRASpec(rank=8, alpha=16.0)
     lora = init_lora_gpt2(config, spec, jax.random.PRNGKey(1))
     mask = trainable_mask(lora)
     tc = TrainConfig(total_steps=1000, lr=2e-4, schedule="constant",
-                     warmup_ratio=0.0, grad_accum_steps=1)
+                     warmup_ratio=0.0, grad_accum_steps=accum)
+    off = None
+    if offload:
+        params, off = offload_setup(params)
 
-    def loss_fn(lora, params, mb):
-        logits = gpt2.forward(config, params, mb["input_ids"],
-                              attention_mask=mb["attention_mask"], lora=lora,
-                              compute_dtype=compute_dtype)
+    def loss_fn(lora_t, p, mb):
+        logits = gpt2.forward(config, p, mb["input_ids"],
+                              attention_mask=mb["attention_mask"],
+                              lora=lora_t, compute_dtype=dtype,
+                              offload=off)
         return lm_cross_entropy_sum(logits, mb["labels"])
 
     step_fn = make_train_step(loss_fn, tc, mask=mask, donate=True)
     opt = init_optimizer(lora, tc, mask)
+    batch = synth_batch(config.vocab_size, B * accum, S)
+    r = measure(step_fn, lora, params, opt, batch, steps)
+    n_frozen = gpt2.param_count(params)
+    n_active = sum(x.size for x in jax.tree.leaves(lora))
+    r["flops"] = transformer_flops(n_active, n_frozen, B * accum, S,
+                                   config.n_layer, config.n_head,
+                                   config.head_dim, full_ft=False)
+    r["tokens"] = B * accum * S
+    return r
 
-    rng = np.random.default_rng(0)
-    ids = jnp.asarray(rng.integers(0, config.vocab_size, (batch, seq)),
-                      jnp.int32)
-    b = {"input_ids": ids, "attention_mask": jnp.ones_like(ids),
-         "labels": ids}
 
-    # Warmup: compile + 2 steady-state steps. NOTE: sync via host readback
-    # of a scalar, not block_until_ready — the latter does not actually
-    # wait for completion on the tunneled TPU platform.
-    for s in range(3):
-        lora, opt, m = step_fn(lora, params, opt, b, jnp.int32(s))
-    float(m["loss"])
+def bench_gpt2_full(B, S, dtype, steps=40):
+    config = GPT2Config.gpt2_small()
+    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    tc = TrainConfig(total_steps=1000, lr=2e-5, schedule="constant",
+                     warmup_ratio=0.0, grad_accum_steps=1)
 
-    t0 = time.perf_counter()
-    for s in range(steps):
-        lora, opt, m = step_fn(lora, params, opt, b, jnp.int32(s + 3))
-    float(m["loss"])
-    dt = time.perf_counter() - t0
+    def loss_fn(p, _unused, mb):
+        logits = gpt2.forward(config, p, mb["input_ids"],
+                              attention_mask=mb["attention_mask"],
+                              compute_dtype=dtype)
+        return lm_cross_entropy_sum(logits, mb["labels"])
 
-    toks_per_sec = batch * seq * steps / dt
+    step_fn = make_train_step(loss_fn, tc, mask=None, donate=True)
+    opt = init_optimizer(params, tc, None)
+    batch = synth_batch(config.vocab_size, B, S)
+    r = measure(step_fn, params, {}, opt, batch, steps)
+    n = gpt2.param_count(params)
+    r["flops"] = transformer_flops(n, 0, B, S, config.n_layer,
+                                   config.n_head, config.head_dim,
+                                   full_ft=True)
+    r["tokens"] = B * S
+    return r
+
+
+def bench_gemma_lora(B, S, dtype, accum=1, offload=False, steps=20):
+    config = Gemma3TextConfig.gemma3_270m()
+    params = gemma3.init_params(config, jax.random.PRNGKey(0))
+    spec = LoRASpec(rank=8, alpha=32.0, targets="full")
+    lora = init_lora_gemma3(config, spec, jax.random.PRNGKey(1))
+    mask = trainable_mask(lora)
+    tc = TrainConfig(total_steps=1000, lr=2e-4, schedule="constant",
+                     warmup_ratio=0.0, grad_accum_steps=accum)
+    off = None
+    if offload:
+        params, off = offload_setup(params)
+
+    def loss_fn(lora_t, p, mb):
+        p2, stream = resolve_offload(p, off)
+        hidden = gemma3.hidden_states(
+            config, p2, mb["input_ids"],
+            attention_mask=mb["attention_mask"], lora=lora_t,
+            compute_dtype=dtype, block_stream=stream)
+        return chunked_lm_cross_entropy_sum(hidden, p2["embed"],
+                                            mb["labels"], num_chunks=8)
+
+    step_fn = make_train_step(loss_fn, tc, mask=mask, donate=True)
+    opt = init_optimizer(lora, tc, mask)
+    batch = synth_batch(config.vocab_size, B * accum, S)
+    r = measure(step_fn, lora, params, opt, batch, steps)
+    n_frozen = sum(x.size for x in jax.tree.leaves(params))
+    n_active = sum(x.size for x in jax.tree.leaves(lora))
+    r["flops"] = transformer_flops(
+        n_active, n_frozen, B * accum, S, config.num_hidden_layers,
+        config.num_attention_heads, config.head_dim, full_ft=False)
+    r["tokens"] = B * accum * S
+    return r
+
+
+def finish(name, r, dtype, steps) -> dict:
+    toks_per_sec = r["tokens"] * steps / r["dt"]
+    return {
+        "config": name,
+        "tokens_per_sec_per_chip": round(toks_per_sec, 1),
+        "vs_baseline": round(toks_per_sec / BASELINE_TOKENS_PER_SEC, 2),
+        "mfu": round(r["flops"] * steps / r["dt"] / PEAK_FLOPS[dtype], 4),
+        "peak_hbm_mb": round(r["peak_bytes"] / 2 ** 20, 1),
+        "loss": round(r["loss"], 4),
+    }
+
+
+def main():
+    on_tpu = jax.devices()[0].platform != "cpu"
+    steps = 40 if on_tpu else 2
+    gsteps = 20 if on_tpu else 2
+    bf16, f32 = "bfloat16", "float32"
+    B = 32 if on_tpu else 2
+    S = 128 if on_tpu else 64
+    GB, GS = (8, 256) if on_tpu else (2, 64)
+
+    suite = []
+
+    def run(name, fn, dtype, n, **kw):
+        try:
+            r = fn(dtype=jnp.bfloat16 if dtype == bf16 else jnp.float32,
+                   steps=n, **kw)
+            row = finish(name, r, dtype, n)
+        except Exception as e:  # record, don't kill the suite
+            row = {"config": name, "error": f"{type(e).__name__}: {e}"}
+        suite.append(row)
+        print(json.dumps(row), file=sys.stderr)
+        return row
+
+    headline = run("gpt2s_lora_bf16_B32_S128", bench_gpt2_lora, bf16,
+                   steps, B=B, S=S)
+    if on_tpu:  # the full suite is a TPU artifact; off-TPU is a smoke
+        run("gpt2s_lora_f32_B32_S128", bench_gpt2_lora, f32, steps, B=B,
+            S=S)
+        run("gpt2s_lora_bf16_accum4", bench_gpt2_lora, bf16, steps,
+            B=max(B // 4, 1), S=S, accum=4)
+        run("gpt2s_lora_bf16_offload_stream", bench_gpt2_lora, bf16,
+            steps, B=B, S=S, offload=True)
+        run("gpt2s_full_bf16_B32_S128", bench_gpt2_full, bf16, steps,
+            B=B, S=S)
+        run("gpt2s_full_f32_B32_S128", bench_gpt2_full, f32, steps, B=B,
+            S=S)
+        run("gemma270m_lora_bf16_B8_S256", bench_gemma_lora, bf16,
+            gsteps, B=GB, S=GS)
+        run("gemma270m_lora_bf16_offload_stream", bench_gemma_lora, bf16,
+            gsteps, B=GB, S=GS, offload=True)
+        # flash vs xla at the long-context shape ('auto' resolves flash)
+        run("gpt2s_lora_bf16_S1024_flash", bench_gpt2_lora, bf16, steps,
+            B=4, S=1024, impl="flash")
+        run("gpt2s_lora_bf16_S1024_xla", bench_gpt2_lora, bf16, steps,
+            B=4, S=1024, impl="xla")
+
+    with open("BENCH_SUITE.json", "w") as f:
+        json.dump({"suite": suite,
+                   "peak_flops_assumed": PEAK_FLOPS,
+                   "baseline_tokens_per_sec": BASELINE_TOKENS_PER_SEC},
+                  f, indent=1)
+
+    # driver contract: exactly one JSON line on stdout (headline config)
     print(json.dumps({
         "metric": "gpt2s_lora_train_tokens_per_sec_per_chip",
-        "value": round(toks_per_sec, 1),
+        "value": headline.get("tokens_per_sec_per_chip", 0.0),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(toks_per_sec / BASELINE_TOKENS_PER_SEC, 2),
+        "vs_baseline": headline.get("vs_baseline", 0.0),
+        "mfu": headline.get("mfu", 0.0),
+        "peak_hbm_mb": headline.get("peak_hbm_mb", 0.0),
     }))
 
 

@@ -86,6 +86,14 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
                         "measured on v5e, tools/bench_attention.py); "
                         "'flash' = Pallas block-sparse kernel; 'xla' = "
                         "plain fused attention")
+    g.add_argument("--profile_dir", default="",
+                   help="emit a jax.profiler trace of a few steady-state "
+                        "steps to this directory (the reference's "
+                        "performance_monitor.h analog; view with "
+                        "tensorboard/xprof)")
+    g.add_argument("--profile_start", type=int, default=10,
+                   help="first profiled step (past compile+warmup)")
+    g.add_argument("--profile_steps", type=int, default=5)
 
 
 def add_align_flags(p: argparse.ArgumentParser):
@@ -267,6 +275,10 @@ def compute_dtype_from_args(args):
     return jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
 
+from mobilefinetuner_tpu.core.xla_stats import (compiled_peak_mb,
+                                                live_hbm_mb)
+
+
 def maybe_resume_opt_state(args, trainable, tc: TrainConfig, mask=None):
     """(opt_state, start_step) from the .opt sidecar next to
     --resume_from, or (None, 0). The sidecar carries Adam m/v AND the step
@@ -341,6 +353,30 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     t_start = time.time()
     metrics = {}
     epoch = 0
+    compiled_step = None       # AOT-compiled at the first step
+    peak_hbm = {"mb": 0.0}     # from the compiled step's memory analysis
+    profile_dir = getattr(args, "profile_dir", "")
+    prof_start = start_step + getattr(args, "profile_start", 10)
+    prof_end = prof_start + getattr(args, "profile_steps", 5)
+    prof_active = False
+
+    def maybe_profile(step):
+        nonlocal prof_active
+        if not profile_dir:
+            return
+        try:
+            if step == prof_start and not prof_active:
+                jax.profiler.start_trace(profile_dir)
+                prof_active = True
+            elif step >= prof_end and prof_active:
+                if metrics:
+                    jax.device_get(metrics["loss"])  # drain queued work
+                jax.profiler.stop_trace()
+                prof_active = False
+                log.info(f"profiler trace -> {profile_dir}")
+        except Exception as e:  # profiling must never kill training
+            log.warning(f"profiler: {e}")
+            prof_active = False
 
     # Per-step metrics stay on device; they are buffered and pulled to host
     # in ONE device_get per log boundary. An unconditional per-step
@@ -367,13 +403,14 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
         fetched = jax.device_get([m for _, _, _, m in buffered])
         dt_ms = ((time.perf_counter() - t_interval) * 1000 - slept_ms) \
             / len(buffered)
+        hbm = live_hbm_mb() or peak_hbm["mb"]
         for (s, ep, toks, _), m in zip(buffered, fetched):
             loss = float(m["loss"])
             avg = ema.update(loss)
             if metrics_csv:
                 metrics_csv.log(epoch=ep, step=s + 1, loss=loss,
                                 avg_loss=avg, lr=float(m["lr"]),
-                                step_time_ms=dt_ms)
+                                step_time_ms=dt_ms, hbm_mb=hbm)
         s, ep, toks, _ = buffered[-1]
         m = fetched[-1]
         if emit_log and args.log_interval:
@@ -396,7 +433,19 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 jax.random.fold_in(dropout_rng, step), n)
         if mesh is not None:
             batch = shard_batch(batch, mesh)
-        trainable, opt_state, metrics = step_fn(
+        if compiled_step is None:
+            # AOT compile once: the SAME executable serves every step
+            # (shapes are static), and its memory analysis gives peak HBM
+            # for free — no second trace/compile on the jit cache path.
+            compiled_step = step_fn.lower(
+                trainable, frozen, opt_state, batch,
+                jnp.int32(step)).compile()
+            peak_hbm["mb"] = compiled_peak_mb(compiled_step)
+            if peak_hbm["mb"]:
+                log.info(f"compiled step peak HBM: "
+                         f"{peak_hbm['mb']:.0f} MB")
+        maybe_profile(step)
+        trainable, opt_state, metrics = compiled_step(
             trainable, frozen, opt_state, batch, jnp.int32(step))
         toks = batch["input_ids"].shape[0] * batch["input_ids"].shape[1]
         buffered.append((step, epoch, toks, metrics))
@@ -429,6 +478,8 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
 
         slept_ms += governor.throttle(step)
 
+    if prof_active:
+        maybe_profile(prof_end)  # close an unfinished trace
     flush_metrics()
     if valid_ds is not None and args.eval_interval:
         ev = evaluate(eval_step, trainable, frozen, valid_ds,
@@ -440,6 +491,9 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                               "tokens": ev["tokens"]})
     if save_hook:
         save_hook(total_steps, trainable, opt_state, final=True)
+    live = live_hbm_mb()
+    log.info(f"peak HBM: {peak_hbm['mb']:.0f} MB (compiled estimate)"
+             + (f", {live:.0f} MB live" if live else ""))
     if metrics_csv:
         metrics_csv.close()
     return trainable, opt_state, metrics

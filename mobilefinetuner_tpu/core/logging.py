@@ -47,15 +47,27 @@ def get_logger(name: str = "mft", level: str = "INFO",
 class MetricsLogger:
     """CSV training-metrics sink, one row per logged step.
 
-    Columns mirror the reference MetricsLogger (logger.h:131-190).
+    Columns mirror the reference MetricsLogger (logger.h:131-190) plus
+    hbm_mb — the observability analog of the reference's per-interval
+    memory prints (gpt2_lora_finetune/main.cpp:639-642): live device
+    bytes-in-use when the platform exposes memory_stats(), else the
+    compiled peak estimate the caller provides.
     """
 
     COLUMNS = ["timestamp", "epoch", "step", "loss", "avg_loss", "lr",
-               "step_time_ms"]
+               "step_time_ms", "hbm_mb"]
 
     def __init__(self, path: str):
         self.path = path
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        if os.path.exists(path):
+            with open(path, newline="") as f:
+                header = f.readline().strip().split(",")
+            if header != self.COLUMNS:
+                # column set changed since the file was started (e.g. a
+                # resumed pre-hbm_mb run): rotate rather than appending
+                # rows that disagree with the header
+                os.replace(path, path + ".old")
         new = not os.path.exists(path)
         self._f = open(path, "a", newline="")
         self._w = csv.writer(self._f)
@@ -64,10 +76,10 @@ class MetricsLogger:
             self._f.flush()
 
     def log(self, epoch: int, step: int, loss: float, avg_loss: float,
-            lr: float, step_time_ms: float):
+            lr: float, step_time_ms: float, hbm_mb: float = 0.0):
         self._w.writerow([f"{time.time():.3f}", epoch, step, f"{loss:.6f}",
                           f"{avg_loss:.6f}", f"{lr:.8f}",
-                          f"{step_time_ms:.2f}"])
+                          f"{step_time_ms:.2f}", f"{hbm_mb:.1f}"])
         self._f.flush()
 
     def close(self):

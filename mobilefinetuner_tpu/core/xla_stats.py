@@ -1,0 +1,39 @@
+"""Device-memory accounting shared by the training loop and benchmarks.
+
+The compile-time peak is the TPU-native analog of the reference's RSS
+reporting (reference: scripts/Finetune/measure_rss.sh:22-42,
+performance_monitor.h:18-33 MemorySnapshot): XLA's memory analysis of a
+compiled program is exact for static shapes, and unlike runtime
+memory_stats() it is available on every platform including the tunneled
+TPU used in CI.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def compiled_peak_bytes(compiled) -> int:
+    """Peak device memory of a compiled program: arguments + temps +
+    outputs minus donated aliases. Returns 0 when the backend does not
+    report memory analysis."""
+    try:
+        ma = compiled.memory_analysis()
+        return int(ma.argument_size_in_bytes + ma.temp_size_in_bytes
+                   + ma.output_size_in_bytes - ma.alias_size_in_bytes)
+    except Exception:
+        return 0
+
+
+def compiled_peak_mb(compiled) -> float:
+    return compiled_peak_bytes(compiled) / 2 ** 20
+
+
+def live_hbm_mb() -> float:
+    """Device bytes-in-use, when the platform exposes memory_stats()
+    (the tunneled TPU platform does not; CPU and direct TPU do)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return stats.get("bytes_in_use", 0) / 2 ** 20
+    except Exception:
+        return 0.0

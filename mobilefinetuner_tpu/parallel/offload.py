@@ -240,13 +240,26 @@ def resolve_offload(params, offload, blocks_key: str = "blocks"):
     top = {k: v for k, v in params.items() if k != blocks_key}
     top = fetch(top, {k: plan[k] for k in top},
                 {k: shardings[k] for k in top})
-    params = dict(top, **{blocks_key: params[blocks_key]})
-    if not any_offloaded(plan[blocks_key]):
+    blocks, bplan, bshard = (params[blocks_key], plan[blocks_key],
+                             shardings[blocks_key])
+    # Only >=3-D stacks ([L, in, out] weights) stream per layer. 2-D
+    # stacks (biases/norms, [L, n]) are fetched whole up front: their
+    # per-layer slices would be 1-row transfers, which the TPU host-DMA
+    # path rejects at larger n (observed on v5e: [2304] and [1, 2304]
+    # host->device dynamic slices fail with INTERNAL while [768, 2304]
+    # works), and all of a model's 2-D stacks together are <1% of its
+    # bytes — streaming them would save nothing.
+    whole = jax.tree.map(lambda t, o: bool(o) and jnp.ndim(t) <= 2,
+                         blocks, bplan)
+    stream_plan = jax.tree.map(lambda t, o: bool(o) and jnp.ndim(t) >= 3,
+                               blocks, bplan)
+    blocks = fetch(blocks, whole, bshard)
+    params = dict(top, **{blocks_key: blocks})
+    if not any_offloaded(stream_plan):
         return params, None
 
     def stream(blocks, i, compute_dtype):
-        return fetch_layer(blocks, plan[blocks_key], i,
-                           shardings[blocks_key], compute_dtype)
+        return fetch_layer(blocks, stream_plan, i, bshard, compute_dtype)
     return params, stream
 
 

@@ -109,6 +109,28 @@ def test_gpt2_lora_training_reduces_loss(gpt2_dir, wiki_dir, tmp_path):
     assert last < first, (first, last)
 
 
+def test_profiler_trace_and_hbm_column(gpt2_dir, wiki_dir, tmp_path):
+    """--profile_dir emits a jax.profiler trace and the metrics CSV carries
+    the hbm_mb observability column (performance_monitor.h:44-57 analog)."""
+    from mobilefinetuner_tpu.cli.gpt2_lora_finetune import main
+    prof = str(tmp_path / "prof")
+    csv_path = str(tmp_path / "m.csv")
+    rc = main(["--pretrained_dir", gpt2_dir, "--data_dir", wiki_dir,
+               "--steps", "6", "--batch_size", "2", "--seq_len", "32",
+               "--lora_out", str(tmp_path / "a.safetensors"),
+               "--profile_dir", prof, "--profile_start", "2",
+               "--profile_steps", "2", "--metrics_csv", csv_path])
+    assert rc == 0
+    trace_files = [os.path.join(r, f) for r, _, fs in os.walk(prof)
+                   for f in fs]
+    assert trace_files, "no profiler trace emitted"
+    import csv as csv_mod
+    with open(csv_path) as f:
+        rows = list(csv_mod.DictReader(f))
+    assert "hbm_mb" in rows[0]
+    assert float(rows[0]["hbm_mb"]) > 0
+
+
 def test_gpt2_lora_with_offload_and_governor(gpt2_dir, wiki_dir, tmp_path):
     """shard_* + pm_* flags wired end-to-end (sharded-training smoke,
     scripts/benchmark/test_all_models_sharding.sh analog)."""

@@ -263,16 +263,24 @@ def main():
                    "baseline_tokens_per_sec": BASELINE_TOKENS_PER_SEC},
                   f, indent=1)
 
-    # driver contract: exactly one JSON line on stdout (headline config)
+    # driver contract: exactly one JSON line on stdout (headline config);
+    # a failed headline must FAIL the run, not report a zero measurement
+    if "error" in headline:
+        print(json.dumps({
+            "metric": "gpt2s_lora_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+            "error": headline["error"]}))
+        return 1
     print(json.dumps({
         "metric": "gpt2s_lora_train_tokens_per_sec_per_chip",
-        "value": headline.get("tokens_per_sec_per_chip", 0.0),
+        "value": headline["tokens_per_sec_per_chip"],
         "unit": "tokens/sec/chip",
-        "vs_baseline": headline.get("vs_baseline", 0.0),
-        "mfu": headline.get("mfu", 0.0),
-        "peak_hbm_mb": headline.get("peak_hbm_mb", 0.0),
+        "vs_baseline": headline["vs_baseline"],
+        "mfu": headline["mfu"],
+        "peak_hbm_mb": headline["peak_hbm_mb"],
     }))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

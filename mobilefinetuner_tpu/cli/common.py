@@ -22,6 +22,8 @@ import numpy as np
 
 from mobilefinetuner_tpu.core.logging import (JSONLWriter, MetricsLogger,
                                               get_logger)
+from mobilefinetuner_tpu.core.xla_stats import (compiled_peak_mb,
+                                                live_hbm_mb)
 from mobilefinetuner_tpu.data.wikitext2 import WikiText2Dataset
 from mobilefinetuner_tpu.ops.loss import perplexity_from_loss
 from mobilefinetuner_tpu.parallel.mesh import (make_mesh,
@@ -273,10 +275,6 @@ def evaluate(eval_step, trainable, frozen, dataset: WikiText2Dataset,
 
 def compute_dtype_from_args(args):
     return jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-
-
-from mobilefinetuner_tpu.core.xla_stats import (compiled_peak_mb,
-                                                live_hbm_mb)
 
 
 def maybe_resume_opt_state(args, trainable, tc: TrainConfig, mask=None):

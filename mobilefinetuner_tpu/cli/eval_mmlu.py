@@ -91,6 +91,12 @@ def main(argv=None) -> int:
             params = merge_gpt2(params, lora)
             lora = None
 
+    # Commit weights to device once; numpy-backed jit args would be
+    # re-transferred per item (see eval_ppl.py).
+    params = jax.device_put(params)
+    if lora is not None:
+        lora = jax.device_put(lora)
+
     tok = GPT2BPETokenizer.from_pretrained(args.pretrained_dir)
     by_subject = mmlu.load_split(args.mmlu_root, args.split)
     n_items = sum(len(v) for v in by_subject.values())

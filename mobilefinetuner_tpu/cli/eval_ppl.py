@@ -1,23 +1,30 @@
-"""WikiText-2 perplexity evaluation CLI.
+"""WikiText-2 perplexity evaluation CLI (GPT-2 AND Gemma-3).
 
 TPU-native rebuild of the reference `eval_ppl` binary
-(reference: gpt2_lora_finetune/eval_ppl.cpp): load GPT-2 (+ optional LoRA
-adapter, merged into the base weights or applied dynamically,
+(reference: gpt2_lora_finetune/eval_ppl.cpp): load the model (+ optional
+LoRA adapter, merged into the base weights or applied dynamically,
 eval_ppl.cpp:110-127), run the split with token-weighted mean NLL
 (mean_nll = Σ(loss·tokens)/Σtokens; ppl = exp(mean_nll),
 eval_ppl.cpp:157-200), JSONL progress + final record, unmerge after
 (eval_ppl.cpp:222 — moot here: merge is functional, the base tree is never
-mutated).
+mutated). Goes beyond the reference by also covering Gemma-3 adapters
+(merge via merge_gemma3 or dynamic), with the 262k-vocab head evaluated
+through the chunked CE so [B,S,262144] fp32 logits are never materialized
+— the reference has no Gemma eval binary at all.
 
 Usage:
   python -m mobilefinetuner_tpu.cli.eval_ppl \
-      --pretrained_dir /path/gpt2 --data_root /path/wikitext-2 \
+      --pretrained_dir /path/gpt2-or-gemma --data_root /path/wikitext-2 \
       --split valid [--lora_path adapter.safetensors --lora_merge]
+The model family is auto-detected from config.json (model_type /
+text_config); force with --family.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import sys
 import time
 
@@ -57,38 +64,94 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--out", default="", help="JSONL output path")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
                    default="float32")
+    p.add_argument("--family", choices=["auto", "gpt2", "gemma"],
+                   default="auto")
+    p.add_argument("--loss_chunks", type=int, default=8,
+                   help="sequence chunks for Gemma's 262k-vocab chunked "
+                        "CE")
     return p
+
+
+def detect_family(model_dir: str) -> str:
+    """gpt2 vs gemma from config.json (model_type or nested text_config)."""
+    with open(os.path.join(model_dir, "config.json")) as f:
+        raw = json.load(f)
+    mt = str(raw.get("model_type", "")).lower()
+    if "gemma" in mt or "text_config" in raw:
+        return "gemma"
+    return "gpt2"
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    config, params = load_gpt2(args.pretrained_dir)
-    args.seq_len = min(args.seq_len, config.n_positions)
+    family = args.family
+    if family == "auto":
+        family = detect_family(args.pretrained_dir)
+    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
-    lora = None
+    lora = spec = None
     if args.lora_path:
         lora, spec = peft_io.load_adapter(args.lora_path)
         log.info(f"adapter: r={spec.rank} alpha={spec.alpha} "
                  f"targets={spec.targets} "
                  f"({'merged' if args.lora_merge else 'dynamic'})")
-        if args.lora_merge:
+
+    if family == "gemma":
+        from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
+        from mobilefinetuner_tpu.io.checkpoints import load_gemma3
+        from mobilefinetuner_tpu.lora.lora import merge_gemma3
+        from mobilefinetuner_tpu.models import gemma3
+        from mobilefinetuner_tpu.ops.loss import chunked_lm_cross_entropy_sum
+        config, params = load_gemma3(args.pretrained_dir)
+        if lora is not None and args.lora_merge:
+            params = merge_gemma3(params, lora)
+            lora = None
+        tok = GemmaTokenizer.from_pretrained(args.pretrained_dir)
+        encode = lambda s: tok.encode(s, add_bos=False)
+        eos_id, pad_id = tok.eos_id, tok.pad_id
+
+        @jax.jit
+        def step(params, lora, batch):
+            hidden = gemma3.hidden_states(
+                config, params, batch["input_ids"],
+                attention_mask=batch["attention_mask"], lora=lora,
+                compute_dtype=compute_dtype)
+            return chunked_lm_cross_entropy_sum(
+                hidden, params["embed"], batch["labels"],
+                num_chunks=args.loss_chunks)
+
+        max_pos = config.max_position_embeddings
+    else:
+        config, params = load_gpt2(args.pretrained_dir)
+        if lora is not None and args.lora_merge:
             params = merge_gpt2(params, lora)
             lora = None
+        tok = GPT2BPETokenizer.from_pretrained(args.pretrained_dir)
+        encode, eos_id, pad_id = tok.encode, tok.eos_id, None
 
-    tok = GPT2BPETokenizer.from_pretrained(args.pretrained_dir)
+        @jax.jit
+        def step(params, lora, batch):
+            logits = gpt2.forward(config, params, batch["input_ids"],
+                                  attention_mask=batch["attention_mask"],
+                                  lora=lora, compute_dtype=compute_dtype)
+            return lm_cross_entropy_sum(logits, batch["labels"])
+
+        max_pos = config.n_positions
+
+    # Commit the weights to the device ONCE: checkpoint loading yields
+    # host numpy arrays, and leaving them as jit arguments re-transfers
+    # the full model every batch (20 s/batch for GPT-2s over a tunneled
+    # TPU link vs milliseconds resident).
+    params = jax.device_put(params)
+    if lora is not None:
+        lora = jax.device_put(lora)
+
+    args.seq_len = min(args.seq_len, max_pos)
     wt2 = WT2Config(seq_len=args.seq_len, batch_size=args.batch_size,
                     stride=args.stride or None, shuffle=False,
                     drop_last=False)
-    ds = WikiText2Dataset(args.data_root, args.split, wt2, tok.encode,
-                          tok.eos_id)
-    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-
-    @jax.jit
-    def step(params, lora, batch):
-        logits = gpt2.forward(config, params, batch["input_ids"],
-                              attention_mask=batch["attention_mask"],
-                              lora=lora, compute_dtype=compute_dtype)
-        return lm_cross_entropy_sum(logits, batch["labels"])
+    ds = WikiText2Dataset(args.data_root, args.split, wt2, encode,
+                          eos_id, pad_id=pad_id)
 
     jsonl = JSONLWriter(args.out) if args.out else None
     total, count = 0.0, 0
@@ -109,15 +172,15 @@ def main(argv=None) -> int:
             break
     mean = total / max(count, 1)
     ppl = perplexity_from_loss(mean)
-    record = {"type": "final", "split": args.split, "nll": mean, "ppl": ppl,
-              "tokens": count, "seq_len": args.seq_len,
-              "lora": bool(args.lora_path), "merged": args.lora_merge,
+    record = {"type": "final", "family": family, "split": args.split,
+              "nll": mean, "ppl": ppl, "tokens": count,
+              "seq_len": args.seq_len, "lora": bool(args.lora_path),
+              "merged": args.lora_merge,
               "seconds": round(time.time() - t0, 1)}
     log.info(f"{args.split} ppl={ppl:.3f} nll={mean:.4f} ({count} tokens)")
     if jsonl:
         jsonl.write(record)
-    import json as _json
-    print(_json.dumps(record))
+    print(json.dumps(record))
     return 0
 
 

@@ -251,6 +251,30 @@ def test_eval_ppl_adapter_merge_matches_dynamic(gpt2_dir, wiki_dir,
     assert outs[0]["ppl"] == pytest.approx(outs[1]["ppl"], rel=1e-4)
 
 
+def test_eval_ppl_gemma_adapter_merge_matches_dynamic(gemma_dir, wiki_dir,
+                                                      tmp_path, capsys):
+    """Gemma eval parity (the reference has NO Gemma eval binary): family
+    auto-detect, chunked-CE eval, and merge == dynamic via merge_gemma3."""
+    from mobilefinetuner_tpu.cli.eval_ppl import main as eval_ppl
+    from mobilefinetuner_tpu.cli.train_lora_gemma import main as train
+    out_dir = str(tmp_path / "g")
+    train(["--model_dir", gemma_dir, "--data_dir", wiki_dir,
+           "--steps", "3", "--batch_size", "2", "--seq_len", "32",
+           "--lr", "5e-3", "--output_dir", out_dir])
+    adapter = os.path.join(out_dir, "gemma_lora.safetensors")
+    outs = []
+    for extra in (["--lora_merge"], []):
+        eval_ppl(["--pretrained_dir", gemma_dir, "--data_root", wiki_dir,
+                  "--split", "valid", "--seq_len", "32",
+                  "--batch_size", "2", "--max_batches", "2",
+                  "--lora_path", adapter] + extra)
+        outs.append(json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1]))
+    assert outs[0]["family"] == "gemma"
+    assert np.isfinite(outs[0]["ppl"])
+    assert outs[0]["ppl"] == pytest.approx(outs[1]["ppl"], rel=1e-4)
+
+
 def test_eval_mmlu_smoke(gpt2_dir, tmp_path, capsys):
     from mobilefinetuner_tpu.cli.eval_mmlu import main
     mmlu_root = write_tiny_mmlu_dir(str(tmp_path / "mmlu"))

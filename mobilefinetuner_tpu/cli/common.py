@@ -88,6 +88,11 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
                         "measured on v5e, tools/bench_attention.py); "
                         "'flash' = Pallas block-sparse kernel; 'xla' = "
                         "plain fused attention")
+    g.add_argument("--no_model_dropout", action="store_true",
+                   help="zero the checkpoint's embd/resid/attn pdrop "
+                        "(HF GPT-2 configs carry 0.1; dropout changes "
+                        "loss curves and attn-dropout forces the XLA "
+                        "attention path)")
     g.add_argument("--profile_dir", default="",
                    help="emit a jax.profiler trace of a few steady-state "
                         "steps to this directory (the reference's "

@@ -60,6 +60,13 @@ def main(argv=None) -> int:
     config, params = load_gpt2(args.pretrained_dir)
     config = dataclasses.replace(
         config, attention_impl=args.attention_impl)
+    if args.no_model_dropout:
+        config = dataclasses.replace(config, embd_pdrop=0.0,
+                                     resid_pdrop=0.0, attn_pdrop=0.0)
+    elif config.attn_pdrop > 0 and args.attention_impl == "flash":
+        log.warning(f"attn_pdrop={config.attn_pdrop} forces the XLA "
+                    f"attention path during training; pass "
+                    f"--no_model_dropout to keep the flash kernel")
     if args.resume_from:
         if os.path.isdir(args.resume_from):
             tensors = load_hf_state_dict(args.resume_from)
@@ -100,11 +107,23 @@ def main(argv=None) -> int:
     shardings = params_shardings(params, mesh)
     params = jax.device_put(params, shardings)
     compute_dtype = common.compute_dtype_from_args(args)
+    model_pdrop = max(config.embd_pdrop, config.resid_pdrop,
+                      config.attn_pdrop)
+    base_rng = (jax.random.PRNGKey(args.seed + 1)
+                if model_pdrop > 0 else None)
 
     def loss_fn(params_t, _unused, mb):
+        rng = mb["dropout_rng"][0] if "dropout_rng" in mb else None
         logits = gpt2.forward(config, params_t, mb["input_ids"],
                               attention_mask=mb["attention_mask"],
-                              compute_dtype=compute_dtype, remat=args.remat)
+                              compute_dtype=compute_dtype, remat=args.remat,
+                              dropout_rng=rng)
+        return lm_cross_entropy_sum(logits, mb["labels"])
+
+    def nll_fn(params_t, _unused, mb):
+        logits = gpt2.forward(config, params_t, mb["input_ids"],
+                              attention_mask=mb["attention_mask"],
+                              compute_dtype=compute_dtype)
         return lm_cross_entropy_sum(logits, mb["labels"])
 
     def save_hook(step, params_t, opt_st, final):
@@ -120,10 +139,10 @@ def main(argv=None) -> int:
 
     common.run_training(
         args, trainable=params, frozen=None, loss_fn=loss_fn,
-        nll_fn=loss_fn, train_ds=train_ds, valid_ds=valid_ds,
+        nll_fn=nll_fn, train_ds=train_ds, valid_ds=valid_ds,
         total_steps=total_steps, tc=tc, mask=None, start_step=start_step,
         opt_state=opt_state, save_hook=save_hook, mesh=mesh,
-        replicate_trainable=False)
+        replicate_trainable=False, dropout_rng=base_rng)
     return 0
 
 

@@ -64,8 +64,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lora_dropout", type=float, default=0.0)
     p.add_argument("--lora_targets", default="attn_qkv,attn_proj",
                    help="comma list of attn_qkv,attn_proj,mlp_fc_in,"
-                        "mlp_fc_out (PEFT-aligned default: fused c_attn + "
-                        "c_proj, main.cpp:381-390)")
+                        "mlp_fc_out,attn_q,attn_k,attn_v (PEFT-aligned "
+                        "default: fused c_attn + c_proj, main.cpp:381-390)")
+    p.add_argument("--split_qkv", action="store_true",
+                   help="replace the fused attn_qkv target with separate "
+                        "q/k/v column-range adapters "
+                        "(lora_injector.h:169-191)")
     p.add_argument("--peft_export_dir", default="",
                    help="also export an HF-PEFT adapter directory")
     common.add_align_flags(p)
@@ -79,9 +83,21 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
+    if args.split_qkv and args.peft_export_dir:
+        raise SystemExit("--split_qkv adapters have no PEFT "
+                         "representation; drop --peft_export_dir "
+                         "(the native adapter format supports them)")
     config, params = load_gpt2(args.pretrained_dir)
     config = dataclasses.replace(
         config, attention_impl=args.attention_impl)
+    if args.no_model_dropout:
+        config = dataclasses.replace(config, embd_pdrop=0.0,
+                                     resid_pdrop=0.0, attn_pdrop=0.0)
+    elif config.attn_pdrop > 0 and args.attention_impl == "flash":
+        log.warning(f"attn_pdrop={config.attn_pdrop} forces the XLA "
+                    f"attention path during training (probs-dropout has "
+                    f"no flash-kernel support); pass --no_model_dropout "
+                    f"to keep the flash kernel")
     if args.seq_len > config.n_positions:
         log.warning(f"seq_len({args.seq_len}) > n_positions"
                     f"({config.n_positions}), clamped")
@@ -95,10 +111,13 @@ def main(argv=None) -> int:
         log.info(f"resumed adapter: r={spec.rank} alpha={spec.alpha} "
                  f"targets={spec.targets}")
     else:
+        targets = [t for t in args.lora_targets.split(",") if t]
+        if args.split_qkv:
+            targets = [t for t in targets if t != "attn_qkv"]
+            targets = ["attn_q", "attn_k", "attn_v"] + targets
         spec = LoRASpec(rank=args.rank, alpha=args.alpha,
                         dropout=args.lora_dropout,
-                        targets=[t for t in args.lora_targets.split(",")
-                                 if t], init="gpt2")
+                        targets=targets, init="gpt2")
         lora = init_lora_gpt2(config, spec, jax.random.PRNGKey(args.seed))
     mask = trainable_mask(lora)
     log.info(f"trainable params: {num_trainable(lora):,}")
@@ -128,8 +147,10 @@ def main(argv=None) -> int:
     params, fetch_fn, offload_arg = common.setup_frozen_params(
         args, params, mesh)
     compute_dtype = common.compute_dtype_from_args(args)
+    model_pdrop = max(config.embd_pdrop, config.resid_pdrop,
+                      config.attn_pdrop)
     base_rng = (jax.random.PRNGKey(args.seed + 1)
-                if args.lora_dropout > 0 else None)
+                if args.lora_dropout > 0 or model_pdrop > 0 else None)
 
     def loss_fn(lora_t, frozen, mb):
         # per-(step, micro-batch) dropout key, threaded via the batch

@@ -60,6 +60,9 @@ class GPT2Config:
             n_head=raw.get("n_head", 12),
             layer_norm_epsilon=raw.get("layer_norm_epsilon", 1e-5),
             activation_function=raw.get("activation_function", "gelu_new"),
+            embd_pdrop=raw.get("embd_pdrop", 0.0),
+            resid_pdrop=raw.get("resid_pdrop", 0.0),
+            attn_pdrop=raw.get("attn_pdrop", 0.0),
             tie_word_embeddings=raw.get("tie_word_embeddings", True),
         )
 

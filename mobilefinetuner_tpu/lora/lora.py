@@ -39,10 +39,18 @@ import numpy as np
 # GPT-2 target name -> (in_dim_fn, out_dim_fn) over config
 GPT2_TARGETS = {
     "attn_qkv": lambda c: (c.n_embd, 3 * c.n_embd),
+    # split-QKV: separate adapters on the q/k/v column ranges of the fused
+    # c_attn projection (reference: lora_injector.h:169-191 Hook
+    # col_offset/col_size) — finer-grained than the fused default
+    "attn_q": lambda c: (c.n_embd, c.n_embd),
+    "attn_k": lambda c: (c.n_embd, c.n_embd),
+    "attn_v": lambda c: (c.n_embd, c.n_embd),
     "attn_proj": lambda c: (c.n_embd, c.n_embd),
     "mlp_fc_in": lambda c: (c.n_embd, 4 * c.n_embd),
     "mlp_fc_out": lambda c: (4 * c.n_embd, c.n_embd),
 }
+# column slot of each split target within the fused [E, 3E] c_attn weight
+GPT2_SPLIT_QKV_SLOTS = {"attn_q": 0, "attn_k": 1, "attn_v": 2}
 # Default PEFT-aligned GPT-2 topology: fused c_attn + c_proj
 # (reference: gpt2_lora_finetune/main.cpp:381-390).
 GPT2_DEFAULT_TARGETS = ["attn_qkv", "attn_proj"]
@@ -158,9 +166,13 @@ def _delta_w(entry) -> jnp.ndarray:
                                        entry["B"])
 
 
-# name of the base-weight leaf each target modifies, per model family
+# name of the base-weight leaf each target modifies, per model family;
+# an optional third element is the column slot within the fused weight
+# (split-QKV, lora_injector.h:169-191)
 _GPT2_BASE = {"attn_qkv": ("attn", "qkv_w"), "attn_proj": ("attn", "proj_w"),
-              "mlp_fc_in": ("mlp", "fc_w"), "mlp_fc_out": ("mlp", "proj_w")}
+              "mlp_fc_in": ("mlp", "fc_w"), "mlp_fc_out": ("mlp", "proj_w"),
+              **{name: ("attn", "qkv_w", slot)
+                 for name, slot in GPT2_SPLIT_QKV_SLOTS.items()}}
 _GEMMA_BASE = {"q_proj": ("attn", "q_w"), "k_proj": ("attn", "k_w"),
                "v_proj": ("attn", "v_w"), "o_proj": ("attn", "o_w"),
                "gate_proj": ("mlp", "gate_w"), "up_proj": ("mlp", "up_w"),
@@ -168,15 +180,24 @@ _GEMMA_BASE = {"q_proj": ("attn", "q_w"), "k_proj": ("attn", "k_w"),
 
 
 def _merge(params, lora_tree, base_map, sign: float):
-    """params + sign * ΔW on every LoRA'd base weight (functional)."""
+    """params + sign * ΔW on every LoRA'd base weight (functional).
+    Split targets add their ΔW into the matching column range of the
+    fused weight."""
     params = jax.tree.map(jnp.asarray, params)
     blocks = dict(params["blocks"])
     groups = {g: dict(blocks[g]) for g in {v[0] for v in base_map.values()}}
     for name, entry in lora_tree["blocks"].items():
-        group, leaf = base_map[name]
+        spec = base_map[name]
+        group, leaf = spec[0], spec[1]
         w = groups[group][leaf]
-        groups[group][leaf] = (
-            w + sign * _delta_w(entry).astype(w.dtype))
+        delta = sign * _delta_w(entry).astype(w.dtype)
+        if len(spec) == 3:
+            out = delta.shape[-1]
+            col0 = spec[2] * out
+            w = w.at[:, :, col0:col0 + out].add(delta)
+        else:
+            w = w + delta
+        groups[group][leaf] = w
     blocks.update(groups)
     out = dict(params)
     out["blocks"] = blocks

@@ -94,6 +94,14 @@ def export_peft(out_dir: str, lora_tree, spec: LoRASpec, family: str,
     → transpose per layer)."""
     modules = (GPT2_PEFT_MODULES if family == "gpt2"
                else GEMMA_PEFT_MODULES)
+    unsupported = sorted(set(lora_tree["blocks"]) - set(modules))
+    if unsupported:
+        raise ValueError(
+            f"targets {unsupported} have no PEFT representation (HF PEFT "
+            f"cannot express column-sliced adapters on the fused c_attn; "
+            f"reference split-QKV uses its own key scheme too, "
+            f"lora_saver.cpp make_peft_key) — use the native adapter "
+            f"format for split-QKV runs")
     os.makedirs(out_dir, exist_ok=True)
     tensors = {}
     for name, entry in lora_tree["blocks"].items():

@@ -45,6 +45,9 @@ def gelu_new(x):
     return jax.nn.gelu(x, approximate=True)
 
 
+from mobilefinetuner_tpu.ops.dropout import inverted_dropout as _dropout
+
+
 from mobilefinetuner_tpu.models.lora_apply import maybe_lora
 
 
@@ -101,14 +104,28 @@ def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx,
     h = layer_norm(x, bp["ln_1"]["g"], bp["ln_1"]["b"], eps)
     qkv = h @ bp["attn"]["qkv_w"] + bp["attn"]["qkv_b"]
     qkv = lora(qkv, h, "attn_qkv", 0)
+    # split-QKV adapters hit only their column range of the fused c_attn
+    # output (reference: lora_injector.h:169-191 col_offset/col_size)
+    if lora_b is not None:
+        from mobilefinetuner_tpu.lora.lora import GPT2_SPLIT_QKV_SLOTS
+        for name, slot in GPT2_SPLIT_QKV_SLOTS.items():
+            if name in lora_b:
+                sl = (Ellipsis, slice(slot * E, (slot + 1) * E))
+                qkv = qkv.at[sl].set(lora(qkv[sl], h, name, 4 + slot))
     q, k, v = jnp.split(qkv, 3, axis=-1)
     to_heads = lambda t: t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+    attn_rng = (None if rng is None or config.attn_pdrop <= 0.0
+                else jax.random.fold_in(rng, 9))
     ctx = attention(to_heads(q), to_heads(k), to_heads(v),
                     impl=config.attention_impl, is_causal=True,
-                    padding_mask=padding_mask)
+                    padding_mask=padding_mask,
+                    attn_dropout=config.attn_pdrop,
+                    attn_dropout_rng=attn_rng)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, E)
     proj = ctx @ bp["attn"]["proj_w"] + bp["attn"]["proj_b"]
     proj = lora(proj, ctx, "attn_proj", 1)
+    proj = _dropout(proj, config.resid_pdrop,
+                    None if rng is None else jax.random.fold_in(rng, 7))
     x = x + proj
 
     h = layer_norm(x, bp["ln_2"]["g"], bp["ln_2"]["b"], eps)
@@ -117,6 +134,8 @@ def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx,
     act = gelu_new(fc)
     out = act @ bp["mlp"]["proj_w"] + bp["mlp"]["proj_b"]
     out = lora(out, act, "mlp_fc_out", 3)
+    out = _dropout(out, config.resid_pdrop,
+                   None if rng is None else jax.random.fold_in(rng, 8))
     return x + out
 
 
@@ -157,6 +176,9 @@ def hidden_states(config: GPT2Config, params, input_ids,
         pos_emb = params["wpe"][:S][None, :, :]
     x = params["wte"][input_ids] + pos_emb
     x = x.astype(compute_dtype)
+    x = _dropout(x, config.embd_pdrop,
+                 None if dropout_rng is None
+                 else jax.random.fold_in(dropout_rng, 1000))
     padding_mask = attention_mask
     from mobilefinetuner_tpu.parallel.offload import layer_slicer
     slice_layer = layer_slicer(params["blocks"], stream, compute_dtype)

@@ -29,11 +29,8 @@ def maybe_lora(y, x, lora_entry, layer_idx=None, dropout: float = 0.0,
     A, B = lora_entry["A"], lora_entry["B"]
     if layer_idx is not None and A.ndim == 3:
         A, B = A[layer_idx], B[layer_idx]
-    xb = x
-    if dropout > 0.0 and rng is not None:
-        keep = 1.0 - dropout
-        mask = jax.random.bernoulli(rng, keep, x.shape)
-        xb = jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    from mobilefinetuner_tpu.ops.dropout import inverted_dropout
+    xb = inverted_dropout(x, dropout, rng)
     delta = (xb @ A.astype(x.dtype)) @ B.astype(x.dtype)
     scale = jax.lax.stop_gradient(
         jnp.asarray(lora_entry["scale"]).astype(y.dtype))

@@ -49,7 +49,9 @@ def dot_product_attention(
         sliding_window: Optional[int] = None,
         padding_mask: Optional[jnp.ndarray] = None,
         attn_mask: Optional[jnp.ndarray] = None,
-        logits_dtype=jnp.float32) -> jnp.ndarray:
+        logits_dtype=jnp.float32,
+        attn_dropout: float = 0.0,
+        attn_dropout_rng: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Scaled dot-product attention with GQA.
 
     q: [B, Hq, S, D]; k, v: [B, Hkv, S, D] with Hq % Hkv == 0 — GQA is
@@ -87,6 +89,10 @@ def dot_product_attention(
         scores = jnp.where(pm[:, None, None, None, :], scores, neg)
 
     probs = jax.nn.softmax(scores, axis=-1)
+    # dropout on attention weights, HF train-mode semantics
+    # (reference: core/ops.cpp:2670 applied to probs)
+    from mobilefinetuner_tpu.ops.dropout import inverted_dropout
+    probs = inverted_dropout(probs, attn_dropout, attn_dropout_rng)
     out = jnp.einsum("bhgqk,bhkd->bhgqd", probs.astype(v.dtype), v)
     return out.reshape(B, Hq, S, D)
 
@@ -109,6 +115,14 @@ def attention(q, k, v, *, impl: str = "auto", **kwargs):
     impl='auto' picks per shape (resolve_impl); 'flash' / 'xla' force the
     respective path.
     """
+    if kwargs.get("attn_dropout", 0.0) > 0.0 \
+            and kwargs.get("attn_dropout_rng") is not None:
+        # probs-dropout has no flash-kernel support; train-mode attention
+        # dropout always takes the XLA path
+        impl = "xla"
+    else:
+        kwargs.pop("attn_dropout", None)
+        kwargs.pop("attn_dropout_rng", None)
     if impl == "auto":
         impl = resolve_impl(q.shape[2], q.shape[3])
     if impl == "flash":

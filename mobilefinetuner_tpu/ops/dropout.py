@@ -1,0 +1,19 @@
+"""Inverted dropout, shared by every site that needs train-mode masking
+(model embd/resid dropout, attention-probs dropout, the LoRA branch).
+Reference: core/ops.cpp:2670 dropout; PEFT branch semantics in
+nn/lora_linear.cpp:47-106."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def inverted_dropout(x, rate: float, rng):
+    """x scaled by 1/keep on surviving elements; identity when rate == 0
+    or rng is None (eval mode)."""
+    if rate <= 0.0 or rng is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)

@@ -8,8 +8,11 @@ eos=bos=pad=unk=50256 (tokenizer_bpe.h:29-33)), itself aligned with the
 public GPT-2 tokenizer algorithm. Implemented from the public algorithm, not
 ported. Uses the `regex` module for \\p{L}/\\p{N} unicode categories.
 
-This Python implementation is the reference; a native C++ fast path is
-planned but not yet built (do not advertise components that don't exist).
+The Python implementation is the behavioral reference; a native C++ merge
+engine (native/fast_bpe.cpp, built on first use and bound via ctypes) is
+the fast path for the BPE hot loop, with automatic fallback when the
+compiler or library is unavailable. Parity between the two is asserted in
+tests/test_native_bpe.py (and the Python side against HF's tokenizers).
 """
 
 from __future__ import annotations
@@ -53,7 +56,8 @@ class GPT2BPETokenizer:
     GPT2TokenizerFast on the same vocab/merges files."""
 
     def __init__(self, vocab: Dict[str, int], merges: List[Tuple[str, str]],
-                 eos_token: str = "<|endoftext|>"):
+                 eos_token: str = "<|endoftext|>",
+                 use_native: bool = True):
         self.encoder = dict(vocab)
         self.decoder = {v: k for k, v in vocab.items()}
         self.bpe_ranks = {pair: i for i, pair in enumerate(merges)}
@@ -65,11 +69,20 @@ class GPT2BPETokenizer:
         # (tokenizer_bpe.h:29-33)
         self.bos_id = self.pad_id = self.unk_id = self.eos_id
         self._cache: Dict[str, List[str]] = {}
+        self._id_cache: Dict[str, List[int]] = {}
+        self._native = None
+        if use_native:
+            try:
+                from mobilefinetuner_tpu.native.fast_bpe import NativeBPE
+                self._native = NativeBPE(merges, vocab)
+            except Exception:
+                self._native = None  # pure-Python fallback
 
     # -- construction --------------------------------------------------------
 
     @classmethod
-    def from_pretrained(cls, model_dir: str) -> "GPT2BPETokenizer":
+    def from_pretrained(cls, model_dir: str,
+                        use_native: bool = True) -> "GPT2BPETokenizer":
         with open(os.path.join(model_dir, "vocab.json"),
                   encoding="utf-8") as f:
             vocab = json.load(f)
@@ -89,7 +102,7 @@ class GPT2BPETokenizer:
                 sm = json.load(f)
             e = sm.get("eos_token", eos)
             eos = e["content"] if isinstance(e, dict) else e
-        return cls(vocab, merges, eos)
+        return cls(vocab, merges, eos, use_native=use_native)
 
     @property
     def vocab_size(self) -> int:
@@ -137,6 +150,19 @@ class GPT2BPETokenizer:
 
     # -- public API ----------------------------------------------------------
 
+    def _word_ids(self, mapped: str) -> List[int]:
+        """ids for one byte->unicode-mapped word: native merge engine when
+        built (cached here), Python reference otherwise (cached inside
+        _bpe — one cache per mode, never both)."""
+        if self._native is None:
+            return [self.encoder.get(sub, self.unk_id)
+                    for sub in self._bpe(mapped)]
+        cached = self._id_cache.get(mapped)
+        if cached is None:
+            cached = self._native.encode_word(mapped, self.unk_id)
+            self._id_cache[mapped] = cached
+        return cached
+
     def encode(self, text: str) -> List[int]:
         # Special tokens are matched verbatim before BPE (HF AddedToken
         # semantics): "<|endoftext|>" in the text becomes the single eos id,
@@ -146,8 +172,7 @@ class GPT2BPETokenizer:
             for piece in _PAT.findall(part):
                 mapped = "".join(self.byte_encoder[b]
                                  for b in piece.encode("utf-8"))
-                for sub in self._bpe(mapped):
-                    ids.append(self.encoder.get(sub, self.unk_id))
+                ids.extend(self._word_ids(mapped))
             ids.append(self.eos_id)
         ids.pop()  # one eos per separator, not per part
         return ids

@@ -1,0 +1,128 @@
+// Native BPE merge engine — the hot loop of GPT-2 byte-level BPE.
+//
+// C++ counterpart of the reference's tokenizer core
+// (reference: operators/finetune_ops/core/tokenizer_bpe.cpp — greedy
+// lowest-rank pair merging over the byte->unicode-mapped word), built as a
+// shared library and driven from Python via ctypes
+// (mobilefinetuner_tpu/native/fast_bpe.py). The Python tokenizer keeps the
+// unicode-category pre-tokenization regex and the per-word cache; this
+// engine replaces only the merge loop + vocab lookup, and must match the
+// Python reference implementation token-for-token (tests/test_native_bpe.py
+// asserts parity; the Python side is itself HF-oracle-tested).
+//
+// Merge semantics mirror the canonical algorithm exactly, including the
+// left-to-right `word.index(a, i)` rebuild pass.
+//
+// Build: g++ -O2 -shared -fPIC fast_bpe.cpp -o libfast_bpe.so
+// (done automatically on first use by fast_bpe.py).
+
+#include <climits>
+#include <cstdint>
+#include <string>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+namespace {
+
+struct PairHash {
+    size_t operator()(const std::pair<std::string, std::string>& p) const {
+        std::hash<std::string> h;
+        return h(p.first) * 1000003u ^ h(p.second);
+    }
+};
+
+struct Engine {
+    std::unordered_map<std::pair<std::string, std::string>, int, PairHash>
+        ranks;
+    std::unordered_map<std::string, int32_t> vocab;
+    int next_rank = 0;
+};
+
+std::vector<std::string> split_utf8(const char* s) {
+    std::vector<std::string> out;
+    const unsigned char* p = reinterpret_cast<const unsigned char*>(s);
+    while (*p) {
+        int len = 1;
+        if ((*p & 0x80u) == 0x00u) len = 1;
+        else if ((*p & 0xE0u) == 0xC0u) len = 2;
+        else if ((*p & 0xF0u) == 0xE0u) len = 3;
+        else if ((*p & 0xF8u) == 0xF0u) len = 4;
+        out.emplace_back(reinterpret_cast<const char*>(p), len);
+        p += len;
+    }
+    return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* bpe_create() { return new Engine(); }
+
+void bpe_destroy(void* h) { delete static_cast<Engine*>(h); }
+
+// rank = insertion order (call in merges.txt order). Assignment (not
+// emplace) + an always-incrementing counter mirror Python's
+// {pair: i for i, pair in enumerate(merges)}: a duplicate pair keeps its
+// LAST index and still consumes a rank slot.
+void bpe_add_merge(void* h, const char* a, const char* b) {
+    Engine* e = static_cast<Engine*>(h);
+    e->ranks[std::make_pair(std::string(a), std::string(b))] =
+        e->next_rank++;
+}
+
+void bpe_add_token(void* h, const char* token, int32_t id) {
+    static_cast<Engine*>(h)->vocab[token] = id;
+}
+
+// Encode one byte->unicode-mapped word (utf-8). Writes ids into out;
+// returns the count, or -1 if cap is too small (caller retries bigger).
+int32_t bpe_encode_word(void* h, const char* word, int32_t* out,
+                        int32_t cap, int32_t unk_id) {
+    Engine* e = static_cast<Engine*>(h);
+    std::vector<std::string> parts = split_utf8(word);
+    if (parts.empty()) return 0;
+
+    while (parts.size() > 1) {
+        int best_rank = INT_MAX;
+        std::pair<std::string, std::string> best;
+        for (size_t i = 0; i + 1 < parts.size(); ++i) {
+            auto it = e->ranks.find({parts[i], parts[i + 1]});
+            if (it != e->ranks.end() && it->second < best_rank) {
+                best_rank = it->second;
+                best = it->first;
+            }
+        }
+        if (best_rank == INT_MAX) break;
+
+        // rebuild pass, python `word.index(a, i)` semantics
+        std::vector<std::string> nw;
+        nw.reserve(parts.size());
+        size_t i = 0;
+        while (i < parts.size()) {
+            size_t j = i;
+            while (j < parts.size() && parts[j] != best.first) ++j;
+            for (size_t k = i; k < j; ++k) nw.push_back(parts[k]);
+            if (j >= parts.size()) break;
+            if (j + 1 < parts.size() && parts[j + 1] == best.second) {
+                nw.push_back(best.first + best.second);
+                i = j + 2;
+            } else {
+                nw.push_back(parts[j]);
+                i = j + 1;
+            }
+        }
+        parts.swap(nw);
+    }
+
+    int32_t n = 0;
+    for (const auto& p : parts) {
+        if (n >= cap) return -1;
+        auto it = e->vocab.find(p);
+        out[n++] = (it == e->vocab.end()) ? unk_id : it->second;
+    }
+    return n;
+}
+
+}  // extern "C"

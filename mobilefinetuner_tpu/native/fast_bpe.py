@@ -1,0 +1,107 @@
+"""ctypes binding + lazy build for the native BPE merge engine.
+
+The shared library (libfast_bpe.so) is compiled from fast_bpe.cpp on first
+use with the system g++ (no pybind11 dependency; plain C ABI + ctypes) and
+cached next to the source; a stale .so (older than the .cpp) is rebuilt.
+Any failure — no compiler, unwritable dir, load error — degrades silently
+to None and the tokenizer keeps its pure-Python path
+(data/tokenizer_bpe.py), which is the behavioral reference.
+
+Set MFT_NO_NATIVE_BPE=1 to force the Python path (used by parity tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fast_bpe.cpp")
+_LIB = os.path.join(_HERE, "libfast_bpe.so")
+_lock = threading.Lock()
+_lib_cache: list = []  # [lib_or_None] once resolved
+
+
+def _build() -> bool:
+    # unique temp output: concurrent builders (pytest-xdist, two CLIs)
+    # must not interleave writes into one file and install a corrupt .so
+    tmp = f"{_LIB}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    if os.environ.get("MFT_NO_NATIVE_BPE") == "1":
+        return None
+    with _lock:
+        if _lib_cache:
+            return _lib_cache[0]
+        lib = None
+        try:
+            stale = (not os.path.exists(_LIB)
+                     or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+            if not stale or _build():
+                lib = ctypes.CDLL(_LIB)
+                lib.bpe_create.restype = ctypes.c_void_p
+                lib.bpe_destroy.argtypes = [ctypes.c_void_p]
+                lib.bpe_add_merge.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_char_p,
+                                              ctypes.c_char_p]
+                lib.bpe_add_token.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_char_p,
+                                              ctypes.c_int32]
+                lib.bpe_encode_word.restype = ctypes.c_int32
+                lib.bpe_encode_word.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p,
+                    ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+                    ctypes.c_int32]
+        except Exception:
+            lib = None
+        _lib_cache.append(lib)
+        return lib
+
+
+class NativeBPE:
+    """One engine instance per tokenizer: merges + vocab loaded once."""
+
+    def __init__(self, merges: List[Tuple[str, str]], vocab):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native BPE library unavailable")
+        self._lib = lib
+        self._h = lib.bpe_create()
+        for a, b in merges:
+            lib.bpe_add_merge(self._h, a.encode("utf-8"),
+                              b.encode("utf-8"))
+        for token, idx in vocab.items():
+            lib.bpe_add_token(self._h, token.encode("utf-8"), int(idx))
+
+    def encode_word(self, mapped_word: str, unk_id: int) -> List[int]:
+        """ids for one byte->unicode-mapped word (matches the Python
+        _bpe + vocab-lookup result exactly)."""
+        raw = mapped_word.encode("utf-8")
+        cap = max(len(mapped_word), 1)
+        while True:
+            buf = (ctypes.c_int32 * cap)()
+            n = self._lib.bpe_encode_word(self._h, raw, buf, cap, unk_id)
+            if n >= 0:
+                return list(buf[:n])
+            cap *= 2
+
+    def __del__(self):
+        try:
+            self._lib.bpe_destroy(self._h)
+        except Exception:
+            pass

@@ -18,6 +18,7 @@
 
 #include <climits>
 #include <cstdint>
+#include <cstring>
 #include <string>
 #include <unordered_map>
 #include <utility>
@@ -41,15 +42,20 @@ struct Engine {
 
 std::vector<std::string> split_utf8(const char* s) {
     std::vector<std::string> out;
-    const unsigned char* p = reinterpret_cast<const unsigned char*>(s);
-    while (*p) {
-        int len = 1;
-        if ((*p & 0x80u) == 0x00u) len = 1;
-        else if ((*p & 0xE0u) == 0xC0u) len = 2;
-        else if ((*p & 0xF0u) == 0xE0u) len = 3;
-        else if ((*p & 0xF8u) == 0xF0u) len = 4;
-        out.emplace_back(reinterpret_cast<const char*>(p), len);
-        p += len;
+    size_t n = std::strlen(s);
+    size_t i = 0;
+    while (i < n) {
+        unsigned char c = static_cast<unsigned char>(s[i]);
+        size_t len = 1;
+        if ((c & 0x80u) == 0x00u) len = 1;
+        else if ((c & 0xE0u) == 0xC0u) len = 2;
+        else if ((c & 0xF0u) == 0xE0u) len = 3;
+        else if ((c & 0xF8u) == 0xF0u) len = 4;
+        // clamp: a truncated/invalid lead byte must not read past the
+        // terminator (this symbol is extern-C callable by anyone)
+        if (len > n - i) len = n - i;
+        out.emplace_back(s + i, len);
+        i += len;
     }
     return out;
 }
@@ -74,6 +80,35 @@ void bpe_add_merge(void* h, const char* a, const char* b) {
 
 void bpe_add_token(void* h, const char* token, int32_t id) {
     static_cast<Engine*>(h)->vocab[token] = id;
+}
+
+// Batch load: one FFI call instead of one per entry (~100k round-trips
+// for the real GPT-2 tables). merges_blob = "a b\na b\n..." in rank
+// order; vocab_blob = "tok\ntok\n..." parallel to ids. Token strings are
+// byte->unicode mapped, so they never contain ' ', '\n', or NUL.
+void bpe_load(void* h, const char* merges_blob, const char* vocab_blob,
+              const int32_t* ids, int32_t n_vocab) {
+    Engine* e = static_cast<Engine*>(h);
+    const char* p = merges_blob;
+    while (*p) {
+        const char* sp = p;
+        while (*sp && *sp != ' ') ++sp;
+        const char* nl = sp;
+        while (*nl && *nl != '\n') ++nl;
+        if (*sp == ' ') {
+            e->ranks[std::make_pair(std::string(p, sp - p),
+                                    std::string(sp + 1, nl - sp - 1))] =
+                e->next_rank++;
+        }
+        p = (*nl == '\n') ? nl + 1 : nl;
+    }
+    p = vocab_blob;
+    for (int32_t i = 0; i < n_vocab && *p; ++i) {
+        const char* nl = p;
+        while (*nl && *nl != '\n') ++nl;
+        e->vocab[std::string(p, nl - p)] = ids[i];
+        p = (*nl == '\n') ? nl + 1 : nl;
+    }
 }
 
 // Encode one byte->unicode-mapped word (utf-8). Writes ids into out;

@@ -62,6 +62,11 @@ def load_library() -> Optional[ctypes.CDLL]:
                 lib.bpe_add_token.argtypes = [ctypes.c_void_p,
                                               ctypes.c_char_p,
                                               ctypes.c_int32]
+                lib.bpe_load.argtypes = [ctypes.c_void_p,
+                                         ctypes.c_char_p,
+                                         ctypes.c_char_p,
+                                         ctypes.POINTER(ctypes.c_int32),
+                                         ctypes.c_int32]
                 lib.bpe_encode_word.restype = ctypes.c_int32
                 lib.bpe_encode_word.argtypes = [
                     ctypes.c_void_p, ctypes.c_char_p,
@@ -82,11 +87,15 @@ class NativeBPE:
             raise RuntimeError("native BPE library unavailable")
         self._lib = lib
         self._h = lib.bpe_create()
-        for a, b in merges:
-            lib.bpe_add_merge(self._h, a.encode("utf-8"),
-                              b.encode("utf-8"))
-        for token, idx in vocab.items():
-            lib.bpe_add_token(self._h, token.encode("utf-8"), int(idx))
+        # one FFI call per table (not per entry — real GPT-2 has ~50k of
+        # each); mapped tokens never contain ' ', '\n', or NUL
+        merges_blob = "".join(f"{a} {b}\n" for a, b in merges)
+        tokens = list(vocab)
+        vocab_blob = "".join(t + "\n" for t in tokens)
+        ids = (ctypes.c_int32 * len(tokens))(
+            *(int(vocab[t]) for t in tokens))
+        lib.bpe_load(self._h, merges_blob.encode("utf-8"),
+                     vocab_blob.encode("utf-8"), ids, len(tokens))
 
     def encode_word(self, mapped_word: str, unk_id: int) -> List[int]:
         """ids for one byte->unicode-mapped word (matches the Python

@@ -33,6 +33,8 @@ def tok_pair(tmp_path_factory):
 
 
 def test_native_library_builds():
+    if os.environ.get("MFT_NO_NATIVE_BPE") == "1":
+        pytest.skip("native BPE disabled by env")
     from mobilefinetuner_tpu.native.fast_bpe import load_library
     assert load_library() is not None
     assert os.path.exists(os.path.join(

@@ -163,6 +163,11 @@ def add_mesh_flags(p: argparse.ArgumentParser):
                    help="fsdp mesh axis size; 0 = all remaining devices "
                         "(default 1 = single chip, like the reference; "
                         "multi-chip is opt-in)")
+    g.add_argument("--sequence_parallel", action="store_true",
+                   help="long-context mode: shard the SEQUENCE axis over "
+                        "the fsdp mesh axis and run ring attention "
+                        "(parallel/ring_attention.py); seq_len must "
+                        "divide by mesh_fsdp")
 
 
 def governor_from_args(args) -> StepGovernor:
@@ -193,17 +198,33 @@ def offload_config_from_args(args) -> OffloadConfig:
 
 
 def build_mesh(args):
+    """Returns (mesh, cp_mesh): cp_mesh is the mesh again when
+    --sequence_parallel is set (pass it to the model forwards so ring
+    attention engages), else None — deriving it HERE keeps every CLI's
+    wiring consistent."""
     n = len(jax.devices())
     fsdp = args.mesh_fsdp or (n // max(args.mesh_data, 1))
     mesh = make_mesh(data=args.mesh_data, fsdp=fsdp,
                      devices=jax.devices()[:args.mesh_data * fsdp])
+    sp = getattr(args, "sequence_parallel", False)
     if args.mesh_data * fsdp > 1:
-        log.info(f"mesh: data={args.mesh_data} fsdp={fsdp}")
-        if args.batch_size % (args.mesh_data * fsdp) != 0:
+        log.info(f"mesh: data={args.mesh_data} fsdp={fsdp}"
+                 + (" (sequence-parallel)" if sp else ""))
+        if sp:
+            if args.batch_size % max(args.mesh_data, 1) != 0:
+                raise SystemExit(
+                    f"batch_size={args.batch_size} must divide by "
+                    f"mesh_data={args.mesh_data} in sequence-parallel "
+                    f"mode")
+            if args.seq_len % fsdp != 0:
+                raise SystemExit(
+                    f"seq_len={args.seq_len} must divide by "
+                    f"mesh_fsdp={fsdp} in sequence-parallel mode")
+        elif args.batch_size % (args.mesh_data * fsdp) != 0:
             raise SystemExit(
                 f"batch_size={args.batch_size} (the micro-batch) must be "
                 f"divisible by the mesh size {args.mesh_data * fsdp}")
-    return mesh
+    return mesh, (mesh if sp else None)
 
 
 # --------------------------- loop helpers -----------------------------------
@@ -435,7 +456,8 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
             batch["dropout_rng"] = jax.random.split(
                 jax.random.fold_in(dropout_rng, step), n)
         if mesh is not None:
-            batch = shard_batch(batch, mesh)
+            batch = shard_batch(batch, mesh,
+                                getattr(args, "sequence_parallel", False))
         if compiled_step is None:
             # AOT compile once: the SAME executable serves every step
             # (shapes are static), and its memory analysis gives peak HBM

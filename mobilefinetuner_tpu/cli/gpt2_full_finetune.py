@@ -103,7 +103,12 @@ def main(argv=None) -> int:
 
     # Full FT: params themselves are the trainable tree — FSDP-shard them
     # (and thus Adam m/v) over the mesh; no host offload of trainables.
-    mesh = common.build_mesh(args)
+    mesh, cp_mesh = common.build_mesh(args)
+    if cp_mesh is not None and config.attn_pdrop > 0:
+        log.warning(f"attn_pdrop={config.attn_pdrop} is unsupported by "
+                    f"ring attention; attention-probs dropout is OFF in "
+                    f"sequence-parallel mode (--no_model_dropout "
+                    f"silences this)")
     shardings = params_shardings(params, mesh)
     params = jax.device_put(params, shardings)
     compute_dtype = common.compute_dtype_from_args(args)
@@ -117,13 +122,14 @@ def main(argv=None) -> int:
         logits = gpt2.forward(config, params_t, mb["input_ids"],
                               attention_mask=mb["attention_mask"],
                               compute_dtype=compute_dtype, remat=args.remat,
-                              dropout_rng=rng)
+                              dropout_rng=rng, cp_mesh=cp_mesh)
         return lm_cross_entropy_sum(logits, mb["labels"])
 
     def nll_fn(params_t, _unused, mb):
         logits = gpt2.forward(config, params_t, mb["input_ids"],
                               attention_mask=mb["attention_mask"],
-                              compute_dtype=compute_dtype)
+                              compute_dtype=compute_dtype,
+                              cp_mesh=cp_mesh)
         return lm_cross_entropy_sum(logits, mb["labels"])
 
     def save_hook(step, params_t, opt_st, final):

@@ -143,7 +143,12 @@ def main(argv=None) -> int:
     opt_state, start_step = common.maybe_resume_opt_state(
         args, lora, tc, mask)
 
-    mesh = common.build_mesh(args)
+    mesh, cp_mesh = common.build_mesh(args)
+    if cp_mesh is not None and config.attn_pdrop > 0:
+        log.warning(f"attn_pdrop={config.attn_pdrop} is unsupported by "
+                    f"ring attention; attention-probs dropout is OFF in "
+                    f"sequence-parallel mode (embd/resid dropout still "
+                    f"applies; --no_model_dropout silences this)")
     params, fetch_fn, offload_arg = common.setup_frozen_params(
         args, params, mesh)
     compute_dtype = common.compute_dtype_from_args(args)
@@ -161,7 +166,7 @@ def main(argv=None) -> int:
                               lora=lora_t, compute_dtype=compute_dtype,
                               remat=args.remat, offload=offload_arg,
                               lora_dropout=args.lora_dropout,
-                              dropout_rng=rng)
+                              dropout_rng=rng, cp_mesh=cp_mesh)
         return lm_cross_entropy_sum(logits, mb["labels"])
 
     def nll_fn(lora_t, frozen, mb):
@@ -169,7 +174,7 @@ def main(argv=None) -> int:
         logits = gpt2.forward(config, p, mb["input_ids"],
                               attention_mask=mb["attention_mask"],
                               lora=lora_t, compute_dtype=compute_dtype,
-                              offload=offload_arg)
+                              offload=offload_arg, cp_mesh=cp_mesh)
         return lm_cross_entropy_sum(logits, mb["labels"])
 
     if args.align_dump_dir:

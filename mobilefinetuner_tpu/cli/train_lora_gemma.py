@@ -136,7 +136,7 @@ def main(argv=None) -> int:
     opt_state, start_step = common.maybe_resume_opt_state(
         args, lora, tc, mask)
 
-    mesh = common.build_mesh(args)
+    mesh, cp_mesh = common.build_mesh(args)
     params, fetch_fn, offload_arg = common.setup_frozen_params(
         args, params, mesh)
     compute_dtype = common.compute_dtype_from_args(args)
@@ -161,7 +161,7 @@ def main(argv=None) -> int:
             attention_mask=mb["attention_mask"], lora=lora_t,
             compute_dtype=compute_dtype, remat=args.remat,
             lora_dropout=args.lora_dropout, dropout_rng=rng,
-            block_stream=stream)
+            block_stream=stream, cp_mesh=cp_mesh)
         # lm_head tied to embeddings; chunked CE avoids [B,S,262k] logits
         return chunked_lm_cross_entropy_sum(
             hidden, p["embed"], mb["labels"], num_chunks=args.loss_chunks)
@@ -171,7 +171,8 @@ def main(argv=None) -> int:
         hidden = gemma3.hidden_states(
             config, p, mb["input_ids"],
             attention_mask=mb["attention_mask"], lora=lora_t,
-            compute_dtype=compute_dtype, block_stream=stream)
+            compute_dtype=compute_dtype, block_stream=stream,
+            cp_mesh=cp_mesh)
         return chunked_lm_cross_entropy_sum(
             hidden, p["embed"], mb["labels"], num_chunks=args.loss_chunks)
 

@@ -92,7 +92,8 @@ from mobilefinetuner_tpu.models.lora_apply import maybe_lora
 
 
 def _block(c: Gemma3TextConfig, bp, x, padding_mask, masks, ropes,
-           is_global, lora_b, i, lora_dropout=0.0, dropout_rng=None):
+           is_global, lora_b, i, lora_dropout=0.0, dropout_rng=None,
+           cp_mesh=None, cp_axis="fsdp"):
     """One Gemma-3 block; bp leaves are THIS layer's weights (sliced out of
     the [L, ...] stacks by the scan body); i (traced scalar) indexes the
     still-stacked LoRA leaves, RoPE tables, and masks."""
@@ -130,7 +131,23 @@ def _block(c: Gemma3TextConfig, bp, x, padding_mask, masks, ropes,
         # needs the flag-based branch below instead of mask matrices
         from mobilefinetuner_tpu.ops.attention import resolve_impl
         impl = resolve_impl(S, D)
-    if impl == "flash":
+    if cp_mesh is not None:
+        # sequence-parallel: ring attention over the mesh axis; the
+        # global/local choice is a traced bool under the layer scan, so
+        # branch with lax.cond like the flash path
+        from mobilefinetuner_tpu.parallel.ring_attention import \
+            ring_attention
+        ctx = jax.lax.cond(
+            is_global[i],
+            lambda ops: ring_attention(*ops, cp_mesh, axis=cp_axis,
+                                       scale=scale, is_causal=True,
+                                       padding_mask=padding_mask),
+            lambda ops: ring_attention(*ops, cp_mesh, axis=cp_axis,
+                                       scale=scale, is_causal=True,
+                                       sliding_window=c.sliding_window,
+                                       padding_mask=padding_mask),
+            (q, k, v))
+    elif impl == "flash":
         # The Pallas kernel takes causal/sliding-window as STATIC config,
         # not a mask matrix; under the layer scan the global/local choice is
         # a traced bool, so branch with lax.cond (each branch compiles its
@@ -170,7 +187,8 @@ def hidden_states(config: Gemma3TextConfig, params, input_ids,
                   compute_dtype=jnp.float32, remat: bool = False,
                   lora_dropout: float = 0.0, dropout_rng=None,
                   offload=None, block_stream=None,
-                  collect_layers: bool = False):
+                  collect_layers: bool = False,
+                  cp_mesh=None, cp_axis: str = "fsdp"):
     """offload: optional (plan, shardings) pair matching `params`; offloaded
     block weights stream host->HBM per layer inside the scan (forces remat
     of the block body) — see parallel/offload.py. block_stream: pre-resolved
@@ -215,7 +233,8 @@ def hidden_states(config: Gemma3TextConfig, params, input_ids,
 
     def body(x, i):
         x2 = _block(c, slice_layer(i), x, attention_mask, masks, ropes,
-                    is_global, lora_b, i, lora_dropout, dropout_rng)
+                    is_global, lora_b, i, lora_dropout, dropout_rng,
+                    cp_mesh, cp_axis)
         return x2, (x2 if collect_layers else None)
     if remat or stream is not None:
         body = jax.checkpoint(body)
@@ -230,11 +249,13 @@ def hidden_states(config: Gemma3TextConfig, params, input_ids,
 def forward(config: Gemma3TextConfig, params, input_ids,
             attention_mask=None, lora=None, compute_dtype=jnp.float32,
             remat: bool = False, lora_dropout: float = 0.0,
-            dropout_rng=None, offload=None) -> jnp.ndarray:
+            dropout_rng=None, offload=None, cp_mesh=None,
+            cp_axis: str = "fsdp") -> jnp.ndarray:
     """Logits [B, S, V]; lm_head tied to the embedding table."""
     from mobilefinetuner_tpu.parallel.offload import resolve_offload
     params, stream = resolve_offload(params, offload)
     x = hidden_states(config, params, input_ids, attention_mask, lora,
                       compute_dtype, remat, lora_dropout, dropout_rng,
-                      block_stream=stream)
+                      block_stream=stream, cp_mesh=cp_mesh,
+                      cp_axis=cp_axis)
     return x @ params["embed"].astype(compute_dtype).T

@@ -84,11 +84,13 @@ def init_params(config: GPT2Config, key: jax.Array,
 
 
 def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx,
-           lora_dropout=0.0, dropout_rng=None):
+           lora_dropout=0.0, dropout_rng=None, cp_mesh=None,
+           cp_axis="fsdp"):
     """One pre-LN transformer block. bp leaves are THIS layer's weights
     (already sliced out of the [L, ...] stacks by the scan body); layer_idx
     (traced scalar) indexes the still-stacked LoRA leaves and salts
-    dropout keys."""
+    dropout keys. cp_mesh: sequence-parallel mode — attention runs as
+    ring attention over the mesh axis (parallel/ring_attention.py)."""
     eps = config.layer_norm_epsilon
     H, D = config.n_head, config.head_dim
     B, S, E = x.shape
@@ -116,11 +118,18 @@ def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx,
     to_heads = lambda t: t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
     attn_rng = (None if rng is None or config.attn_pdrop <= 0.0
                 else jax.random.fold_in(rng, 9))
-    ctx = attention(to_heads(q), to_heads(k), to_heads(v),
-                    impl=config.attention_impl, is_causal=True,
-                    padding_mask=padding_mask,
-                    attn_dropout=config.attn_pdrop,
-                    attn_dropout_rng=attn_rng)
+    if cp_mesh is not None:
+        from mobilefinetuner_tpu.parallel.ring_attention import \
+            ring_attention
+        ctx = ring_attention(to_heads(q), to_heads(k), to_heads(v),
+                             cp_mesh, axis=cp_axis, is_causal=True,
+                             padding_mask=padding_mask)
+    else:
+        ctx = attention(to_heads(q), to_heads(k), to_heads(v),
+                        impl=config.attention_impl, is_causal=True,
+                        padding_mask=padding_mask,
+                        attn_dropout=config.attn_pdrop,
+                        attn_dropout_rng=attn_rng)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, E)
     proj = ctx @ bp["attn"]["proj_w"] + bp["attn"]["proj_b"]
     proj = lora(proj, ctx, "attn_proj", 1)
@@ -144,7 +153,8 @@ def hidden_states(config: GPT2Config, params, input_ids,
                   compute_dtype=jnp.float32, remat: bool = False,
                   lora_dropout: float = 0.0, dropout_rng=None,
                   offload=None, block_stream=None,
-                  collect_layers: bool = False):
+                  collect_layers: bool = False,
+                  cp_mesh=None, cp_axis: str = "fsdp"):
     """Final-LN hidden states [B, S, E] (pre lm_head).
 
     offload: optional (plan, shardings) pytree pair matching `params`
@@ -188,7 +198,7 @@ def hidden_states(config: GPT2Config, params, input_ids,
 
     def body(x, i):
         x2 = _block(config, slice_layer(i), x, padding_mask, lora_b, i,
-                    lora_dropout, dropout_rng)
+                    lora_dropout, dropout_rng, cp_mesh, cp_axis)
         return x2, (x2 if collect_layers else None)
     if remat or stream is not None:
         body = jax.checkpoint(body)
@@ -204,7 +214,8 @@ def hidden_states(config: GPT2Config, params, input_ids,
 def forward(config: GPT2Config, params, input_ids, attention_mask=None,
             lora=None, compute_dtype=jnp.float32, remat: bool = False,
             lora_dropout: float = 0.0, dropout_rng=None,
-            offload=None) -> jnp.ndarray:
+            offload=None, cp_mesh=None,
+            cp_axis: str = "fsdp") -> jnp.ndarray:
     """Logits [B, S, V]. Tied lm_head: x @ wte^T (gpt2_model.cpp:421-440).
 
     The reference caches wte^T when embeddings are frozen (SURVEY.md
@@ -214,7 +225,8 @@ def forward(config: GPT2Config, params, input_ids, attention_mask=None,
     params, stream = resolve_offload(params, offload)
     x = hidden_states(config, params, input_ids, attention_mask, lora,
                       compute_dtype, remat, lora_dropout, dropout_rng,
-                      block_stream=stream)
+                      block_stream=stream, cp_mesh=cp_mesh,
+                      cp_axis=cp_axis)
     logits = x @ params["wte"].astype(compute_dtype).T
     return logits
 

@@ -88,13 +88,29 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(("data", "fsdp")))
 
 
+def sp_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sequence-parallel placement: batch over "data", SEQUENCE over
+    "fsdp" (ring attention consumes the S shards —
+    parallel/ring_attention.py)."""
+    return NamedSharding(mesh, P("data", "fsdp"))
+
+
 def shard_params(params, mesh: Mesh, min_size: int = 2 ** 16):
     """Place a parameter pytree onto the mesh with FSDP shardings."""
     shardings = params_shardings(params, mesh, min_size)
     return jax.device_put(params, shardings)
 
 
-def shard_batch(batch, mesh: Mesh):
-    """Place a batch pytree (leading batch axis) onto the mesh."""
-    s = batch_sharding(mesh)
-    return jax.device_put(batch, jax.tree.map(lambda _: s, batch))
+def shard_batch(batch, mesh: Mesh, sequence_parallel: bool = False):
+    """Place a batch pytree (leading batch axis) onto the mesh. In
+    sequence-parallel mode, [B, S] token arrays shard S over "fsdp";
+    per-sample leaves without a sequence axis (dropout_rng keys) shard
+    only the batch dim."""
+    if not sequence_parallel:
+        s = batch_sharding(mesh)
+        return jax.device_put(batch, jax.tree.map(lambda _: s, batch))
+    sp = sp_batch_sharding(mesh)
+    b_only = NamedSharding(mesh, P("data"))
+    placed = {k: jax.device_put(v, sp if k != "dropout_rng" else b_only)
+              for k, v in batch.items()}
+    return placed

@@ -1,0 +1,137 @@
+"""Ring attention: exact attention over sequence-sharded activations.
+
+The long-context scale-out lever the reference cannot have (it is
+single-device; its only long-context tools are O(S)-memory streaming
+softmax and Gemma's sliding window — SURVEY.md §2.11/§5). Here the
+sequence axis is sharded across mesh devices and K/V chunks rotate around
+the ring with `lax.ppermute` while each device keeps its Q shard and
+accumulates ONLINE-softmax partial results — attention memory per device
+stays O(S_local · S_local) for scores and O(S_local · D) for K/V, so
+context length scales linearly with the number of devices, and each
+rotation's communication can overlap the previous chunk's compute (XLA's
+latency-hiding scheduler; collectives ride ICI).
+
+Semantics match ops.attention.dot_product_attention exactly (causal,
+sliding window implies causal, GQA via Hkv < Hq, key-padding mask) — the
+parity and gradient tests run both on a virtual 8-device CPU mesh
+(tests/test_ring_attention.py). Differentiable end to end: the ring is a
+`lax.scan` over static mesh-size steps inside `shard_map`, so reverse-mode
+AD runs the rotation backwards with the transposed permutation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _chunk_attend(q, k, v, pad, row0, col0, scale, causal, window):
+    """Partial attention of a local Q shard against one K/V chunk at
+    global column offset col0; returns (m, l, acc) online-softmax stats.
+    q: [B, Hq, Sq, D]; k, v: [B, Hkv, Sk, D]; pad: [B, Sk]."""
+    B, Hq, Sq, D = q.shape
+    Hkv, Sk = k.shape[1], k.shape[2]
+    G = Hq // Hkv
+    qg = q.reshape(B, Hkv, G, Sq, D).astype(jnp.float32)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0) + row0
+    cols = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1) + col0
+    mask = jnp.ones((Sq, Sk), jnp.bool_)
+    if causal:
+        mask = mask & (cols <= rows)
+    if window is not None:
+        mask = mask & (cols > rows - window)
+    mask = mask[None, None, None] & (pad > 0)[:, None, None, None, :]
+    s = jnp.where(mask, s, NEG_INF)
+
+    m = jnp.max(s, axis=-1, keepdims=True)                 # [B,Hkv,G,Sq,1]
+    p = jnp.where(mask, jnp.exp(s - m), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhgqk,bhkd->bhgqd", p, v.astype(jnp.float32))
+    return m, l, acc
+
+
+def _ring_shard(q, k, v, pad, *, axis, scale, causal, window):
+    """Runs on each device inside shard_map: local Q stays, K/V/pad
+    rotate; online-softmax merge across the n ring steps."""
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    B, Hq, Sq, D = q.shape
+    Hkv = k.shape[1]
+    G = Hq // Hkv
+    row0 = idx * Sq
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        k_cur, v_cur, pad_cur, src, m, l, acc = carry
+        col0 = src * Sq
+        m_c, l_c, a_c = _chunk_attend(q, k_cur, v_cur, pad_cur, row0,
+                                      col0, scale, causal, window)
+        m_new = jnp.maximum(m, m_c)
+        a1 = jnp.exp(m - m_new)
+        a2 = jnp.exp(m_c - m_new)
+        l = l * a1 + l_c * a2
+        acc = acc * a1 + a_c * a2
+        # rotate: after this step each device holds its left neighbor's
+        # chunk, whose global offset is (src - 1) mod n
+        k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+        pad_nxt = jax.lax.ppermute(pad_cur, axis, perm)
+        src_nxt = (src - 1) % n
+        return (k_nxt, v_nxt, pad_nxt, src_nxt, m_new, l, acc), None
+
+    m0 = jnp.full((B, Hkv, G, Sq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, G, Sq, 1), jnp.float32)
+    a0 = jnp.zeros((B, Hkv, G, Sq, D), jnp.float32)
+    (_, _, _, _, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, pad, idx, m0, l0, a0), None, length=n)
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.reshape(B, Hq, Sq, D).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, *,
+                   axis: str = "fsdp",
+                   batch_axis: Optional[str] = "data",
+                   scale: Optional[float] = None,
+                   is_causal: bool = True,
+                   sliding_window: Optional[int] = None,
+                   padding_mask: Optional[jnp.ndarray] = None):
+    """Exact attention with the sequence axis sharded over `mesh[axis]`.
+
+    q: [B, Hq, S, D]; k, v: [B, Hkv, S, D]; padding_mask: [B, S] (1 =
+    real token). S must divide by the axis size. The batch axis shards
+    over `batch_axis` when the mesh has it (each data group rings over
+    its OWN batch shard — without this, every group would all-gather and
+    redundantly attend over the global batch). Returns [B, Hq, S, D]
+    sharded the same way. Call under jit (or eagerly); shard_map handles
+    the placement.
+    """
+    B, Hq, S, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    is_causal = bool(is_causal) or sliding_window is not None
+    if padding_mask is None:
+        padding_mask = jnp.ones((B, S), jnp.float32)
+    pad = padding_mask.astype(jnp.float32)
+
+    ba = batch_axis if (batch_axis in mesh.axis_names) else None
+    spec_s = P(ba, None, axis, None)     # batch + sequence sharded
+    spec_p = P(ba, axis)
+    fn = partial(_ring_shard, axis=axis, scale=float(scale),
+                 causal=is_causal,
+                 window=None if sliding_window is None
+                 else int(sliding_window))
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(spec_s, spec_s, spec_s, spec_p),
+        out_specs=spec_s,
+        check_vma=False,
+    )(q, k, v, pad)

@@ -168,6 +168,18 @@ def add_mesh_flags(p: argparse.ArgumentParser):
                         "the fsdp mesh axis and run ring attention "
                         "(parallel/ring_attention.py); seq_len must "
                         "divide by mesh_fsdp")
+    g.add_argument("--multihost", action="store_true",
+                   help="multi-process run: bring up jax.distributed "
+                        "(auto-detected on TPU pods) and lay the mesh out "
+                        "DCN-aware (fsdp on ICI within a host, data "
+                        "across hosts; parallel/distributed.py)")
+    g.add_argument("--dist_coordinator", default="",
+                   help="coordinator host:port (or JAX_COORDINATOR_ADDRESS; "
+                        "omit on TPU pods — auto-detected)")
+    g.add_argument("--dist_num_processes", type=int, default=0,
+                   help="process count (or JAX_NUM_PROCESSES; 0 = auto)")
+    g.add_argument("--dist_process_id", type=int, default=-1,
+                   help="this process's id (or JAX_PROCESS_ID; -1 = auto)")
 
 
 def governor_from_args(args) -> StepGovernor:
@@ -201,8 +213,46 @@ def build_mesh(args):
     """Returns (mesh, cp_mesh): cp_mesh is the mesh again when
     --sequence_parallel is set (pass it to the model forwards so ring
     attention engages), else None — deriving it HERE keeps every CLI's
-    wiring consistent."""
+    wiring consistent. --multihost (or JAX_* env) first brings up the
+    distributed runtime and switches to the DCN-aware hybrid layout."""
+    from mobilefinetuner_tpu.parallel.distributed import (initialize,
+                                                          make_hybrid_mesh)
+    # initialize() no-ops without --multihost / --dist_coordinator /
+    # JAX_COORDINATOR_ADDRESS-style env, so the env-var-only launch mode
+    # works without any flag
+    multi = initialize(
+        coordinator=getattr(args, "dist_coordinator", ""),
+        num_processes=getattr(args, "dist_num_processes", 0) or None,
+        process_id=(getattr(args, "dist_process_id", -1)
+                    if getattr(args, "dist_process_id", -1) >= 0 else None),
+        force=getattr(args, "multihost", False))
     n = len(jax.devices())
+    if multi or jax.process_count() > 1:
+        # multi-host: the mesh must span every process's devices, so the
+        # requested (data, fsdp) is interpreted globally; data=0/1 with
+        # fsdp=0 means "data absorbs everything DCN, fsdp=1"
+        fsdp = args.mesh_fsdp or 1
+        data = args.mesh_data if args.mesh_data > 1 else n // fsdp
+        mesh = make_hybrid_mesh(data=data, fsdp=fsdp)
+        args.mesh_data, args.mesh_fsdp = data, fsdp  # for the checks below
+        sp = getattr(args, "sequence_parallel", False)
+        log.info(f"mesh (multihost): data={data} fsdp={fsdp} over "
+                 f"{jax.process_count()} processes"
+                 + (" (sequence-parallel)" if sp else ""))
+        if sp:
+            if args.seq_len % fsdp != 0:
+                raise SystemExit(
+                    f"seq_len={args.seq_len} must divide by "
+                    f"mesh_fsdp={fsdp} in sequence-parallel mode")
+            if args.batch_size % max(data, 1) != 0:
+                raise SystemExit(
+                    f"batch_size={args.batch_size} must divide by "
+                    f"mesh_data={data} in sequence-parallel mode")
+        elif args.batch_size % n != 0:
+            raise SystemExit(
+                f"batch_size={args.batch_size} (the GLOBAL micro-batch) "
+                f"must be divisible by the global device count {n}")
+        return mesh, (mesh if sp else None)
     fsdp = args.mesh_fsdp or (n // max(args.mesh_data, 1))
     mesh = make_mesh(data=args.mesh_data, fsdp=fsdp,
                      devices=jax.devices()[:args.mesh_data * fsdp])
@@ -283,11 +333,16 @@ def micro_batches(dataset: WikiText2Dataset, accum: int,
 
 
 def evaluate(eval_step, trainable, frozen, dataset: WikiText2Dataset,
-             max_batches: int) -> dict:
+             max_batches: int, mesh=None,
+             sequence_parallel: bool = False) -> dict:
     """Token-weighted mean NLL over the split -> {loss, ppl, tokens}
-    (eval_ppl.cpp:157-200 semantics), under the no-grad eval step."""
+    (eval_ppl.cpp:157-200 semantics), under the no-grad eval step.
+    `mesh`: place eval batches like train batches (required under
+    multi-host, where raw host numpy cannot feed a global-mesh jit)."""
     total, count, n = 0.0, 0, 0
     for b in dataset.epoch(0):
+        if mesh is not None:
+            b = shard_batch(b, mesh, sequence_parallel)
         s, c = eval_step(trainable, frozen, b)
         total += float(s)
         count += int(c)
@@ -351,11 +406,32 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     silently reuse one mask for the whole run).
     Returns (trainable, opt_state, last_metrics).
     """
+    from mobilefinetuner_tpu.parallel.distributed import (device_put_global,
+                                                          gather_to_host,
+                                                          is_coordinator)
     governor = governor_from_args(args)
-    metrics_csv = MetricsLogger(args.metrics_csv) if args.metrics_csv \
-        else None
-    eval_jsonl = JSONLWriter(args.eval_out) if getattr(args, "eval_out", "") \
-        else None
+    # multi-host: every process runs the identical compiled step over global
+    # arrays; file sinks (CSV/JSONL/checkpoints) write once, on process 0.
+    # Saving first gathers cross-process-sharded trees to host on EVERY
+    # process (gather_to_host is collective), then only process 0 writes.
+    coord = is_coordinator()
+    multiproc = jax.process_count() > 1
+    metrics_csv = MetricsLogger(args.metrics_csv) \
+        if args.metrics_csv and coord else None
+    eval_jsonl = JSONLWriter(args.eval_out) \
+        if getattr(args, "eval_out", "") and coord else None
+    if save_hook is not None and multiproc:
+        orig_save = save_hook
+
+        def save_hook(step, tr, opt, final=False):
+            tr_h, opt_h = gather_to_host(tr), gather_to_host(opt)
+            if coord:
+                orig_save(step, tr_h, opt_h, final=final)
+    # the eval path must feed global arrays under multi-host (raw host
+    # numpy cannot address a global mesh); single-process keeps the
+    # uncommitted-numpy fast path
+    eval_mesh = mesh if (mesh is not None and multiproc) else None
+    eval_sp = getattr(args, "sequence_parallel", False)
 
     step_fn = make_train_step(loss_fn, tc, mask=mask, donate=True)
     eval_step = make_eval_step(nll_fn)
@@ -366,10 +442,10 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
         # LoRA-style tiny trainables: replicate A/B + Adam state; FSDP'd
         # trainables (full FT) arrive pre-placed and are left alone.
         repl = replicated_sharding(mesh)
-        trainable = jax.device_put(
-            trainable, jax.tree.map(lambda _: repl, trainable))
-        opt_state = jax.device_put(
-            opt_state, jax.tree.map(lambda _: repl, opt_state))
+        trainable = jax.tree.map(
+            lambda x: device_put_global(x, repl), trainable)
+        opt_state = jax.tree.map(
+            lambda x: device_put_global(x, repl), opt_state)
 
     ema = EMA(args.ema_beta)
     batches = micro_batches(train_ds, tc.grad_accum_steps,
@@ -485,7 +561,8 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 and (step + 1) % args.eval_interval == 0):
             flush_metrics(emit_log=False)  # off-cadence boundary flush
             ev = evaluate(eval_step, trainable, frozen, valid_ds,
-                          args.eval_batches)
+                          args.eval_batches, mesh=eval_mesh,
+                          sequence_parallel=eval_sp)
             log.info(f"eval @ step {step + 1}: loss={ev['loss']:.4f} "
                      f"ppl={ev['ppl']:.2f} ({ev['tokens']} tokens)")
             if eval_jsonl:
@@ -508,7 +585,8 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     flush_metrics()
     if valid_ds is not None and args.eval_interval:
         ev = evaluate(eval_step, trainable, frozen, valid_ds,
-                      args.eval_batches)
+                      args.eval_batches, mesh=eval_mesh,
+                      sequence_parallel=eval_sp)
         log.info(f"final eval: loss={ev['loss']:.4f} ppl={ev['ppl']:.2f}")
         if eval_jsonl:
             eval_jsonl.write({"type": "final_eval", "step": total_steps,

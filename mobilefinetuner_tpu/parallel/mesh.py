@@ -96,21 +96,26 @@ def sp_batch_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def shard_params(params, mesh: Mesh, min_size: int = 2 ** 16):
-    """Place a parameter pytree onto the mesh with FSDP shardings."""
+    """Place a parameter pytree onto the mesh with FSDP shardings
+    (multi-host safe: every process holds the same host copy and feeds its
+    addressable shards)."""
+    from mobilefinetuner_tpu.parallel.distributed import device_put_global
     shardings = params_shardings(params, mesh, min_size)
-    return jax.device_put(params, shardings)
+    return jax.tree.map(device_put_global, params, shardings)
 
 
 def shard_batch(batch, mesh: Mesh, sequence_parallel: bool = False):
     """Place a batch pytree (leading batch axis) onto the mesh. In
     sequence-parallel mode, [B, S] token arrays shard S over "fsdp";
     per-sample leaves without a sequence axis (dropout_rng keys) shard
-    only the batch dim."""
+    only the batch dim. Multi-host: every process holds the same global
+    batch and feeds only its addressable shards
+    (parallel/distributed.device_put_global)."""
+    from mobilefinetuner_tpu.parallel.distributed import device_put_global
     if not sequence_parallel:
         s = batch_sharding(mesh)
-        return jax.device_put(batch, jax.tree.map(lambda _: s, batch))
+        return {k: device_put_global(v, s) for k, v in batch.items()}
     sp = sp_batch_sharding(mesh)
     b_only = NamedSharding(mesh, P("data"))
-    placed = {k: jax.device_put(v, sp if k != "dropout_rng" else b_only)
-              for k, v in batch.items()}
-    return placed
+    return {k: device_put_global(v, sp if k != "dropout_rng" else b_only)
+            for k, v in batch.items()}

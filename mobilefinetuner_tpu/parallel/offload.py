@@ -148,12 +148,14 @@ def apply_placement(params, plan, shardings, config: OffloadConfig):
         shardings = jax.tree.map(lambda _: shardings, params)
     od = config.np_offload_dtype
 
+    from mobilefinetuner_tpu.parallel.distributed import device_put_global
+
     def place(x, off, sh):
         x = jnp.asarray(x)
         if off:
-            return jax.device_put(x.astype(od),
-                                  sh.with_memory_kind(HOST))
-        return jax.device_put(x, sh)
+            return device_put_global(x.astype(od),
+                                     sh.with_memory_kind(HOST))
+        return device_put_global(x, sh)
 
     return jax.tree.map(place, params, plan, shardings)
 

@@ -70,29 +70,32 @@ def _ring_shard(q, k, v, pad, *, axis, scale, causal, window):
     row0 = idx * Sq
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(carry, _):
-        k_cur, v_cur, pad_cur, src, m, l, acc = carry
-        col0 = src * Sq
-        m_c, l_c, a_c = _chunk_attend(q, k_cur, v_cur, pad_cur, row0,
-                                      col0, scale, causal, window)
+    def merge(stats, chunk):
+        m, l, acc = stats
+        m_c, l_c, a_c = chunk
         m_new = jnp.maximum(m, m_c)
         a1 = jnp.exp(m - m_new)
         a2 = jnp.exp(m_c - m_new)
-        l = l * a1 + l_c * a2
-        acc = acc * a1 + a_c * a2
-        # rotate: after this step each device holds its left neighbor's
-        # chunk, whose global offset is (src - 1) mod n
-        k_nxt = jax.lax.ppermute(k_cur, axis, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis, perm)
-        pad_nxt = jax.lax.ppermute(pad_cur, axis, perm)
-        src_nxt = (src - 1) % n
-        return (k_nxt, v_nxt, pad_nxt, src_nxt, m_new, l, acc), None
+        return m_new, l * a1 + l_c * a2, acc * a1 + a_c * a2
 
-    m0 = jnp.full((B, Hkv, G, Sq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, Hkv, G, Sq, 1), jnp.float32)
-    a0 = jnp.zeros((B, Hkv, G, Sq, D), jnp.float32)
+    def step(carry, _):
+        # rotate FIRST: the local chunk was attended before the scan, so
+        # only n-1 rotations happen — no trailing ppermute whose result
+        # would be thrown away
+        k_cur, v_cur, pad_cur, src, m, l, acc = carry
+        k_cur = jax.lax.ppermute(k_cur, axis, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis, perm)
+        pad_cur = jax.lax.ppermute(pad_cur, axis, perm)
+        src = (src - 1) % n
+        chunk = _chunk_attend(q, k_cur, v_cur, pad_cur, row0, src * Sq,
+                              scale, causal, window)
+        m, l, acc = merge((m, l, acc), chunk)
+        return (k_cur, v_cur, pad_cur, src, m, l, acc), None
+
+    m0, l0, acc0 = _chunk_attend(q, k, v, pad, row0, idx * Sq, scale,
+                                 causal, window)
     (_, _, _, _, m, l, acc), _ = jax.lax.scan(
-        step, (k, v, pad, idx, m0, l0, a0), None, length=n)
+        step, (k, v, pad, idx, m0, l0, acc0), None, length=n - 1)
     out = acc / jnp.maximum(l, 1e-30)
     return out.reshape(B, Hq, Sq, D).astype(q.dtype)
 

@@ -1,0 +1,156 @@
+"""Multi-host distributed backend tests (parallel/distributed.py).
+
+A real multi-process run needs N hosts; what CAN be validated here (the
+reference's mocked-telemetry testing culture, SURVEY.md §4.6, applied to
+the distributed runtime) is everything except the socket layer:
+single-process no-op semantics, the DCN-aware mesh layout rule, and the
+global-array feeding path (make_array_from_callback produces bit-identical
+placement to device_put when every shard is addressable).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mobilefinetuner_tpu.parallel import distributed as dist
+from mobilefinetuner_tpu.parallel.mesh import (batch_sharding, make_mesh,
+                                               shard_batch, shard_params)
+
+
+def test_initialize_noop_single_process(monkeypatch):
+    """No coordinator, no env, no pod -> initialize must not start the
+    distributed service (it would hang waiting for peers)."""
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert dist.initialize() is False
+    assert jax.process_count() == 1
+
+
+def test_is_coordinator_single_process():
+    assert dist.is_coordinator() is True
+
+
+def test_hybrid_mesh_single_process_matches_make_mesh():
+    m = dist.make_hybrid_mesh(data=2, fsdp=4)
+    assert m.axis_names == ("data", "fsdp")
+    assert m.shape["data"] == 2 and m.shape["fsdp"] == 4
+    assert set(np.asarray(m.devices).ravel()) == set(jax.devices())
+
+
+def test_hybrid_mesh_infers_fsdp():
+    m = dist.make_hybrid_mesh(data=2, fsdp=None)
+    assert m.shape["fsdp"] == len(jax.devices()) // 2
+
+
+def test_hybrid_mesh_rejects_bad_shape():
+    with pytest.raises(ValueError):
+        dist.make_hybrid_mesh(data=3, fsdp=3)
+
+
+def test_device_put_global_matches_device_put():
+    mesh = make_mesh(data=2, fsdp=4, devices=jax.devices()[:8])
+    sh = NamedSharding(mesh, P(None, "fsdp"))
+    x = np.arange(32 * 8, dtype=np.float32).reshape(32, 8)
+    a = dist.device_put_global(x, sh)
+    b = jax.device_put(x, sh)
+    assert a.sharding == b.sharding
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_device_put_global_batch_sharding():
+    mesh = make_mesh(data=2, fsdp=4, devices=jax.devices()[:8])
+    sh = batch_sharding(mesh)
+    x = np.arange(16 * 4, dtype=np.int32).reshape(16, 4)
+    arr = dist.device_put_global(x, sh)
+    assert arr.sharding == sh
+    np.testing.assert_array_equal(np.asarray(arr), x)
+
+
+def test_gather_to_host_single_process_identity():
+    t = {"a": jax.numpy.ones((4, 4)), "b": 3}
+    out = dist.gather_to_host(t)
+    assert out["a"] is t["a"] and out["b"] == 3
+
+
+def test_make_array_from_callback_path_equivalence():
+    """The multi-process feeding path (exercised explicitly, since
+    process_count()==1 would route around it): callback-built global
+    arrays must equal the device_put result shard for shard."""
+    mesh = make_mesh(data=2, fsdp=4, devices=jax.devices()[:8])
+    sh = batch_sharding(mesh)
+    x = np.random.default_rng(0).normal(size=(16, 8)).astype(np.float32)
+    via_cb = jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
+    via_dp = jax.device_put(x, sh)
+    np.testing.assert_array_equal(np.asarray(via_cb), np.asarray(via_dp))
+    for s_cb, s_dp in zip(via_cb.addressable_shards,
+                          via_dp.addressable_shards):
+        assert s_cb.device == s_dp.device
+        np.testing.assert_array_equal(np.asarray(s_cb.data),
+                                      np.asarray(s_dp.data))
+
+
+def test_shard_batch_routes_through_global_path():
+    """shard_batch output must be usable as a jit input over the mesh and
+    carry the expected batch sharding."""
+    mesh = make_mesh(data=2, fsdp=4, devices=jax.devices()[:8])
+    batch = {"input_ids": np.ones((8, 16), np.int32),
+             "labels": np.full((8, 16), -100, np.int32)}
+    placed = shard_batch(batch, mesh)
+    assert placed["input_ids"].sharding.spec == P(("data", "fsdp"))
+
+    @jax.jit
+    def f(b):
+        return jnp.sum(b["input_ids"])
+
+    assert int(f(placed)) == 8 * 16
+
+
+def test_two_process_training_step_agrees():
+    """REAL multi-process validation: launch tools/multihost_smoke.py as
+    two coordinated processes (jax.distributed over CPU, 4 virtual devices
+    each -> a (2 procs × 4 dev) global mesh), run two FSDP LoRA optimizer
+    steps, and assert both processes converge to the SAME loss — which
+    requires the cross-process collectives (param all-gathers, grad
+    reductions) to have actually run."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:  # free port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS",)}  # workers set their own device count
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join(repo, "tools", "multihost_smoke.py"),
+         coord, "2", str(i), "4"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for i in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+        assert "MULTIHOST_OK" in out, out
+    losses = {ln.split("loss=")[1].split()[0]
+              for out in outs for ln in out.splitlines()
+              if "MULTIHOST_OK" in ln}
+    assert len(losses) == 1, f"processes disagree: {losses}"
+
+
+def test_shard_params_global_path():
+    mesh = make_mesh(data=2, fsdp=4, devices=jax.devices()[:8])
+    params = {"w": np.random.default_rng(1).normal(
+        size=(256, 512)).astype(np.float32)}
+    placed = shard_params(params, mesh, min_size=1024)
+    spec = placed["w"].sharding.spec
+    assert "fsdp" in tuple(spec)
+    np.testing.assert_allclose(np.asarray(placed["w"]), params["w"])

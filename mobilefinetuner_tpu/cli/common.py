@@ -227,53 +227,50 @@ def build_mesh(args):
                     if getattr(args, "dist_process_id", -1) >= 0 else None),
         force=getattr(args, "multihost", False))
     n = len(jax.devices())
-    if multi or jax.process_count() > 1:
-        # multi-host: the mesh must span every process's devices, so the
-        # requested (data, fsdp) is interpreted globally; data=0/1 with
-        # fsdp=0 means "data absorbs everything DCN, fsdp=1"
-        fsdp = args.mesh_fsdp or 1
+    multi = multi or jax.process_count() > 1
+    if multi:
+        # the mesh must span every process's devices, so (data, fsdp) is
+        # interpreted globally. mesh_fsdp=0 keeps its "all remaining"
+        # meaning, resolved hierarchy-aware: fsdp = one host's ICI domain
+        # (or the global remainder when an explicit data size is given).
+        fsdp = args.mesh_fsdp
+        if fsdp == 0:
+            fsdp = (n // args.mesh_data if args.mesh_data > 1
+                    else len(jax.local_devices()))
         data = args.mesh_data if args.mesh_data > 1 else n // fsdp
         mesh = make_hybrid_mesh(data=data, fsdp=fsdp)
-        args.mesh_data, args.mesh_fsdp = data, fsdp  # for the checks below
-        sp = getattr(args, "sequence_parallel", False)
-        log.info(f"mesh (multihost): data={data} fsdp={fsdp} over "
-                 f"{jax.process_count()} processes"
-                 + (" (sequence-parallel)" if sp else ""))
-        if sp:
-            if args.seq_len % fsdp != 0:
-                raise SystemExit(
-                    f"seq_len={args.seq_len} must divide by "
-                    f"mesh_fsdp={fsdp} in sequence-parallel mode")
-            if args.batch_size % max(data, 1) != 0:
-                raise SystemExit(
-                    f"batch_size={args.batch_size} must divide by "
-                    f"mesh_data={data} in sequence-parallel mode")
-        elif args.batch_size % n != 0:
-            raise SystemExit(
-                f"batch_size={args.batch_size} (the GLOBAL micro-batch) "
-                f"must be divisible by the global device count {n}")
-        return mesh, (mesh if sp else None)
-    fsdp = args.mesh_fsdp or (n // max(args.mesh_data, 1))
-    mesh = make_mesh(data=args.mesh_data, fsdp=fsdp,
-                     devices=jax.devices()[:args.mesh_data * fsdp])
+    else:
+        data = args.mesh_data
+        fsdp = args.mesh_fsdp or (n // max(data, 1))
+        mesh = make_mesh(data=data, fsdp=fsdp,
+                         devices=jax.devices()[:data * fsdp])
+    size = data * fsdp
     sp = getattr(args, "sequence_parallel", False)
-    if args.mesh_data * fsdp > 1:
-        log.info(f"mesh: data={args.mesh_data} fsdp={fsdp}"
+    if size > 1:
+        log.info(f"mesh: data={data} fsdp={fsdp}"
+                 + (f" over {jax.process_count()} processes" if multi
+                    else "")
                  + (" (sequence-parallel)" if sp else ""))
-        if sp:
-            if args.batch_size % max(args.mesh_data, 1) != 0:
-                raise SystemExit(
-                    f"batch_size={args.batch_size} must divide by "
-                    f"mesh_data={args.mesh_data} in sequence-parallel "
-                    f"mode")
-            if args.seq_len % fsdp != 0:
-                raise SystemExit(
-                    f"seq_len={args.seq_len} must divide by "
-                    f"mesh_fsdp={fsdp} in sequence-parallel mode")
-        elif args.batch_size % (args.mesh_data * fsdp) != 0:
+        # one validation block for both layouts: batch shards over the
+        # whole mesh (or just "data" under sequence parallelism, where
+        # "fsdp" carries the sequence axis instead)
+        b_div = max(data, 1) if sp else size
+        b_axis = f"mesh_data={data}" if sp else f"the mesh size {size}"
+        if args.batch_size % b_div != 0:
             raise SystemExit(
-                f"batch_size={args.batch_size} (the micro-batch) must be "
-                f"divisible by the mesh size {args.mesh_data * fsdp}")
+                f"batch_size={args.batch_size} (the "
+                f"{'GLOBAL ' if multi else ''}micro-batch) must be "
+                f"divisible by {b_axis}")
+        if sp and args.seq_len % fsdp != 0:
+            raise SystemExit(
+                f"seq_len={args.seq_len} must divide by mesh_fsdp={fsdp} "
+                f"in sequence-parallel mode")
+        if (multi and getattr(args, "eval_interval", 0)
+                and getattr(args, "eval_batch_size", 1) % b_div != 0):
+            raise SystemExit(
+                f"eval_batch_size={args.eval_batch_size} must be "
+                f"divisible by {b_axis} under multi-host (eval batches "
+                f"shard like train batches)")
     return mesh, (mesh if sp else None)
 
 

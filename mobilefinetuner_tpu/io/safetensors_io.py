@@ -7,6 +7,12 @@ in gpt2_full_finetune/main.cpp:156-237 / graph/lora_saver.cpp. Like the
 reference we parse the header ourselves and memory-map the blob; unlike the
 reference (F32/F16 only, auto-promote to F32) we also handle BF16 — the
 TPU-native parameter dtype.
+
+Two interchangeable backends: the native C++ engine (native/
+fast_safetensors.{cpp,py} — mmap + own JSON parser + streamed writer,
+mirroring the reference's native loader role) is used automatically when it
+builds; this module's pure-Python implementation is the behavioral
+reference and the fallback. MFT_NO_NATIVE_ST=1 forces Python.
 """
 
 from __future__ import annotations
@@ -16,6 +22,14 @@ import struct
 from typing import Dict, Optional, Tuple
 
 import numpy as np
+
+
+def _native_mod():
+    try:
+        from mobilefinetuner_tpu.native import fast_safetensors as m
+        return m if m.load_library() is not None else None
+    except Exception:
+        return None
 
 # safetensors dtype tag -> (numpy dtype used for raw decode, itemsize)
 _DTYPES = {
@@ -45,10 +59,28 @@ def _f32_to_bf16_u16(x: np.ndarray) -> np.ndarray:
 
 
 class SafeTensorsReader:
-    """Parses header eagerly, memory-maps the blob, loads tensors lazily."""
+    """Parses header eagerly, memory-maps the blob, loads tensors lazily.
+
+    Backed by the native C++ engine when available (identical entries/
+    metadata/load results — tests/test_native_safetensors.py asserts
+    byte-level parity), else by the pure-Python parse below.
+    """
 
     def __init__(self, path: str):
         self.path = path
+        self._native = None
+        nat = _native_mod()
+        if nat is not None:
+            try:
+                self._native = nat.NativeReader(path)
+            except MemoryError:
+                self._native = None
+            # ValueError (malformed file) propagates: both backends reject
+        if self._native is not None:
+            self.metadata = self._native.metadata
+            self.entries = self._native.entries
+            self._blob = None
+            return
         with open(path, "rb") as f:
             (header_len,) = struct.unpack("<Q", f.read(8))
             header = json.loads(f.read(header_len).decode("utf-8"))
@@ -74,8 +106,11 @@ class SafeTensorsReader:
         tag = e["dtype"]
         if tag not in _DTYPES:
             raise ValueError(f"unsupported safetensors dtype {tag}")
-        begin, end = e["data_offsets"]
-        raw = np.frombuffer(self._blob[begin:end], dtype=_DTYPES[tag])
+        if self._native is not None:
+            raw = np.frombuffer(self._native.raw(name), dtype=_DTYPES[tag])
+        else:
+            begin, end = e["data_offsets"]
+            raw = np.frombuffer(self._blob[begin:end], dtype=_DTYPES[tag])
         if tag == "BF16":
             arr = _bf16_to_f32(raw)
         else:
@@ -88,12 +123,41 @@ class SafeTensorsReader:
         return {k: self.load(k, promote_to_f32) for k in self.entries}
 
 
+def _encode_tensor(name, arr, bf16_keys) -> Tuple[str, tuple, bytes]:
+    """(tag, shape, raw_bytes) for one tensor, shared by both writers."""
+    arr = np.asarray(arr)
+    # jax bf16 arrays arrive as ml_dtypes.bfloat16 numpy arrays — store
+    # them as BF16, not silently upcast to F32.
+    is_bf16_input = arr.dtype.name == "bfloat16"
+    if is_bf16_input:
+        arr = arr.astype(np.float32)
+    if is_bf16_input or (bf16_keys and name in bf16_keys):
+        return ("BF16", arr.shape,
+                _f32_to_bf16_u16(arr.astype(np.float32)).tobytes())
+    if arr.dtype not in _TO_TAG:
+        arr = arr.astype(np.float32)
+    return (_TO_TAG[arr.dtype], arr.shape,
+            np.ascontiguousarray(arr).tobytes())
+
+
 def save_safetensors(path: str, tensors: Dict[str, np.ndarray],
                      metadata: Optional[Dict[str, str]] = None,
                      bf16_keys: Optional[set] = None):
     """Write a safetensors file. Keys in `bf16_keys` (or arrays already
     passed as jax bfloat16 via float32 conversion upstream) are stored BF16.
+    Uses the native streamed writer when available; the Python writer below
+    is the fallback and behavioral reference.
     """
+    nat = _native_mod()
+    if nat is not None:
+        # real write failures (IOError) propagate — a disk that rejects
+        # the native writer would reject the Python writer too
+        nat.native_write(
+            path,
+            [(name,) + _encode_tensor(name, arr, bf16_keys)
+             for name, arr in tensors.items()],
+            metadata)
+        return
     header: Dict[str, object] = {}
     if metadata:
         header["__metadata__"] = {str(k): str(v)
@@ -101,21 +165,8 @@ def save_safetensors(path: str, tensors: Dict[str, np.ndarray],
     blobs = []
     offset = 0
     for name, arr in tensors.items():
-        arr = np.asarray(arr)
-        # jax bf16 arrays arrive as ml_dtypes.bfloat16 numpy arrays — store
-        # them as BF16, not silently upcast to F32.
-        is_bf16_input = arr.dtype.name == "bfloat16"
-        if is_bf16_input:
-            arr = arr.astype(np.float32)
-        if is_bf16_input or (bf16_keys and name in bf16_keys):
-            raw = _f32_to_bf16_u16(arr.astype(np.float32)).tobytes()
-            tag = "BF16"
-        else:
-            if arr.dtype not in _TO_TAG:
-                arr = arr.astype(np.float32)
-            raw = np.ascontiguousarray(arr).tobytes()
-            tag = _TO_TAG[arr.dtype]
-        header[name] = {"dtype": tag, "shape": list(arr.shape),
+        tag, shape, raw = _encode_tensor(name, arr, bf16_keys)
+        header[name] = {"dtype": tag, "shape": list(shape),
                         "data_offsets": [offset, offset + len(raw)]}
         blobs.append(raw)
         offset += len(raw)

@@ -1,0 +1,532 @@
+// Native safetensors engine: mmap'd reader + buffered writer, plain C ABI.
+//
+// The runtime-native counterpart of io/safetensors_io.py (which stays as the
+// behavioral reference and automatic fallback). Mirrors the CAPABILITY of the
+// reference's C++ loader (reference: operators/finetune_ops/graph/
+// safetensors_loader.{h,cpp}: 8-byte LE header length + JSON header + raw
+// blob, F32/F16 focus) but is an independent design: a tagged-union JSON
+// parser instead of field scraping, mmap + zero-copy tensor windows instead
+// of per-tensor reads, and BF16 as a first-class tag (TPU parameter dtype).
+//
+// Build: g++ -O2 -shared -fPIC fast_safetensors.cpp -o libfast_safetensors.so
+// (driven lazily by native/fast_safetensors.py, same scheme as fast_bpe).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+// ---------------------------------------------------------------- JSON ----
+// Minimal recursive-descent JSON parser. Safetensors headers are flat
+// machine-written JSON, but we parse the full grammar (incl. \u escapes)
+// so any spec-conformant producer round-trips.
+
+struct JValue;
+// insertion-ordered object: safetensors key order is file order and must
+// round-trip (Python's json preserves it; a sorted map would not)
+using JObject = std::vector<std::pair<std::string, JValue>>;
+struct JValue {
+  enum Kind { kNull, kBool, kNum, kStr, kArr, kObj } kind = kNull;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<JValue> arr;
+  std::shared_ptr<JObject> obj;  // shared_ptr: JObject is incomplete here
+};
+
+const JValue* jfind(const JObject& o, const char* key) {
+  for (const auto& kv : o)
+    if (kv.first == key) return &kv.second;
+  return nullptr;
+}
+
+struct JParser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  explicit JParser(const std::string& s) : p(s.data()), end(s.data() + s.size()) {}
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++;
+  }
+  bool lit(const char* s) {
+    size_t n = strlen(s);
+    if (size_t(end - p) < n || memcmp(p, s, n) != 0) return false;
+    p += n;
+    return true;
+  }
+
+  bool parse_hex4(uint32_t* out) {
+    if (end - p < 4) return false;
+    uint32_t v = 0;
+    for (int i = 0; i < 4; i++) {
+      char c = p[i];
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= uint32_t(c - '0');
+      else if (c >= 'a' && c <= 'f') v |= uint32_t(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F') v |= uint32_t(c - 'A' + 10);
+      else return false;
+    }
+    p += 4;
+    *out = v;
+    return true;
+  }
+
+  static void append_utf8(std::string* s, uint32_t cp) {
+    if (cp < 0x80) {
+      s->push_back(char(cp));
+    } else if (cp < 0x800) {
+      s->push_back(char(0xC0 | (cp >> 6)));
+      s->push_back(char(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      s->push_back(char(0xE0 | (cp >> 12)));
+      s->push_back(char(0x80 | ((cp >> 6) & 0x3F)));
+      s->push_back(char(0x80 | (cp & 0x3F)));
+    } else {
+      s->push_back(char(0xF0 | (cp >> 18)));
+      s->push_back(char(0x80 | ((cp >> 12) & 0x3F)));
+      s->push_back(char(0x80 | ((cp >> 6) & 0x3F)));
+      s->push_back(char(0x80 | (cp & 0x3F)));
+    }
+  }
+
+  bool parse_string(std::string* out) {
+    if (p >= end || *p != '"') return false;
+    p++;
+    out->clear();
+    while (p < end && *p != '"') {
+      char c = *p++;
+      if (c != '\\') {
+        out->push_back(c);
+        continue;
+      }
+      if (p >= end) return false;
+      char e = *p++;
+      switch (e) {
+        case '"': out->push_back('"'); break;
+        case '\\': out->push_back('\\'); break;
+        case '/': out->push_back('/'); break;
+        case 'b': out->push_back('\b'); break;
+        case 'f': out->push_back('\f'); break;
+        case 'n': out->push_back('\n'); break;
+        case 'r': out->push_back('\r'); break;
+        case 't': out->push_back('\t'); break;
+        case 'u': {
+          uint32_t cp;
+          if (!parse_hex4(&cp)) return false;
+          if (cp >= 0xD800 && cp <= 0xDBFF) {  // surrogate pair
+            if (end - p < 6 || p[0] != '\\' || p[1] != 'u') return false;
+            p += 2;
+            uint32_t lo;
+            if (!parse_hex4(&lo) || lo < 0xDC00 || lo > 0xDFFF) return false;
+            cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+          }
+          append_utf8(out, cp);
+          break;
+        }
+        default: return false;
+      }
+    }
+    if (p >= end) return false;
+    p++;  // closing quote
+    return true;
+  }
+
+  bool parse_value(JValue* v) {
+    skip_ws();
+    if (p >= end) return false;
+    switch (*p) {
+      case '{': {
+        p++;
+        v->kind = JValue::kObj;
+        v->obj = std::make_shared<JObject>();
+        skip_ws();
+        if (p < end && *p == '}') { p++; return true; }
+        while (true) {
+          skip_ws();
+          std::string key;
+          if (!parse_string(&key)) return false;
+          skip_ws();
+          if (p >= end || *p++ != ':') return false;
+          JValue child;
+          if (!parse_value(&child)) return false;
+          v->obj->emplace_back(std::move(key), std::move(child));
+          skip_ws();
+          if (p < end && *p == ',') { p++; continue; }
+          if (p < end && *p == '}') { p++; return true; }
+          return false;
+        }
+      }
+      case '[': {
+        p++;
+        v->kind = JValue::kArr;
+        skip_ws();
+        if (p < end && *p == ']') { p++; return true; }
+        while (true) {
+          JValue child;
+          if (!parse_value(&child)) return false;
+          v->arr.push_back(std::move(child));
+          skip_ws();
+          if (p < end && *p == ',') { p++; continue; }
+          if (p < end && *p == ']') { p++; return true; }
+          return false;
+        }
+      }
+      case '"':
+        v->kind = JValue::kStr;
+        return parse_string(&v->str);
+      case 't': v->kind = JValue::kBool; v->b = true; return lit("true");
+      case 'f': v->kind = JValue::kBool; v->b = false; return lit("false");
+      case 'n': v->kind = JValue::kNull; return lit("null");
+      default: {
+        char* q = nullptr;
+        v->kind = JValue::kNum;
+        v->num = strtod(p, &q);
+        if (q == p || q > end) return false;
+        p = q;
+        return true;
+      }
+    }
+  }
+};
+
+// ------------------------------------------------------------- reader -----
+
+struct TensorEntry {
+  std::string name;
+  std::string dtype;                // safetensors tag: "F32", "BF16", ...
+  std::vector<int64_t> shape;
+  uint64_t begin = 0, end = 0;      // offsets within the blob
+};
+
+struct Reader {
+  int fd = -1;
+  uint8_t* map = nullptr;
+  size_t file_size = 0;
+  uint64_t blob_off = 0;            // 8 + header_len
+  std::vector<TensorEntry> tensors;
+  std::map<std::string, size_t> index;
+  std::vector<std::pair<std::string, std::string>> metadata;
+  std::string error;
+};
+
+Reader* reader_fail(Reader* r, const char* msg) {
+  r->error = msg;
+  return r;  // caller inspects st_error()
+}
+
+}  // namespace
+
+extern "C" {
+
+// Opens the file; returns a handle even on failure (query st_error, then
+// st_close). A null return means allocation failed.
+void* st_open(const char* path) {
+  Reader* r = new Reader();
+  r->fd = ::open(path, O_RDONLY);
+  if (r->fd < 0) return reader_fail(r, "cannot open file");
+  struct stat st;
+  if (fstat(r->fd, &st) != 0 || st.st_size < 8)
+    return reader_fail(r, "file too small for safetensors header");
+  r->file_size = size_t(st.st_size);
+  r->map = static_cast<uint8_t*>(
+      mmap(nullptr, r->file_size, PROT_READ, MAP_PRIVATE, r->fd, 0));
+  if (r->map == MAP_FAILED) {
+    r->map = nullptr;
+    return reader_fail(r, "mmap failed");
+  }
+  uint64_t header_len;
+  memcpy(&header_len, r->map, 8);   // little-endian file, LE hosts only
+  if (header_len > r->file_size - 8)
+    return reader_fail(r, "header length exceeds file size");
+  r->blob_off = 8 + header_len;
+
+  std::string hdr(reinterpret_cast<const char*>(r->map + 8), header_len);
+  JParser jp(hdr);
+  JValue root;
+  if (!jp.parse_value(&root) || root.kind != JValue::kObj)
+    return reader_fail(r, "header is not a JSON object");
+
+  uint64_t blob_size = r->file_size - r->blob_off;
+  for (auto& kv : *root.obj) {
+    if (kv.first == "__metadata__") {
+      if (kv.second.kind == JValue::kObj)
+        for (auto& m : *kv.second.obj)
+          if (m.second.kind == JValue::kStr)
+            r->metadata.emplace_back(m.first, m.second.str);
+      continue;
+    }
+    if (kv.second.kind != JValue::kObj)
+      return reader_fail(r, "tensor entry is not an object");
+    const JObject& e = *kv.second.obj;
+    TensorEntry t;
+    t.name = kv.first;
+    const JValue* dt = jfind(e, "dtype");
+    const JValue* sh = jfind(e, "shape");
+    const JValue* off = jfind(e, "data_offsets");
+    if (!dt || dt->kind != JValue::kStr ||
+        !sh || sh->kind != JValue::kArr ||
+        !off || off->kind != JValue::kArr || off->arr.size() != 2)
+      return reader_fail(r, "malformed tensor entry");
+    t.dtype = dt->str;
+    for (auto& d : sh->arr) {
+      if (d.kind != JValue::kNum) return reader_fail(r, "non-numeric dim");
+      t.shape.push_back(int64_t(d.num));
+    }
+    t.begin = uint64_t(off->arr[0].num);
+    t.end = uint64_t(off->arr[1].num);
+    if (t.begin > t.end || t.end > blob_size)
+      return reader_fail(r, "tensor offsets out of range");
+    r->index[t.name] = r->tensors.size();
+    r->tensors.push_back(std::move(t));
+  }
+  return r;
+}
+
+const char* st_error(void* h) {
+  Reader* r = static_cast<Reader*>(h);
+  return r->error.empty() ? nullptr : r->error.c_str();
+}
+
+int32_t st_count(void* h) {
+  return int32_t(static_cast<Reader*>(h)->tensors.size());
+}
+
+const char* st_key(void* h, int32_t i) {
+  Reader* r = static_cast<Reader*>(h);
+  if (i < 0 || size_t(i) >= r->tensors.size()) return nullptr;
+  return r->tensors[i].name.c_str();
+}
+
+// Fills dtype tag (cap>=8 incl. NUL), ndim, shape (cap 8) and the blob
+// window [begin, end). Returns 0, or -1 if the name is unknown.
+int32_t st_info(void* h, const char* name, char* dtype_out, int32_t* ndim,
+                int64_t* shape_out, uint64_t* begin, uint64_t* end) {
+  Reader* r = static_cast<Reader*>(h);
+  auto it = r->index.find(name);
+  if (it == r->index.end()) return -1;
+  const TensorEntry& t = r->tensors[it->second];
+  if (t.shape.size() > 8) return -2;  // caller's shape buffer is 8 slots
+  snprintf(dtype_out, 8, "%s", t.dtype.c_str());
+  *ndim = int32_t(t.shape.size());
+  for (size_t i = 0; i < t.shape.size(); i++)
+    shape_out[i] = t.shape[i];
+  *begin = t.begin;
+  *end = t.end;
+  return 0;
+}
+
+// Base pointer of the mmap'd blob; tensor bytes live at base+begin.
+const uint8_t* st_blob(void* h) {
+  Reader* r = static_cast<Reader*>(h);
+  return r->map ? r->map + r->blob_off : nullptr;
+}
+
+int32_t st_meta_count(void* h) {
+  return int32_t(static_cast<Reader*>(h)->metadata.size());
+}
+
+const char* st_meta_key(void* h, int32_t i) {
+  Reader* r = static_cast<Reader*>(h);
+  if (i < 0 || size_t(i) >= r->metadata.size()) return nullptr;
+  return r->metadata[i].first.c_str();
+}
+
+const char* st_meta_val(void* h, int32_t i) {
+  Reader* r = static_cast<Reader*>(h);
+  if (i < 0 || size_t(i) >= r->metadata.size()) return nullptr;
+  return r->metadata[i].second.c_str();
+}
+
+void st_close(void* h) {
+  Reader* r = static_cast<Reader*>(h);
+  if (r->map) munmap(r->map, r->file_size);
+  if (r->fd >= 0) ::close(r->fd);
+  delete r;
+}
+
+// ------------------------------------------------------------- writer -----
+// Streamed two-pass writer: callers declare every tensor (name/tag/shape/
+// size) up front, then the header is emitted once and tensor bytes are
+// appended in declaration order — no in-memory concatenation of the blob.
+
+namespace {
+
+struct PendingTensor {
+  std::string name, dtype;
+  std::vector<int64_t> shape;
+  uint64_t nbytes = 0;
+};
+
+struct Writer {
+  std::string path;
+  FILE* f = nullptr;
+  std::vector<PendingTensor> pending;
+  std::vector<std::pair<std::string, std::string>> metadata;
+  bool header_written = false;
+  size_t write_cursor = 0;   // next tensor expected by st_write_data
+  std::string error;
+};
+
+void json_escape(const std::string& s, std::string* out) {
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\b': *out += "\\b"; break;
+      case '\f': *out += "\\f"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(char(c));
+        }
+    }
+  }
+}
+
+}  // namespace
+
+void* stw_create(const char* path) {
+  Writer* w = new Writer();
+  w->path = path;
+  return w;
+}
+
+const char* stw_error(void* h) {
+  Writer* w = static_cast<Writer*>(h);
+  return w->error.empty() ? nullptr : w->error.c_str();
+}
+
+void stw_meta(void* h, const char* key, const char* val) {
+  static_cast<Writer*>(h)->metadata.emplace_back(key, val);
+}
+
+int32_t stw_declare(void* h, const char* name, const char* dtype,
+                    const int64_t* shape, int32_t ndim, uint64_t nbytes) {
+  Writer* w = static_cast<Writer*>(h);
+  if (w->header_written) {
+    w->error = "declare after header written";
+    return -1;
+  }
+  PendingTensor t;
+  t.name = name;
+  t.dtype = dtype;
+  t.shape.assign(shape, shape + ndim);
+  t.nbytes = nbytes;
+  w->pending.push_back(std::move(t));
+  return 0;
+}
+
+// Emits the 8-byte length + JSON header (8-byte space-padded, matching the
+// HF writer convention). Idempotent.
+static int32_t stw_write_header(void* h) {
+  Writer* w = static_cast<Writer*>(h);
+  if (!w->header_written) {
+    std::string hdr = "{";
+    bool first = true;
+    if (!w->metadata.empty()) {
+      hdr += "\"__metadata__\":{";
+      bool mf = true;
+      for (auto& kv : w->metadata) {
+        if (!mf) hdr += ",";
+        mf = false;
+        hdr += "\"";
+        json_escape(kv.first, &hdr);
+        hdr += "\":\"";
+        json_escape(kv.second, &hdr);
+        hdr += "\"";
+      }
+      hdr += "}";
+      first = false;
+    }
+    uint64_t off = 0;
+    for (auto& t : w->pending) {
+      if (!first) hdr += ",";
+      first = false;
+      hdr += "\"";
+      json_escape(t.name, &hdr);
+      hdr += "\":{\"dtype\":\"" + t.dtype + "\",\"shape\":[";
+      for (size_t i = 0; i < t.shape.size(); i++) {
+        if (i) hdr += ",";
+        hdr += std::to_string(t.shape[i]);
+      }
+      hdr += "],\"data_offsets\":[" + std::to_string(off) + "," +
+             std::to_string(off + t.nbytes) + "]}";
+      off += t.nbytes;
+    }
+    hdr += "}";
+    while (hdr.size() % 8) hdr += " ";
+    w->f = fopen(w->path.c_str(), "wb");
+    if (!w->f) {
+      w->error = "cannot open output file";
+      return -1;
+    }
+    uint64_t hlen = hdr.size();
+    if (fwrite(&hlen, 8, 1, w->f) != 1 ||
+        fwrite(hdr.data(), 1, hdr.size(), w->f) != hdr.size()) {
+      w->error = "header write failed";
+      return -1;
+    }
+    w->header_written = true;
+  }
+  return 0;
+}
+
+// Writes one tensor's bytes; tensors MUST arrive in declaration order. The
+// first call emits the header.
+int32_t stw_data(void* h, const uint8_t* data, uint64_t nbytes) {
+  Writer* w = static_cast<Writer*>(h);
+  if (stw_write_header(h) != 0) return -1;
+  if (w->write_cursor >= w->pending.size() ||
+      nbytes != w->pending[w->write_cursor].nbytes) {
+    w->error = "tensor data out of declared order/size";
+    return -1;
+  }
+  if (nbytes && fwrite(data, 1, nbytes, w->f) != nbytes) {
+    w->error = "data write failed";
+    return -1;
+  }
+  w->write_cursor++;
+  return 0;
+}
+
+int32_t stw_finish(void* h) {
+  Writer* w = static_cast<Writer*>(h);
+  int32_t rc = 0;
+  if (!w->header_written) stw_write_header(h);  // zero-tensor file
+  if (!w->error.empty() || w->write_cursor != w->pending.size()) {
+    if (w->error.empty()) w->error = "missing tensor data at finish";
+    rc = -1;
+  }
+  if (w->f && fclose(w->f) != 0 && rc == 0) {
+    w->error = "close failed";
+    rc = -1;
+  }
+  w->f = nullptr;
+  return rc;
+}
+
+void stw_destroy(void* h) {
+  Writer* w = static_cast<Writer*>(h);
+  if (w->f) fclose(w->f);
+  delete w;
+}
+
+}  // extern "C"

@@ -1,0 +1,191 @@
+"""ctypes binding + lazy build for the native safetensors engine.
+
+Same scheme as native/fast_bpe.py: libfast_safetensors.so is compiled from
+fast_safetensors.cpp on first use with the system g++ (plain C ABI, no
+pybind11) and cached next to the source; any failure degrades to None and
+io/safetensors_io.py keeps its pure-Python path, which is the behavioral
+reference. The native reader mmaps the file and hands back zero-copy numpy
+windows into the blob; the writer streams tensors straight to disk without
+concatenating the blob in memory.
+
+Set MFT_NO_NATIVE_ST=1 to force the Python path (used by parity tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fast_safetensors.cpp")
+_LIB = os.path.join(_HERE, "libfast_safetensors.so")
+_lock = threading.Lock()
+_lib_cache: list = []
+
+
+def _build() -> bool:
+    tmp = f"{_LIB}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    if os.environ.get("MFT_NO_NATIVE_ST") == "1":
+        return None
+    with _lock:
+        if _lib_cache:
+            return _lib_cache[0]
+        lib = None
+        try:
+            stale = (not os.path.exists(_LIB)
+                     or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+            if not stale or _build():
+                lib = ctypes.CDLL(_LIB)
+                c = ctypes
+                lib.st_open.restype = c.c_void_p
+                lib.st_open.argtypes = [c.c_char_p]
+                lib.st_error.restype = c.c_char_p
+                lib.st_error.argtypes = [c.c_void_p]
+                lib.st_count.restype = c.c_int32
+                lib.st_count.argtypes = [c.c_void_p]
+                lib.st_key.restype = c.c_char_p
+                lib.st_key.argtypes = [c.c_void_p, c.c_int32]
+                lib.st_info.restype = c.c_int32
+                lib.st_info.argtypes = [
+                    c.c_void_p, c.c_char_p, c.c_char_p,
+                    c.POINTER(c.c_int32), c.POINTER(c.c_int64),
+                    c.POINTER(c.c_uint64), c.POINTER(c.c_uint64)]
+                lib.st_blob.restype = c.POINTER(c.c_uint8)
+                lib.st_blob.argtypes = [c.c_void_p]
+                lib.st_meta_count.restype = c.c_int32
+                lib.st_meta_count.argtypes = [c.c_void_p]
+                lib.st_meta_key.restype = c.c_char_p
+                lib.st_meta_key.argtypes = [c.c_void_p, c.c_int32]
+                lib.st_meta_val.restype = c.c_char_p
+                lib.st_meta_val.argtypes = [c.c_void_p, c.c_int32]
+                lib.st_close.argtypes = [c.c_void_p]
+                lib.stw_create.restype = c.c_void_p
+                lib.stw_create.argtypes = [c.c_char_p]
+                lib.stw_error.restype = c.c_char_p
+                lib.stw_error.argtypes = [c.c_void_p]
+                lib.stw_meta.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p]
+                lib.stw_declare.restype = c.c_int32
+                lib.stw_declare.argtypes = [
+                    c.c_void_p, c.c_char_p, c.c_char_p,
+                    c.POINTER(c.c_int64), c.c_int32, c.c_uint64]
+                lib.stw_data.restype = c.c_int32
+                lib.stw_data.argtypes = [c.c_void_p, c.c_void_p, c.c_uint64]
+                lib.stw_finish.restype = c.c_int32
+                lib.stw_finish.argtypes = [c.c_void_p]
+                lib.stw_destroy.argtypes = [c.c_void_p]
+        except Exception:
+            lib = None
+        _lib_cache.append(lib)
+        return lib
+
+
+class NativeReader:
+    """Parsed header + mmap'd blob. raw(name) returns a ZERO-COPY numpy
+    byte window into the mmap (valid until close)."""
+
+    def __init__(self, path: str):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native safetensors library unavailable")
+        self._lib = lib
+        self._h = lib.st_open(path.encode())
+        if not self._h:
+            raise MemoryError("st_open returned null")
+        err = lib.st_error(self._h)
+        if err:
+            msg = err.decode()
+            lib.st_close(self._h)
+            self._h = None
+            if msg == "cannot open file":
+                # same exception type as the Python backend's open()
+                raise FileNotFoundError(f"{path}: {msg}")
+            raise ValueError(f"{path}: {msg}")
+        self.entries: Dict[str, dict] = {}
+        dt = ctypes.create_string_buffer(8)
+        ndim = ctypes.c_int32()
+        shape = (ctypes.c_int64 * 8)()
+        begin = ctypes.c_uint64()
+        end = ctypes.c_uint64()
+        for i in range(lib.st_count(self._h)):
+            name = lib.st_key(self._h, i).decode()
+            rc = lib.st_info(self._h, name.encode(), dt, ctypes.byref(ndim),
+                             shape, ctypes.byref(begin), ctypes.byref(end))
+            if rc != 0:
+                raise ValueError(f"{path}: bad entry {name!r} (rc={rc})")
+            self.entries[name] = {
+                "dtype": dt.value.decode(),
+                "shape": list(shape[:ndim.value]),
+                "data_offsets": [begin.value, end.value]}
+        self.metadata: Dict[str, str] = {}
+        for i in range(lib.st_meta_count(self._h)):
+            self.metadata[lib.st_meta_key(self._h, i).decode()] = \
+                lib.st_meta_val(self._h, i).decode()
+
+    def raw(self, name: str) -> np.ndarray:
+        """uint8 view of the tensor's bytes, zero-copy from the mmap."""
+        begin, end = self.entries[name]["data_offsets"]
+        base = self._lib.st_blob(self._h)
+        if not base:
+            raise ValueError("no blob mapped")
+        buf = (ctypes.c_uint8 * (end - begin)).from_address(
+            ctypes.addressof(base.contents) + begin)
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        arr.flags.writeable = False
+        return arr
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.st_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def native_write(path: str, tensors: List[Tuple[str, str, tuple, bytes]],
+                 metadata: Optional[Dict[str, str]] = None) -> None:
+    """Write a safetensors file natively. tensors: [(name, tag, shape,
+    raw_bytes), ...] in final order. Raises on any writer error."""
+    lib = load_library()
+    if lib is None:
+        raise RuntimeError("native safetensors library unavailable")
+    h = lib.stw_create(path.encode())
+    try:
+        if metadata:
+            for k, v in metadata.items():
+                lib.stw_meta(h, str(k).encode(), str(v).encode())
+        for name, tag, shape, raw in tensors:
+            sh = (ctypes.c_int64 * max(len(shape), 1))(*shape)
+            if lib.stw_declare(h, name.encode(), tag.encode(), sh,
+                               len(shape), len(raw)) != 0:
+                raise IOError(lib.stw_error(h).decode())
+        for name, tag, shape, raw in tensors:
+            if lib.stw_data(h, raw, len(raw)) != 0:
+                raise IOError(lib.stw_error(h).decode())
+        if lib.stw_finish(h) != 0:
+            err = lib.stw_error(h)
+            raise IOError(err.decode() if err else "writer finish failed")
+    finally:
+        lib.stw_destroy(h)

@@ -1,0 +1,198 @@
+"""Native C++ safetensors engine parity tests (native/fast_safetensors).
+
+The pure-Python implementation in io/safetensors_io.py is the behavioral
+reference (itself HF-oracle-tested in tests/test_* I/O suites); the native
+mmap reader and streamed writer must be indistinguishable from it:
+identical entries/metadata/arrays both ways, including BF16, escapes in
+names/metadata, zero-size tensors, and malformed-file rejection.
+Skips cleanly when the toolchain can't build the library.
+"""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from mobilefinetuner_tpu.io import safetensors_io as st
+from mobilefinetuner_tpu.native import fast_safetensors as nst
+
+
+def native_available():
+    return (os.environ.get("MFT_NO_NATIVE_ST") != "1"
+            and nst.load_library() is not None)
+
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native safetensors lib unavailable")
+
+
+def sample_tensors():
+    rng = np.random.default_rng(0)
+    return {
+        "wte": rng.normal(size=(17, 8)).astype(np.float32),
+        "blocks.0.qkv_w": rng.normal(size=(8, 24)).astype(np.float32),
+        "ids": rng.integers(-5, 5, (3, 2)).astype(np.int64),
+        "flags": np.array([True, False, True]),
+        "half": rng.normal(size=(4,)).astype(np.float16),
+        "empty": np.zeros((0, 4), np.float32),
+        "weird \"name\"\t\\x": rng.normal(size=(2,)).astype(np.float32),
+    }
+
+
+def python_write(path, tensors, metadata=None, bf16_keys=None):
+    os.environ["MFT_NO_NATIVE_ST"] = "1"
+    try:
+        st.save_safetensors(path, tensors, metadata, bf16_keys)
+    finally:
+        del os.environ["MFT_NO_NATIVE_ST"]
+
+
+def python_read_all(path):
+    os.environ["MFT_NO_NATIVE_ST"] = "1"
+    try:
+        r = st.SafeTensorsReader(path)
+        return r.entries, r.metadata, r.load_all()
+    finally:
+        del os.environ["MFT_NO_NATIVE_ST"]
+
+
+META = {"format": "pt", "lora_rank": "8", "esc\"key": "va\\lue\n2"}
+
+
+def test_native_reader_matches_python_reader(tmp_path):
+    p = str(tmp_path / "t.safetensors")
+    python_write(p, sample_tensors(), META, bf16_keys={"wte"})
+    entries_py, meta_py, arrays_py = python_read_all(p)
+    r = st.SafeTensorsReader(p)
+    assert r._native is not None, "native backend not engaged"
+    assert r.metadata == meta_py
+    assert list(r.entries.keys()) == list(entries_py.keys())
+    for k in entries_py:
+        assert r.entries[k]["dtype"] == entries_py[k]["dtype"]
+        assert list(r.entries[k]["shape"]) == list(entries_py[k]["shape"])
+        a, b = r.load(k), arrays_py[k]
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+
+
+def test_native_writer_matches_python_writer(tmp_path):
+    tensors = sample_tensors()
+    p_nat = str(tmp_path / "nat.safetensors")
+    p_py = str(tmp_path / "py.safetensors")
+    st.save_safetensors(p_nat, tensors, META, bf16_keys={"wte"})
+    python_write(p_py, tensors, META, bf16_keys={"wte"})
+    _, meta_a, arrays_a = python_read_all(p_nat)
+    _, meta_b, arrays_b = python_read_all(p_py)
+    assert meta_a == meta_b
+    assert list(arrays_a.keys()) == list(arrays_b.keys())
+    for k in arrays_a:
+        np.testing.assert_array_equal(arrays_a[k], arrays_b[k])
+
+
+def test_native_writer_output_loads_in_hf_safetensors(tmp_path):
+    """Oracle check: the native writer's file must parse in the official
+    safetensors package (HF interchange is the whole point)."""
+    safetensors = pytest.importorskip("safetensors.numpy")
+    tensors = {k: v for k, v in sample_tensors().items()
+               if "\"" not in k}  # HF forbids nothing, but keep it plain
+    p = str(tmp_path / "hf.safetensors")
+    st.save_safetensors(p, tensors, {"format": "pt"})
+    loaded = safetensors.load_file(p)
+    for k, v in tensors.items():
+        np.testing.assert_array_equal(loaded[k], v)
+
+
+def test_native_reader_reads_hf_safetensors(tmp_path):
+    safetensors = pytest.importorskip("safetensors.numpy")
+    rng = np.random.default_rng(3)
+    tensors = {"a": rng.normal(size=(5, 3)).astype(np.float32),
+               "b": rng.integers(0, 9, (4,)).astype(np.int32)}
+    p = str(tmp_path / "hf_in.safetensors")
+    safetensors.save_file(tensors, p, metadata={"src": "hf"})
+    r = st.SafeTensorsReader(p)
+    assert r._native is not None
+    assert r.metadata == {"src": "hf"}
+    for k, v in tensors.items():
+        np.testing.assert_array_equal(r.load(k), v)
+
+
+def test_unicode_escape_in_header(tmp_path):
+    """\\u-escaped names (incl. a surrogate pair) must decode to the same
+    UTF-8 the Python json module produces."""
+    name = "emb/é€\U0001F600"
+    arr = np.arange(4, dtype=np.float32)
+    header = {name: {"dtype": "F32", "shape": [4],
+                     "data_offsets": [0, 16]}}
+    hjson = json.dumps(header).encode()  # ensure_ascii=True -> \u escapes
+    assert b"\\u" in hjson
+    hjson += b" " * (-len(hjson) % 8)
+    p = str(tmp_path / "esc.safetensors")
+    with open(p, "wb") as f:
+        f.write(struct.pack("<Q", len(hjson)))
+        f.write(hjson)
+        f.write(arr.tobytes())
+    r = st.SafeTensorsReader(p)
+    assert r._native is not None
+    assert list(r.entries.keys()) == [name]
+    np.testing.assert_array_equal(r.load(name), arr)
+
+
+@pytest.mark.parametrize("corrupt", ["short", "bad_json", "bad_offsets",
+                                     "huge_header"])
+def test_malformed_files_rejected(tmp_path, corrupt):
+    p = str(tmp_path / "bad.safetensors")
+    if corrupt == "short":
+        data = b"\x01\x02"
+    elif corrupt == "bad_json":
+        h = b'{"a": [broken'
+        data = struct.pack("<Q", len(h)) + h
+    elif corrupt == "bad_offsets":
+        h = json.dumps({"a": {"dtype": "F32", "shape": [4],
+                              "data_offsets": [0, 999]}}).encode()
+        data = struct.pack("<Q", len(h)) + h + b"\x00" * 16
+    else:  # huge_header
+        data = struct.pack("<Q", 1 << 40) + b"{}"
+    with open(p, "wb") as f:
+        f.write(data)
+    with pytest.raises((ValueError, Exception)):
+        st.SafeTensorsReader(p)
+
+
+def test_missing_file_raises_filenotfound(tmp_path):
+    """Exception-type parity with the Python backend: a missing path must
+    raise FileNotFoundError regardless of which backend is active."""
+    missing = str(tmp_path / "nope.safetensors")
+    with pytest.raises(FileNotFoundError):
+        st.SafeTensorsReader(missing)
+    os.environ["MFT_NO_NATIVE_ST"] = "1"
+    try:
+        with pytest.raises(FileNotFoundError):
+            st.SafeTensorsReader(missing)
+    finally:
+        del os.environ["MFT_NO_NATIVE_ST"]
+
+
+def test_zero_copy_raw_window(tmp_path):
+    """NativeReader.raw must be a read-only zero-copy view."""
+    p = str(tmp_path / "zc.safetensors")
+    arr = np.arange(8, dtype=np.float32)
+    python_write(p, {"a": arr})
+    r = nst.NativeReader(p)
+    w = r.raw("a")
+    assert not w.flags.writeable
+    np.testing.assert_array_equal(w.view(np.float32), arr)
+    r.close()
+
+
+def test_checkpoint_roundtrip_through_native(tmp_path):
+    """The io.checkpoints path (LoRA/full saves) keeps working end-to-end
+    with the native backend engaged."""
+    p = str(tmp_path / "rt.safetensors")
+    tensors = {"x": np.float32(np.random.default_rng(1)
+                               .normal(size=(64, 64)))}
+    st.save_safetensors(p, tensors, {"k": "v"})
+    r = st.SafeTensorsReader(p)
+    np.testing.assert_array_equal(r.load("x"), tensors["x"])
+    assert r.metadata == {"k": "v"}

@@ -1,0 +1,150 @@
+"""Text-generation CLI: KV-cached autoregressive sampling for GPT-2 and
+Gemma-3, with optional merged LoRA adapters.
+
+A capability the reference framework ships only as excluded legacy code
+(reference: legacy/transformer/kv_cache.cpp + autoregressive_ops,
+SURVEY.md §2.10 — "the active framework is training/eval only, no sampling
+loop"). Here it is a first-class surface over models/generate.py: one
+compiled program per (batch, prompt-length-bucket, max_new_tokens).
+
+Usage:
+  python -m mobilefinetuner_tpu.cli.generate \
+      --pretrained_dir /path/gpt2 --prompt "The meaning of life is" \
+      [--prompt ...] [--lora_path adapter.safetensors] \
+      [--max_new_tokens 64] [--greedy | --temperature 0.8 --top_k 50 \
+       --top_p 0.95] [--seed 0] [--dtype bfloat16] [--json]
+
+Model family is auto-detected from config.json (model_type / presence of
+Gemma fields); --model forces it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json as json_mod
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mobilefinetuner_tpu.core.logging import get_logger
+from mobilefinetuner_tpu.models.generate import (SampleConfig, gemma3_generate,
+                                                 gpt2_generate, left_pad)
+
+log = get_logger()
+
+
+def detect_model_type(model_dir: str) -> str:
+    cfg = os.path.join(model_dir, "config.json")
+    try:
+        with open(cfg, encoding="utf-8") as f:
+            d = json_mod.load(f)
+    except OSError:
+        raise SystemExit(f"no config.json under {model_dir}")
+    mt = str(d.get("model_type", "")).lower()
+    if "gemma" in mt or "text_config" in d:
+        return "gemma3"
+    return "gpt2"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "generate", description="KV-cached sampling (GPT-2 / Gemma-3)")
+    p.add_argument("--pretrained_dir", required=True)
+    p.add_argument("--model", choices=["auto", "gpt2", "gemma3"],
+                   default="auto")
+    p.add_argument("--prompt", action="append", default=[],
+                   help="repeatable; one generation per prompt")
+    p.add_argument("--prompt_file", default="",
+                   help="one prompt per line (adds to --prompt)")
+    p.add_argument("--lora_path", default="",
+                   help="adapter safetensors; merged into the base weights")
+    p.add_argument("--max_new_tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--top_k", type=int, default=0)
+    p.add_argument("--top_p", type=float, default=1.0)
+    p.add_argument("--greedy", action="store_true")
+    p.add_argument("--no_eos_stop", action="store_true",
+                   help="keep sampling past the eos token")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--dtype", choices=["float32", "bfloat16"],
+                   default="float32")
+    p.add_argument("--json", action="store_true", dest="json_out",
+                   help="emit one JSON object per prompt on stdout")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    prompts = list(args.prompt)
+    if args.prompt_file:
+        with open(args.prompt_file, encoding="utf-8") as f:
+            prompts += [ln.rstrip("\n") for ln in f if ln.strip()]
+    if not prompts:
+        raise SystemExit("no prompts (--prompt / --prompt_file)")
+    model_type = (detect_model_type(args.pretrained_dir)
+                  if args.model == "auto" else args.model)
+    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" \
+        else jnp.float32
+
+    if model_type == "gpt2":
+        from mobilefinetuner_tpu.data.tokenizer_bpe import GPT2BPETokenizer
+        from mobilefinetuner_tpu.io.checkpoints import load_gpt2
+        from mobilefinetuner_tpu.lora.lora import merge_gpt2
+        config, params = load_gpt2(args.pretrained_dir)
+        tok = GPT2BPETokenizer.from_pretrained(args.pretrained_dir)
+        merge = merge_gpt2
+        gen = gpt2_generate
+        encode = tok.encode
+    else:
+        from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
+        from mobilefinetuner_tpu.io.checkpoints import load_gemma3
+        from mobilefinetuner_tpu.lora.lora import merge_gemma3
+        config, params = load_gemma3(args.pretrained_dir)
+        tok = GemmaTokenizer.from_pretrained(args.pretrained_dir)
+        merge = merge_gemma3
+        gen = gemma3_generate
+        encode = tok.encode  # add_bos default True (HF parity)
+
+    if args.lora_path:
+        from mobilefinetuner_tpu.lora import peft_io
+        lora_tree, spec = peft_io.load_adapter(args.lora_path)
+        params = merge(params, lora_tree)
+        log.info(f"merged adapter {args.lora_path} (r={spec.rank})")
+
+    ids, mask = left_pad([encode(p) for p in prompts], tok.pad_id)
+    cfg = SampleConfig(
+        max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+        greedy=args.greedy,
+        eos_id=None if args.no_eos_stop else tok.eos_id,
+        pad_id=tok.pad_id)
+    rng = jax.random.PRNGKey(args.seed)
+
+    t0 = time.time()
+    out = np.asarray(gen(config, params, jnp.asarray(ids),
+                         jnp.asarray(mask), cfg, rng,
+                         compute_dtype=compute_dtype))
+    dt = time.time() - t0
+    n_tok = int(out.size)
+    log.info(f"{n_tok} tokens in {dt:.2f}s "
+             f"({n_tok / max(dt, 1e-9):.1f} tok/s incl. compile)")
+
+    for i, prompt in enumerate(prompts):
+        row = out[i].tolist()
+        if cfg.eos_id is not None and cfg.eos_id in row:
+            row = row[:row.index(cfg.eos_id) + 1]
+        text = tok.decode(row)
+        if args.json_out:
+            print(json_mod.dumps({"prompt": prompt, "ids": row,
+                                  "text": text}))
+        else:
+            print(f"=== {prompt!r}\n{text}\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,10 +93,12 @@ from mobilefinetuner_tpu.models.lora_apply import maybe_lora
 
 def _block(c: Gemma3TextConfig, bp, x, padding_mask, masks, ropes,
            is_global, lora_b, i, lora_dropout=0.0, dropout_rng=None,
-           cp_mesh=None, cp_axis="fsdp"):
+           cp_mesh=None, cp_axis="fsdp", collect_kv: bool = False):
     """One Gemma-3 block; bp leaves are THIS layer's weights (sliced out of
     the [L, ...] stacks by the scan body); i (traced scalar) indexes the
-    still-stacked LoRA leaves, RoPE tables, and masks."""
+    still-stacked LoRA leaves, RoPE tables, and masks. collect_kv: also
+    return this layer's post-norm post-RoPE (k, v) head tensors
+    [B, Hkv, S, D] (KV-cache prefill, models/generate.py)."""
     eps = c.rms_norm_eps
     B, S, H = x.shape
     nq, nkv, D = (c.num_attention_heads, c.num_key_value_heads, c.head_dim)
@@ -124,6 +126,7 @@ def _block(c: Gemma3TextConfig, bp, x, padding_mask, masks, ropes,
     sin = jnp.where(is_global[i], ropes["sin_g"], ropes["sin_l"])
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
+    kv_out = (k, v) if collect_kv else None
     scale = c.query_pre_attn_scalar ** -0.5
     impl = c.attention_impl
     if impl == "auto":
@@ -179,6 +182,8 @@ def _block(c: Gemma3TextConfig, bp, x, padding_mask, masks, ropes,
     act = gelu_tanh(gate) * up
     down = lora(act @ bp["mlp"]["down_w"], act, "down_proj", 6)
     down = rms_norm(down, bp["post_ffn_ln"], eps)
+    if collect_kv:
+        return x + down, kv_out
     return x + down
 
 
@@ -187,7 +192,7 @@ def hidden_states(config: Gemma3TextConfig, params, input_ids,
                   compute_dtype=jnp.float32, remat: bool = False,
                   lora_dropout: float = 0.0, dropout_rng=None,
                   offload=None, block_stream=None,
-                  collect_layers: bool = False,
+                  collect_layers: bool = False, collect_kv: bool = False,
                   cp_mesh=None, cp_axis: str = "fsdp"):
     """offload: optional (plan, shardings) pair matching `params`; offloaded
     block weights stream host->HBM per layer inside the scan (forces remat
@@ -232,17 +237,20 @@ def hidden_states(config: Gemma3TextConfig, params, input_ids,
     embed_out = x
 
     def body(x, i):
-        x2 = _block(c, slice_layer(i), x, attention_mask, masks, ropes,
-                    is_global, lora_b, i, lora_dropout, dropout_rng,
-                    cp_mesh, cp_axis)
-        return x2, (x2 if collect_layers else None)
+        r = _block(c, slice_layer(i), x, attention_mask, masks, ropes,
+                   is_global, lora_b, i, lora_dropout, dropout_rng,
+                   cp_mesh, cp_axis, collect_kv=collect_kv)
+        x2, kv = r if collect_kv else (r, None)
+        return x2, (kv if collect_kv else (x2 if collect_layers else None))
     if remat or stream is not None:
         body = jax.checkpoint(body)
-    x, layer_acts = jax.lax.scan(body, x, jnp.arange(c.num_hidden_layers))
+    x, extras = jax.lax.scan(body, x, jnp.arange(c.num_hidden_layers))
     x = rms_norm(x, params["final_norm"].astype(compute_dtype),
                  c.rms_norm_eps)
+    if collect_kv:
+        return x, extras  # ([L,B,Hkv,S,D] k, [L,B,Hkv,S,D] v)
     if collect_layers:
-        return x, {"embed": embed_out, "layers": layer_acts}
+        return x, {"embed": embed_out, "layers": extras}
     return x
 
 

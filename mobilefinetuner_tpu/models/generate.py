@@ -1,0 +1,326 @@
+"""Autoregressive generation with a KV cache, for GPT-2 and Gemma-3.
+
+The reference framework is training/eval-only: its only KV-cache and
+sampling code sits in the excluded legacy tree (reference:
+legacy/transformer/kv_cache.cpp + autoregressive_ops, catalogued "orphan"
+in SURVEY.md §2.10). This module supplies that missing capability
+TPU-natively:
+
+  * prefill = ONE full-sequence forward (the models' scan path, MXU-sized
+    matmuls) that also returns every layer's K/V (`collect_kv=True`);
+  * decode = a `lax.scan` over token steps; each step runs all layers via
+    an inner scan over the stacked [L, ...] weights, updating the cache
+    with `dynamic_update_slice` — static shapes throughout, one compiled
+    program for the whole generation;
+  * prompts are LEFT-padded to a common length so every cache write lands
+    at the same column; positions/RoPE phases are mask-derived per sample,
+    matching the models' HF-aligned padded-batch semantics.
+
+LoRA: merge adapters into the base weights first (lora.merge_gpt2 /
+merge_gemma3) — generation reads plain params.
+
+Sampling: greedy, temperature, top-k, nucleus (top-p), composable; eos
+stops a row (further slots fill with pad_id) and `lax.while_loop`-free
+full-length scan keeps shapes static.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from mobilefinetuner_tpu.core.config import GPT2Config, Gemma3TextConfig
+from mobilefinetuner_tpu.models import gemma3, gpt2
+from mobilefinetuner_tpu.ops.rope import apply_rope, rope_cos_sin
+
+NEG_INF = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class SampleConfig:
+    max_new_tokens: int = 32
+    temperature: float = 1.0     # <= 0 or greedy=True -> argmax
+    top_k: int = 0               # 0 = off
+    top_p: float = 1.0           # 1.0 = off
+    greedy: bool = False
+    eos_id: Optional[int] = None
+    pad_id: int = 0
+
+
+def _filter_logits(logits, cfg: SampleConfig):
+    """Apply top-k then top-p filtering (HF order) to [B, V] logits."""
+    V = logits.shape[-1]
+    if cfg.top_k and cfg.top_k < V:
+        kth = jnp.sort(logits, axis=-1)[:, V - cfg.top_k][:, None]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    if cfg.top_p < 1.0:
+        sort_idx = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens whose cumulative mass (exclusive) is < top_p; the
+        # first token is always kept
+        keep_sorted = (cum - probs) < cfg.top_p
+        keep = jnp.zeros_like(keep_sorted).at[
+            jnp.arange(logits.shape[0])[:, None], sort_idx].set(keep_sorted)
+        logits = jnp.where(keep, logits, NEG_INF)
+    return logits
+
+
+def _sample(logits, key, cfg: SampleConfig):
+    """[B, V] logits -> [B] token ids."""
+    if cfg.greedy or cfg.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = _filter_logits(logits / cfg.temperature, cfg)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def _advance(tok_raw, done, cfg: SampleConfig):
+    """eos bookkeeping: emit pad for finished rows, mark rows that just
+    emitted eos as finished AFTER emitting it."""
+    tok = jnp.where(done, jnp.int32(cfg.pad_id), tok_raw)
+    if cfg.eos_id is not None:
+        done = done | (tok_raw == cfg.eos_id)
+    return tok, done
+
+
+def _col_positions(attention_mask, P, T):
+    """Per-sample position ids of every cache column [B, T]: prompt columns
+    use mask-derived positions (HF convention), generated column P+j has
+    position n_real + j."""
+    n_real = attention_mask.sum(-1).astype(jnp.int32)            # [B]
+    prompt_pos = jnp.clip(
+        jnp.cumsum(attention_mask.astype(jnp.int32), axis=-1) - 1, 0)
+    gen_pos = n_real[:, None] + jnp.arange(T - P, dtype=jnp.int32)[None, :]
+    return jnp.concatenate([prompt_pos, gen_pos], axis=-1)
+
+
+def _col_valid(attention_mask, P, T, t):
+    """[B, T] bool: which cache columns are attendable at decode step t
+    (prompt columns per the mask; generated columns 0..t)."""
+    cols = jnp.arange(T)
+    gen_ok = cols[None, :] <= P + t
+    prompt = jnp.pad(attention_mask.astype(bool),
+                     ((0, 0), (0, T - P)), constant_values=True)
+    return prompt & gen_ok
+
+
+# ----------------------------------------------------------- GPT-2 ----------
+
+def gpt2_generate(config: GPT2Config, params, input_ids, attention_mask,
+                  cfg: SampleConfig, rng: Optional[jax.Array] = None,
+                  compute_dtype=jnp.float32):
+    """Generate [B, max_new_tokens] ids from LEFT-padded prompts [B, P].
+
+    One jittable program: full-forward prefill (collect_kv) + scanned
+    single-token decode over a [L, B, H, P+N, D] cache.
+    """
+    B, P = input_ids.shape
+    N = cfg.max_new_tokens
+    T = P + N
+    if T > config.n_positions:
+        # learned absolute positions: an out-of-range wpe gather would
+        # silently clamp to the last row and quietly degrade sampling
+        raise ValueError(
+            f"prompt ({P}) + max_new_tokens ({N}) = {T} exceeds "
+            f"n_positions={config.n_positions}")
+    E, H, D = config.n_embd, config.n_head, config.head_dim
+    L = config.n_layer
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    params = jax.tree.map(jnp.asarray, params)
+
+    x, (pk, pv) = gpt2.hidden_states(
+        config, params, input_ids, attention_mask,
+        compute_dtype=compute_dtype, collect_kv=True)
+    logits0 = x[:, -1] @ params["wte"].astype(compute_dtype).T  # [B, V]
+
+    pad_kv = lambda t: jnp.pad(t, ((0, 0), (0, 0), (0, 0), (0, N), (0, 0)))
+    kc, vc = pad_kv(pk), pad_kv(pv)                  # [L, B, H, T, D]
+
+    n_real = attention_mask.sum(-1).astype(jnp.int32)
+    wb = params["blocks"]
+    eps = config.layer_norm_epsilon
+    cast = lambda t: (t.astype(compute_dtype)
+                      if jnp.issubdtype(t.dtype, jnp.floating) else t)
+    wb = jax.tree.map(cast, wb)
+
+    def decode_step(carry, step_rng_t):
+        tok, done, kc, vc = carry
+        t, key = step_rng_t
+        pos = n_real + t                                        # [B]
+        x = params["wte"][tok].astype(compute_dtype) \
+            + params["wpe"][pos].astype(compute_dtype)          # [B, E]
+        valid = _col_valid(attention_mask, P, T, t)             # [B, T]
+
+        def layer(x, inp):
+            bp, kc_l, vc_l = inp                  # kc_l: [B, H, T, D]
+            h = gpt2.layer_norm(x, bp["ln_1"]["g"], bp["ln_1"]["b"], eps)
+            qkv = h @ bp["attn"]["qkv_w"] + bp["attn"]["qkv_b"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            hd = lambda z: z.reshape(B, H, D)
+            q, k, v = hd(q), hd(k), hd(v)
+            kc_l = jax.lax.dynamic_update_slice(
+                kc_l, k[:, :, None, :].astype(kc_l.dtype), (0, 0, P + t, 0))
+            vc_l = jax.lax.dynamic_update_slice(
+                vc_l, v[:, :, None, :].astype(vc_l.dtype), (0, 0, P + t, 0))
+            s = jnp.einsum("bhd,bhtd->bht", q.astype(jnp.float32),
+                           kc_l.astype(jnp.float32)) / (D ** 0.5)
+            s = jnp.where(valid[:, None, :], s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bht,bhtd->bhd", p,
+                             vc_l.astype(jnp.float32))
+            ctx = ctx.reshape(B, E).astype(compute_dtype)
+            proj = ctx @ bp["attn"]["proj_w"] + bp["attn"]["proj_b"]
+            x = x + proj
+            h2 = gpt2.layer_norm(x, bp["ln_2"]["g"], bp["ln_2"]["b"], eps)
+            fc = gpt2.gelu_new(h2 @ bp["mlp"]["fc_w"] + bp["mlp"]["fc_b"])
+            out = fc @ bp["mlp"]["proj_w"] + bp["mlp"]["proj_b"]
+            return x + out, (kc_l, vc_l)
+
+        x, (kc, vc) = jax.lax.scan(layer, x, (wb, kc, vc))
+        x = gpt2.layer_norm(x, params["ln_f"]["g"].astype(compute_dtype),
+                            params["ln_f"]["b"].astype(compute_dtype), eps)
+        logits = x @ params["wte"].astype(compute_dtype).T
+        nxt_raw = _sample(logits.astype(jnp.float32), key, cfg)
+        nxt, done = _advance(nxt_raw, done, cfg)
+        return (nxt, done, kc, vc), tok
+
+    all_keys = jax.random.split(rng, N + 1)
+    tok0_raw = _sample(logits0.astype(jnp.float32), all_keys[N], cfg)
+    tok0, done0 = _advance(tok0_raw, jnp.zeros((B,), bool), cfg)
+    # N-1 decode steps: step t consumes token t and samples token t+1, so
+    # the final token comes out of the carry — no trailing all-layers
+    # forward whose sample would be discarded
+    steps = jnp.arange(N - 1, dtype=jnp.int32)
+    keys = all_keys[:N - 1]
+    (tok_last, _, _, _), toks = jax.lax.scan(
+        decode_step, (tok0, done0, kc, vc), (steps, keys))
+    toks = jnp.concatenate([toks, tok_last[None]], axis=0)
+    return toks.T                                              # [B, N]
+
+
+# ---------------------------------------------------------- Gemma-3 ---------
+
+def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
+                    attention_mask, cfg: SampleConfig,
+                    rng: Optional[jax.Array] = None,
+                    compute_dtype=jnp.float32):
+    """Gemma-3 generation: GQA cache [L, B, Hkv, T, D], per-layer
+    global/local RoPE + sliding-window validity over POSITION ids."""
+    c = config
+    B, P = input_ids.shape
+    N = cfg.max_new_tokens
+    T = P + N
+    nq, nkv, D = c.num_attention_heads, c.num_key_value_heads, c.head_dim
+    G = nq // nkv
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    params = jax.tree.map(jnp.asarray, params)
+
+    x, (pk, pv) = gemma3.hidden_states(
+        c, params, input_ids, attention_mask,
+        compute_dtype=compute_dtype, collect_kv=True)
+    logits0 = x[:, -1] @ params["embed"].astype(compute_dtype).T
+
+    pad_kv = lambda t: jnp.pad(t, ((0, 0), (0, 0), (0, 0), (0, N), (0, 0)))
+    kc, vc = pad_kv(pk), pad_kv(pv)
+
+    n_real = attention_mask.sum(-1).astype(jnp.int32)
+    col_pos = _col_positions(attention_mask, P, T)              # [B, T]
+    is_global = jnp.asarray([c.is_global_layer(i)
+                             for i in range(c.num_hidden_layers)])
+    eps = c.rms_norm_eps
+    scale = c.query_pre_attn_scalar ** -0.5
+    wb = params["blocks"]
+    cast = lambda t: (t.astype(compute_dtype)
+                      if jnp.issubdtype(t.dtype, jnp.floating) else t)
+    wb = jax.tree.map(cast, wb)
+    normalizer = jnp.asarray(c.hidden_size ** 0.5, compute_dtype)
+
+    def decode_step(carry, step_rng_t):
+        tok, done, kc, vc = carry
+        t, key = step_rng_t
+        pos = n_real + t                                        # [B]
+        x = params["embed"][tok].astype(compute_dtype) * normalizer
+        cos_g, sin_g = rope_cos_sin(pos[:, None], D, c.rope_theta)
+        cos_l, sin_l = rope_cos_sin(pos[:, None], D, c.rope_local_base_freq)
+        valid = _col_valid(attention_mask, P, T, t)             # [B, T]
+        # sliding-window validity uses POSITION ids (mask-derived), same
+        # phases as the padded-batch training forward
+        win_ok = (pos[:, None] - col_pos) < c.sliding_window    # [B, T]
+
+        def layer(x, inp):
+            bp, kc_l, vc_l, glob = inp
+            a = bp["attn"]
+            h = gemma3.rms_norm(x, bp["input_ln"], eps)
+            q = (h @ a["q_w"]).reshape(B, nq, D)
+            k = (h @ a["k_w"]).reshape(B, nkv, D)
+            v = (h @ a["v_w"]).reshape(B, nkv, D)
+            q = gemma3.rms_norm(q, a["q_norm"], eps)
+            k = gemma3.rms_norm(k, a["k_norm"], eps)
+            cos = jnp.where(glob, cos_g, cos_l)
+            sin = jnp.where(glob, sin_g, sin_l)
+            # apply_rope expects [..., S, D]; insert S=1
+            q = apply_rope(q[:, :, None, :], cos, sin)[:, :, 0]
+            k = apply_rope(k[:, :, None, :], cos, sin)[:, :, 0]
+            kc_l = jax.lax.dynamic_update_slice(
+                kc_l, k[:, :, None, :].astype(kc_l.dtype), (0, 0, P + t, 0))
+            vc_l = jax.lax.dynamic_update_slice(
+                vc_l, v[:, :, None, :].astype(vc_l.dtype), (0, 0, P + t, 0))
+            qg = q.reshape(B, nkv, G, D).astype(jnp.float32)
+            s = jnp.einsum("bkgd,bktd->bkgt", qg,
+                           kc_l.astype(jnp.float32)) * scale
+            ok = jnp.where(glob, valid, valid & win_ok)         # [B, T]
+            s = jnp.where(ok[:, None, None, :], s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bkgt,bktd->bkgd", p,
+                             vc_l.astype(jnp.float32))
+            ctx = ctx.reshape(B, nq * D).astype(compute_dtype)
+            attn_out = ctx @ a["o_w"]
+            attn_out = gemma3.rms_norm(attn_out, bp["post_attn_ln"], eps)
+            x = x + attn_out
+            h2 = gemma3.rms_norm(x, bp["pre_ffn_ln"], eps)
+            act = gemma3.gelu_tanh(h2 @ bp["mlp"]["gate_w"]) \
+                * (h2 @ bp["mlp"]["up_w"])
+            down = act @ bp["mlp"]["down_w"]
+            down = gemma3.rms_norm(down, bp["post_ffn_ln"], eps)
+            return x + down, (kc_l, vc_l)
+
+        x, (kc, vc) = jax.lax.scan(layer, x, (wb, kc, vc, is_global))
+        x = gemma3.rms_norm(x, params["final_norm"].astype(compute_dtype),
+                            eps)
+        logits = x @ params["embed"].astype(compute_dtype).T
+        nxt_raw = _sample(logits.astype(jnp.float32), key, cfg)
+        nxt, done = _advance(nxt_raw, done, cfg)
+        return (nxt, done, kc, vc), tok
+
+    all_keys = jax.random.split(rng, N + 1)
+    tok0_raw = _sample(logits0.astype(jnp.float32), all_keys[N], cfg)
+    tok0, done0 = _advance(tok0_raw, jnp.zeros((B,), bool), cfg)
+    # N-1 decode steps: step t consumes token t and samples token t+1, so
+    # the final token comes out of the carry — no trailing all-layers
+    # forward whose sample would be discarded
+    steps = jnp.arange(N - 1, dtype=jnp.int32)
+    keys = all_keys[:N - 1]
+    (tok_last, _, _, _), toks = jax.lax.scan(
+        decode_step, (tok0, done0, kc, vc), (steps, keys))
+    toks = jnp.concatenate([toks, tok_last[None]], axis=0)
+    return toks.T
+
+
+def left_pad(seqs, pad_id: int):
+    """[[ids...], ...] -> (input_ids [B, P], attention_mask [B, P]) with
+    LEFT padding (generation convention; cache writes share one column)."""
+    import numpy as np
+    P = max(len(s) for s in seqs)
+    B = len(seqs)
+    ids = np.full((B, P), pad_id, np.int32)
+    mask = np.zeros((B, P), np.int32)
+    for i, s in enumerate(seqs):
+        if len(s):
+            ids[i, P - len(s):] = s
+            mask[i, P - len(s):] = 1
+    return ids, mask

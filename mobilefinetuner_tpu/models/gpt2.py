@@ -85,12 +85,14 @@ def init_params(config: GPT2Config, key: jax.Array,
 
 def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx,
            lora_dropout=0.0, dropout_rng=None, cp_mesh=None,
-           cp_axis="fsdp"):
+           cp_axis="fsdp", collect_kv: bool = False):
     """One pre-LN transformer block. bp leaves are THIS layer's weights
     (already sliced out of the [L, ...] stacks by the scan body); layer_idx
     (traced scalar) indexes the still-stacked LoRA leaves and salts
     dropout keys. cp_mesh: sequence-parallel mode — attention runs as
-    ring attention over the mesh axis (parallel/ring_attention.py)."""
+    ring attention over the mesh axis (parallel/ring_attention.py).
+    collect_kv: also return this layer's (k, v) head tensors [B, H, S, D]
+    (KV-cache prefill, models/generate.py)."""
     eps = config.layer_norm_epsilon
     H, D = config.n_head, config.head_dim
     B, S, E = x.shape
@@ -116,6 +118,7 @@ def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx,
                 qkv = qkv.at[sl].set(lora(qkv[sl], h, name, 4 + slot))
     q, k, v = jnp.split(qkv, 3, axis=-1)
     to_heads = lambda t: t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+    kv_out = (to_heads(k), to_heads(v)) if collect_kv else None
     attn_rng = (None if rng is None or config.attn_pdrop <= 0.0
                 else jax.random.fold_in(rng, 9))
     if cp_mesh is not None:
@@ -145,6 +148,8 @@ def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx,
     out = lora(out, act, "mlp_fc_out", 3)
     out = _dropout(out, config.resid_pdrop,
                    None if rng is None else jax.random.fold_in(rng, 8))
+    if collect_kv:
+        return x + out, kv_out
     return x + out
 
 
@@ -153,7 +158,7 @@ def hidden_states(config: GPT2Config, params, input_ids,
                   compute_dtype=jnp.float32, remat: bool = False,
                   lora_dropout: float = 0.0, dropout_rng=None,
                   offload=None, block_stream=None,
-                  collect_layers: bool = False,
+                  collect_layers: bool = False, collect_kv: bool = False,
                   cp_mesh=None, cp_axis: str = "fsdp"):
     """Final-LN hidden states [B, S, E] (pre lm_head).
 
@@ -197,17 +202,21 @@ def hidden_states(config: GPT2Config, params, input_ids,
     embed_out = x
 
     def body(x, i):
-        x2 = _block(config, slice_layer(i), x, padding_mask, lora_b, i,
-                    lora_dropout, dropout_rng, cp_mesh, cp_axis)
-        return x2, (x2 if collect_layers else None)
+        r = _block(config, slice_layer(i), x, padding_mask, lora_b, i,
+                   lora_dropout, dropout_rng, cp_mesh, cp_axis,
+                   collect_kv=collect_kv)
+        x2, kv = r if collect_kv else (r, None)
+        return x2, (kv if collect_kv else (x2 if collect_layers else None))
     if remat or stream is not None:
         body = jax.checkpoint(body)
-    x, layer_acts = jax.lax.scan(body, x, jnp.arange(config.n_layer))
+    x, extras = jax.lax.scan(body, x, jnp.arange(config.n_layer))
     x = layer_norm(x, params["ln_f"]["g"].astype(compute_dtype),
                    params["ln_f"]["b"].astype(compute_dtype),
                    config.layer_norm_epsilon)
+    if collect_kv:
+        return x, extras  # ([L,B,H,S,D] k, [L,B,H,S,D] v)
     if collect_layers:
-        return x, {"embed": embed_out, "layers": layer_acts}
+        return x, {"embed": embed_out, "layers": extras}
     return x
 
 

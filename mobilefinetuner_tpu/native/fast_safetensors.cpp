@@ -216,7 +216,6 @@ struct Reader {
   size_t file_size = 0;
   uint64_t blob_off = 0;            // 8 + header_len
   std::vector<TensorEntry> tensors;
-  std::map<std::string, size_t> index;
   std::vector<std::pair<std::string, std::string>> metadata;
   std::string error;
 };
@@ -288,7 +287,6 @@ void* st_open(const char* path) {
     t.end = uint64_t(off->arr[1].num);
     if (t.begin > t.end || t.end > blob_size)
       return reader_fail(r, "tensor offsets out of range");
-    r->index[t.name] = r->tensors.size();
     r->tensors.push_back(std::move(t));
   }
   return r;
@@ -303,20 +301,25 @@ int32_t st_count(void* h) {
   return int32_t(static_cast<Reader*>(h)->tensors.size());
 }
 
-const char* st_key(void* h, int32_t i) {
+// Length-aware: JSON strings may legally contain NUL bytes, which a
+// NUL-terminated char* cannot represent. Returns the byte pointer and
+// writes the exact length.
+const char* st_key_n(void* h, int32_t i, int32_t* len) {
   Reader* r = static_cast<Reader*>(h);
   if (i < 0 || size_t(i) >= r->tensors.size()) return nullptr;
-  return r->tensors[i].name.c_str();
+  *len = int32_t(r->tensors[i].name.size());
+  return r->tensors[i].name.data();
 }
 
 // Fills dtype tag (cap>=8 incl. NUL), ndim, shape (cap 8) and the blob
-// window [begin, end). Returns 0, or -1 if the name is unknown.
-int32_t st_info(void* h, const char* name, char* dtype_out, int32_t* ndim,
-                int64_t* shape_out, uint64_t* begin, uint64_t* end) {
+// window [begin, end) for tensor index i (the Python wrapper iterates by
+// index, so names never cross the FFI as NUL-terminated strings).
+// Returns 0, or -1 for a bad index, -2 for ndim > 8.
+int32_t st_info_at(void* h, int32_t i, char* dtype_out, int32_t* ndim,
+                   int64_t* shape_out, uint64_t* begin, uint64_t* end) {
   Reader* r = static_cast<Reader*>(h);
-  auto it = r->index.find(name);
-  if (it == r->index.end()) return -1;
-  const TensorEntry& t = r->tensors[it->second];
+  if (i < 0 || size_t(i) >= r->tensors.size()) return -1;
+  const TensorEntry& t = r->tensors[i];
   if (t.shape.size() > 8) return -2;  // caller's shape buffer is 8 slots
   snprintf(dtype_out, 8, "%s", t.dtype.c_str());
   *ndim = int32_t(t.shape.size());
@@ -337,16 +340,18 @@ int32_t st_meta_count(void* h) {
   return int32_t(static_cast<Reader*>(h)->metadata.size());
 }
 
-const char* st_meta_key(void* h, int32_t i) {
+const char* st_meta_key_n(void* h, int32_t i, int32_t* len) {
   Reader* r = static_cast<Reader*>(h);
   if (i < 0 || size_t(i) >= r->metadata.size()) return nullptr;
-  return r->metadata[i].first.c_str();
+  *len = int32_t(r->metadata[i].first.size());
+  return r->metadata[i].first.data();
 }
 
-const char* st_meta_val(void* h, int32_t i) {
+const char* st_meta_val_n(void* h, int32_t i, int32_t* len) {
   Reader* r = static_cast<Reader*>(h);
   if (i < 0 || size_t(i) >= r->metadata.size()) return nullptr;
-  return r->metadata[i].second.c_str();
+  *len = int32_t(r->metadata[i].second.size());
+  return r->metadata[i].second.data();
 }
 
 void st_close(void* h) {
@@ -414,19 +419,23 @@ const char* stw_error(void* h) {
   return w->error.empty() ? nullptr : w->error.c_str();
 }
 
-void stw_meta(void* h, const char* key, const char* val) {
-  static_cast<Writer*>(h)->metadata.emplace_back(key, val);
+// Length-aware (names/values may contain NUL bytes, which JSON escapes).
+void stw_meta(void* h, const char* key, int32_t key_len, const char* val,
+              int32_t val_len) {
+  static_cast<Writer*>(h)->metadata.emplace_back(
+      std::string(key, size_t(key_len)), std::string(val, size_t(val_len)));
 }
 
-int32_t stw_declare(void* h, const char* name, const char* dtype,
-                    const int64_t* shape, int32_t ndim, uint64_t nbytes) {
+int32_t stw_declare(void* h, const char* name, int32_t name_len,
+                    const char* dtype, const int64_t* shape, int32_t ndim,
+                    uint64_t nbytes) {
   Writer* w = static_cast<Writer*>(h);
   if (w->header_written) {
     w->error = "declare after header written";
     return -1;
   }
   PendingTensor t;
-  t.name = name;
+  t.name.assign(name, size_t(name_len));
   t.dtype = dtype;
   t.shape.assign(shape, shape + ndim);
   t.nbytes = nbytes;

@@ -62,30 +62,36 @@ def load_library() -> Optional[ctypes.CDLL]:
                 lib.st_error.argtypes = [c.c_void_p]
                 lib.st_count.restype = c.c_int32
                 lib.st_count.argtypes = [c.c_void_p]
-                lib.st_key.restype = c.c_char_p
-                lib.st_key.argtypes = [c.c_void_p, c.c_int32]
-                lib.st_info.restype = c.c_int32
-                lib.st_info.argtypes = [
-                    c.c_void_p, c.c_char_p, c.c_char_p,
+                # *_n functions return raw byte pointers + explicit length
+                # (NOT c_char_p: names/metadata may contain NUL bytes)
+                lib.st_key_n.restype = c.c_void_p
+                lib.st_key_n.argtypes = [c.c_void_p, c.c_int32,
+                                         c.POINTER(c.c_int32)]
+                lib.st_info_at.restype = c.c_int32
+                lib.st_info_at.argtypes = [
+                    c.c_void_p, c.c_int32, c.c_char_p,
                     c.POINTER(c.c_int32), c.POINTER(c.c_int64),
                     c.POINTER(c.c_uint64), c.POINTER(c.c_uint64)]
                 lib.st_blob.restype = c.POINTER(c.c_uint8)
                 lib.st_blob.argtypes = [c.c_void_p]
                 lib.st_meta_count.restype = c.c_int32
                 lib.st_meta_count.argtypes = [c.c_void_p]
-                lib.st_meta_key.restype = c.c_char_p
-                lib.st_meta_key.argtypes = [c.c_void_p, c.c_int32]
-                lib.st_meta_val.restype = c.c_char_p
-                lib.st_meta_val.argtypes = [c.c_void_p, c.c_int32]
+                lib.st_meta_key_n.restype = c.c_void_p
+                lib.st_meta_key_n.argtypes = [c.c_void_p, c.c_int32,
+                                              c.POINTER(c.c_int32)]
+                lib.st_meta_val_n.restype = c.c_void_p
+                lib.st_meta_val_n.argtypes = [c.c_void_p, c.c_int32,
+                                              c.POINTER(c.c_int32)]
                 lib.st_close.argtypes = [c.c_void_p]
                 lib.stw_create.restype = c.c_void_p
                 lib.stw_create.argtypes = [c.c_char_p]
                 lib.stw_error.restype = c.c_char_p
                 lib.stw_error.argtypes = [c.c_void_p]
-                lib.stw_meta.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p]
+                lib.stw_meta.argtypes = [c.c_void_p, c.c_char_p, c.c_int32,
+                                         c.c_char_p, c.c_int32]
                 lib.stw_declare.restype = c.c_int32
                 lib.stw_declare.argtypes = [
-                    c.c_void_p, c.c_char_p, c.c_char_p,
+                    c.c_void_p, c.c_char_p, c.c_int32, c.c_char_p,
                     c.POINTER(c.c_int64), c.c_int32, c.c_uint64]
                 lib.stw_data.restype = c.c_int32
                 lib.stw_data.argtypes = [c.c_void_p, c.c_void_p, c.c_uint64]
@@ -125,10 +131,15 @@ class NativeReader:
         shape = (ctypes.c_int64 * 8)()
         begin = ctypes.c_uint64()
         end = ctypes.c_uint64()
+        slen = ctypes.c_int32()
+
+        def s(ptr):  # exact-length string (names may contain NUL bytes)
+            return ctypes.string_at(ptr, slen.value).decode()
+
         for i in range(lib.st_count(self._h)):
-            name = lib.st_key(self._h, i).decode()
-            rc = lib.st_info(self._h, name.encode(), dt, ctypes.byref(ndim),
-                             shape, ctypes.byref(begin), ctypes.byref(end))
+            name = s(lib.st_key_n(self._h, i, ctypes.byref(slen)))
+            rc = lib.st_info_at(self._h, i, dt, ctypes.byref(ndim),
+                                shape, ctypes.byref(begin), ctypes.byref(end))
             if rc != 0:
                 raise ValueError(f"{path}: bad entry {name!r} (rc={rc})")
             self.entries[name] = {
@@ -137,8 +148,9 @@ class NativeReader:
                 "data_offsets": [begin.value, end.value]}
         self.metadata: Dict[str, str] = {}
         for i in range(lib.st_meta_count(self._h)):
-            self.metadata[lib.st_meta_key(self._h, i).decode()] = \
-                lib.st_meta_val(self._h, i).decode()
+            k = s(lib.st_meta_key_n(self._h, i, ctypes.byref(slen)))
+            self.metadata[k] = s(
+                lib.st_meta_val_n(self._h, i, ctypes.byref(slen)))
 
     def raw(self, name: str) -> np.ndarray:
         """uint8 view of the tensor's bytes, zero-copy from the mmap."""
@@ -175,10 +187,12 @@ def native_write(path: str, tensors: List[Tuple[str, str, tuple, bytes]],
     try:
         if metadata:
             for k, v in metadata.items():
-                lib.stw_meta(h, str(k).encode(), str(v).encode())
+                kb, vb = str(k).encode(), str(v).encode()
+                lib.stw_meta(h, kb, len(kb), vb, len(vb))
         for name, tag, shape, raw in tensors:
             sh = (ctypes.c_int64 * max(len(shape), 1))(*shape)
-            if lib.stw_declare(h, name.encode(), tag.encode(), sh,
+            nb = name.encode()
+            if lib.stw_declare(h, nb, len(nb), tag.encode(), sh,
                                len(shape), len(raw)) != 0:
                 raise IOError(lib.stw_error(h).decode())
         for name, tag, shape, raw in tensors:

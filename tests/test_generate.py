@@ -1,0 +1,208 @@
+"""KV-cached generation tests (models/generate.py, cli/generate.py).
+
+The correctness anchor: a greedy KV-cached rollout must match the naive
+no-cache rollout (full forward re-run per emitted token, argmax) token for
+token — for both model families, including ragged left-padded batches
+(per-sample mask-derived positions), Gemma's sliding-window/global layer
+mix, eos early-stop, and merged-LoRA weights. The reference has no active
+generation path to anchor to (SURVEY.md §2.10: KV cache only in excluded
+legacy code), so the no-cache rollout IS the oracle.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mobilefinetuner_tpu.core.config import GPT2Config, Gemma3TextConfig
+from mobilefinetuner_tpu.models import gemma3, gpt2
+from mobilefinetuner_tpu.models.generate import (SampleConfig,
+                                                 gemma3_generate,
+                                                 gpt2_generate, left_pad)
+
+GPT2_CFG = dataclasses.replace(
+    GPT2Config.tiny(vocab_size=211), n_embd=64, n_head=4, n_positions=64,
+    n_layer=3, embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0)
+# 4 layers: local, local, global, local (sliding_window < prompt+gen so the
+# window actually truncates attention)
+GEMMA_CFG = dataclasses.replace(
+    Gemma3TextConfig.tiny(vocab_size=199), hidden_size=48, head_dim=12,
+    num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+    num_hidden_layers=4, sliding_window=6, sliding_window_pattern=3)
+
+
+@pytest.fixture(scope="module")
+def gpt2_params():
+    return gpt2.init_params(GPT2_CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def gemma_params():
+    return gemma3.init_params(GEMMA_CFG, jax.random.PRNGKey(1))
+
+
+def naive_rollout(fwd, ids, mask, n_new):
+    """Oracle: re-run the full forward for every emitted token (no cache),
+    greedy argmax, appending to the right of the left-padded batch."""
+    ids = np.asarray(ids).copy()
+    mask = np.asarray(mask).copy()
+    out = []
+    for _ in range(n_new):
+        logits = np.asarray(fwd(jnp.asarray(ids), jnp.asarray(mask)))
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)
+        out.append(nxt)
+        ids = np.concatenate([ids, nxt[:, None]], axis=1)
+        mask = np.concatenate(
+            [mask, np.ones((ids.shape[0], 1), mask.dtype)], axis=1)
+    return np.stack(out, axis=1)  # [B, n_new]
+
+
+def test_gpt2_greedy_matches_naive_rollout(gpt2_params):
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, 200, n)) for n in (5, 9, 2)]
+    ids, mask = left_pad(prompts, pad_id=0)
+    n_new = 8
+    cfg = SampleConfig(max_new_tokens=n_new, greedy=True, eos_id=None)
+
+    def fwd(i, m):
+        return gpt2.forward(GPT2_CFG, gpt2_params, i, attention_mask=m)
+
+    want = naive_rollout(fwd, ids, mask, n_new)
+    got = np.asarray(gpt2_generate(GPT2_CFG, gpt2_params,
+                                   jnp.asarray(ids), jnp.asarray(mask),
+                                   cfg))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_gemma3_greedy_matches_naive_rollout(gemma_params):
+    rng = np.random.default_rng(1)
+    prompts = [list(rng.integers(3, 190, n)) for n in (7, 3, 11)]
+    ids, mask = left_pad(prompts, pad_id=0)
+    n_new = 9  # > sliding_window - prompt overlap: the window engages
+    cfg = SampleConfig(max_new_tokens=n_new, greedy=True, eos_id=None)
+
+    def fwd(i, m):
+        return gemma3.forward(GEMMA_CFG, gemma_params, i, attention_mask=m)
+
+    want = naive_rollout(fwd, ids, mask, n_new)
+    got = np.asarray(gemma3_generate(GEMMA_CFG, gemma_params,
+                                     jnp.asarray(ids), jnp.asarray(mask),
+                                     cfg))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_gpt2_generate_is_jittable(gpt2_params):
+    ids, mask = left_pad([[1, 2, 3], [4, 5, 6, 7]], pad_id=0)
+    cfg = SampleConfig(max_new_tokens=4, greedy=True)
+    fn = jax.jit(lambda i, m: gpt2_generate(GPT2_CFG, gpt2_params, i, m,
+                                            cfg))
+    out = np.asarray(fn(jnp.asarray(ids), jnp.asarray(mask)))
+    assert out.shape == (2, 4)
+    assert (out >= 0).all() and (out < GPT2_CFG.vocab_size).all()
+
+
+def test_eos_stops_row(gpt2_params):
+    """Declare the first greedily-emitted token to BE eos: the row must
+    then emit exactly that token and pad out the rest."""
+    ids, mask = left_pad([[1, 2, 3]], pad_id=0)
+    free = SampleConfig(max_new_tokens=5, greedy=True, eos_id=None)
+    rollout = np.asarray(gpt2_generate(GPT2_CFG, gpt2_params,
+                                       jnp.asarray(ids), jnp.asarray(mask),
+                                       free))
+    eos = int(rollout[0, 0])
+    pad = (eos + 1) % GPT2_CFG.vocab_size
+    cfg = SampleConfig(max_new_tokens=5, greedy=True, eos_id=eos,
+                       pad_id=pad)
+    out = np.asarray(gpt2_generate(GPT2_CFG, gpt2_params, jnp.asarray(ids),
+                                   jnp.asarray(mask), cfg))
+    assert out[0, 0] == eos
+    assert (out[0, 1:] == pad).all()
+
+
+def test_gpt2_rejects_overlong_generation(gpt2_params):
+    """prompt + max_new_tokens beyond n_positions must fail loudly (a
+    clamped wpe gather would silently degrade output)."""
+    ids, mask = left_pad([list(range(1, 61))], pad_id=0)  # P=60
+    cfg = SampleConfig(max_new_tokens=10, greedy=True)    # 70 > 64
+    with pytest.raises(ValueError, match="n_positions"):
+        gpt2_generate(GPT2_CFG, gpt2_params, jnp.asarray(ids),
+                      jnp.asarray(mask), cfg)
+
+
+def test_single_token_generation(gpt2_params):
+    """max_new_tokens=1: the token comes straight from prefill (the decode
+    scan runs zero steps)."""
+    ids, mask = left_pad([[1, 2, 3], [4, 5, 6]], pad_id=0)
+    cfg = SampleConfig(max_new_tokens=1, greedy=True, eos_id=None)
+
+    def fwd(i, m):
+        return gpt2.forward(GPT2_CFG, gpt2_params, i, attention_mask=m)
+
+    want = naive_rollout(fwd, ids, mask, 1)
+    got = np.asarray(gpt2_generate(GPT2_CFG, gpt2_params, jnp.asarray(ids),
+                                   jnp.asarray(mask), cfg))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sampling_is_seeded_and_in_range(gpt2_params):
+    ids, mask = left_pad([[1, 2, 3, 4]], pad_id=0)
+    cfg = SampleConfig(max_new_tokens=6, temperature=0.9, top_k=20,
+                      top_p=0.9, eos_id=None)
+    a = np.asarray(gpt2_generate(GPT2_CFG, gpt2_params, jnp.asarray(ids),
+                                 jnp.asarray(mask), cfg,
+                                 rng=jax.random.PRNGKey(3)))
+    b = np.asarray(gpt2_generate(GPT2_CFG, gpt2_params, jnp.asarray(ids),
+                                 jnp.asarray(mask), cfg,
+                                 rng=jax.random.PRNGKey(3)))
+    c = np.asarray(gpt2_generate(GPT2_CFG, gpt2_params, jnp.asarray(ids),
+                                 jnp.asarray(mask), cfg,
+                                 rng=jax.random.PRNGKey(4)))
+    np.testing.assert_array_equal(a, b)
+    assert (a >= 0).all() and (a < GPT2_CFG.vocab_size).all()
+    assert not np.array_equal(a, c) or a.size < 4  # seeds differ
+
+
+def test_lora_merged_generation_differs_and_runs(gpt2_params):
+    from mobilefinetuner_tpu.lora.lora import (LoRASpec, init_lora_gpt2,
+                                               merge_gpt2)
+    spec = LoRASpec(rank=2, alpha=16.0)
+    lora = init_lora_gpt2(GPT2_CFG, spec, jax.random.PRNGKey(9))
+    # push B away from zero so the adapter actually changes logits
+    lora = jax.tree.map(
+        lambda x: x + 0.05 if x.ndim and x.shape[-1] else x, lora)
+    merged = merge_gpt2(gpt2_params, lora)
+    ids, mask = left_pad([[1, 2, 3, 4, 5]], pad_id=0)
+    cfg = SampleConfig(max_new_tokens=6, greedy=True, eos_id=None)
+    base = np.asarray(gpt2_generate(GPT2_CFG, gpt2_params,
+                                    jnp.asarray(ids), jnp.asarray(mask),
+                                    cfg))
+    tuned = np.asarray(gpt2_generate(GPT2_CFG, merged, jnp.asarray(ids),
+                                     jnp.asarray(mask), cfg))
+    assert base.shape == tuned.shape == (1, 6)
+    assert not np.array_equal(base, tuned)
+
+
+def test_generate_cli_end_to_end(tmp_path):
+    """Drive the CLI against a tiny on-disk GPT-2 checkpoint."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__)))
+    from fixtures import write_tiny_gpt2_dir
+    d = str(tmp_path / "model")
+    os.makedirs(d)
+    write_tiny_gpt2_dir(d)
+    from mobilefinetuner_tpu.cli.generate import main
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main(["--pretrained_dir", d, "--prompt", "hello world",
+                   "--max_new_tokens", "4", "--greedy", "--json"])
+    assert rc == 0
+    import json
+    lines = [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+    rec = json.loads(lines[-1])
+    assert rec["prompt"] == "hello world"
+    assert len(rec["ids"]) <= 4 and isinstance(rec["text"], str)

@@ -160,6 +160,24 @@ def test_malformed_files_rejected(tmp_path, corrupt):
         st.SafeTensorsReader(p)
 
 
+def test_nul_bytes_in_names_and_metadata_roundtrip(tmp_path):
+    """JSON strings may contain \\u0000; both backends must round-trip
+    them identically (the FFI is length-aware, not NUL-terminated)."""
+    name = "a\x00b"
+    meta = {"note": "x\x00y"}
+    arr = np.arange(3, dtype=np.float32)
+    p_py = str(tmp_path / "py.safetensors")
+    p_nat = str(tmp_path / "nat.safetensors")
+    python_write(p_py, {name: arr}, meta)
+    st.save_safetensors(p_nat, {name: arr}, meta)  # native writer
+    for p in (p_py, p_nat):
+        r = st.SafeTensorsReader(p)           # native reader
+        assert r._native is not None
+        assert list(r.entries.keys()) == [name]
+        assert r.metadata == meta
+        np.testing.assert_array_equal(r.load(name), arr)
+
+
 def test_missing_file_raises_filenotfound(tmp_path):
     """Exception-type parity with the Python backend: a missing path must
     raise FileNotFoundError regardless of which backend is active."""

@@ -1,19 +1,24 @@
-"""MMLU evaluation CLI.
+"""MMLU evaluation CLI, GPT-2 and Gemma-3.
 
 TPU-native rebuild of the reference `eval_mmlu` binary
 (reference: gpt2_lora_finetune/eval_mmlu.cpp + mmlu/mmlu_runner.{h,cpp}):
-load GPT-2 (+ optional merged adapter), evaluate 4-choice accuracy with
-k-shot prompts, report per-subject + macro/micro.
+load a model (+ optional adapter, merged or dynamic), evaluate 4-choice
+accuracy with k-shot prompts, report per-subject + macro/micro. The
+reference binary is GPT-2-only; like eval_ppl, this CLI auto-detects the
+family from config.json so the Gemma track has the same eval story.
 
 Variable-length prompts vs XLA's static shapes: prompts are right-padded to
 power-of-two length buckets, so the whole eval compiles a handful of
 programs instead of one per length. The last REAL token's logits are
-selected by index (padding never shifts the prediction).
+selected by index (padding never shifts the prediction), and only that one
+position is projected through the lm_head — materializing [1, S, V] logits
+would cost ~1 MB/token on Gemma's 262k vocab for values that are discarded.
 
 Usage:
   python -m mobilefinetuner_tpu.cli.eval_mmlu \
-      --pretrained_dir /path/gpt2 --mmlu_root /path/mmlu --split test \
-      [--fewshot 5] [--lora_path adapter.safetensors --lora_merge]
+      --pretrained_dir /path/gpt2-or-gemma --mmlu_root /path/mmlu \
+      --split test [--fewshot 5] [--lora_path adapter.safetensors \
+      --lora_merge]
 """
 
 from __future__ import annotations
@@ -26,13 +31,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mobilefinetuner_tpu.cli.eval_ppl import detect_family
 from mobilefinetuner_tpu.core.logging import JSONLWriter, get_logger
-from mobilefinetuner_tpu.data.tokenizer_bpe import GPT2BPETokenizer
 from mobilefinetuner_tpu.eval import mmlu
-from mobilefinetuner_tpu.io.checkpoints import load_gpt2
 from mobilefinetuner_tpu.lora import peft_io
-from mobilefinetuner_tpu.lora.lora import merge_gpt2
-from mobilefinetuner_tpu.models import gpt2
 
 log = get_logger()
 
@@ -41,6 +43,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="eval_mmlu", description="MMLU 4-choice accuracy (TPU)")
     p.add_argument("--pretrained_dir", required=True)
+    p.add_argument("--family", choices=["auto", "gpt2", "gemma"],
+                   default="auto")
     p.add_argument("--mmlu_root", required=True,
                    help="dir containing <split>/ with per-subject CSVs")
     p.add_argument("--split", default="test")
@@ -55,22 +59,87 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def make_logits_fn(config, params, lora, compute_dtype):
+def setup_family(args):
+    """(hidden_fn, head_key, compute_dtype, tok, letter_encode, max_len,
+    params, lora): family dispatch. hidden_fn(params, lora, ids) ->
+    [1, S, E] final-norm hidden states; params[head_key] is the (tied)
+    lm_head weight [V, E]; letter_encode is the BOS-free encoder for the
+    A-D letter-id lookup (None = use tok.encode as-is)."""
+    family = (detect_family(args.pretrained_dir) if args.family == "auto"
+              else args.family)
+    log.info(f"model family: {family}")
+    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" \
+        else jnp.float32
+    if family == "gemma":
+        from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
+        from mobilefinetuner_tpu.io.checkpoints import load_gemma3
+        from mobilefinetuner_tpu.lora.lora import merge_gemma3
+        from mobilefinetuner_tpu.models import gemma3
+        config, params = load_gemma3(args.pretrained_dir)
+        tok = GemmaTokenizer.from_pretrained(args.pretrained_dir)
+        # letter-id lookup must not see the auto-BOS (eval/mmlu.py)
+        letter_encode = lambda s: tok.encode(s, add_bos=False)
+        merge = merge_gemma3
+
+        def hidden_fn(params, lora, ids):
+            return gemma3.hidden_states(config, params, ids, lora=lora,
+                                        compute_dtype=compute_dtype)
+
+        head_key = "embed"
+        # prompts are bucketed; cap at 4096 (far above MMLU prompt sizes,
+        # far below the 32k max — a 32k zero-pad bucket would be waste)
+        max_len = min(config.max_position_embeddings, 4096)
+    else:
+        from mobilefinetuner_tpu.data.tokenizer_bpe import GPT2BPETokenizer
+        from mobilefinetuner_tpu.io.checkpoints import load_gpt2
+        from mobilefinetuner_tpu.lora.lora import merge_gpt2
+        from mobilefinetuner_tpu.models import gpt2
+        config, params = load_gpt2(args.pretrained_dir)
+        tok = GPT2BPETokenizer.from_pretrained(args.pretrained_dir)
+        letter_encode = None  # GPT-2 encode adds no sequence-start token
+        merge = merge_gpt2
+
+        def hidden_fn(params, lora, ids):
+            return gpt2.hidden_states(config, params, ids, lora=lora,
+                                      compute_dtype=compute_dtype)
+
+        head_key = "wte"
+        max_len = config.n_positions
+
+    lora = None
+    if args.lora_path:
+        lora, spec = peft_io.load_adapter(args.lora_path)
+        log.info(f"adapter: r={spec.rank} "
+                 f"({'merged' if args.lora_merge else 'dynamic'})")
+        if args.lora_merge:
+            params = merge(params, lora)
+            lora = None
+    # Commit weights to device once; numpy-backed jit args would be
+    # re-transferred per item (see eval_ppl.py).
+    params = jax.device_put(params)
+    if lora is not None:
+        lora = jax.device_put(lora)
+    return (hidden_fn, head_key, compute_dtype, tok, letter_encode,
+            max_len, params, lora)
+
+
+def make_logits_fn(hidden_fn, head_key, compute_dtype, params, lora,
+                   max_len):
     """Bucketed-length last-token logits: np [1,S] -> np [V]."""
 
     @jax.jit
     def fwd(params, lora, ids, last_idx):
-        logits = gpt2.forward(config, params, ids, lora=lora,
-                              compute_dtype=compute_dtype)
-        return logits[0, last_idx, :]
+        h = hidden_fn(params, lora, ids)            # [1, S, E]
+        head = params[head_key].astype(compute_dtype)
+        return h[0, last_idx, :] @ head.T           # [V]
 
     def logits_fn(ids: np.ndarray) -> np.ndarray:
         S = ids.shape[1]
-        if S > config.n_positions:  # keep the prompt tail
-            ids = ids[:, -config.n_positions:]
+        if S > max_len:  # keep the prompt tail
+            ids = ids[:, -max_len:]
             S = ids.shape[1]
         bucket = 1 << (S - 1).bit_length()
-        bucket = min(max(bucket, 32), config.n_positions)
+        bucket = min(max(bucket, 32), max_len)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :S] = ids[0]
         return np.asarray(fwd(params, lora, padded, jnp.int32(S - 1)))
@@ -80,32 +149,16 @@ def make_logits_fn(config, params, lora, compute_dtype):
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    config, params = load_gpt2(args.pretrained_dir)
+    (hidden_fn, head_key, compute_dtype, tok, letter_encode, max_len,
+     params, lora) = setup_family(args)
 
-    lora = None
-    if args.lora_path:
-        lora, spec = peft_io.load_adapter(args.lora_path)
-        log.info(f"adapter: r={spec.rank} "
-                 f"({'merged' if args.lora_merge else 'dynamic'})")
-        if args.lora_merge:
-            params = merge_gpt2(params, lora)
-            lora = None
-
-    # Commit weights to device once; numpy-backed jit args would be
-    # re-transferred per item (see eval_ppl.py).
-    params = jax.device_put(params)
-    if lora is not None:
-        lora = jax.device_put(lora)
-
-    tok = GPT2BPETokenizer.from_pretrained(args.pretrained_dir)
     by_subject = mmlu.load_split(args.mmlu_root, args.split)
     n_items = sum(len(v) for v in by_subject.values())
     log.info(f"MMLU {args.split}: {len(by_subject)} subjects, "
              f"{n_items} items, fewshot={args.fewshot}")
 
-    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    logits_fn = make_logits_fn(config, params, lora, compute_dtype)
-
+    logits_fn = make_logits_fn(hidden_fn, head_key, compute_dtype, params,
+                               lora, max_len)
     done = [0]
 
     def progress(subject, i, n):
@@ -115,7 +168,8 @@ def main(argv=None) -> int:
 
     result = mmlu.evaluate(by_subject, logits_fn, tok.encode,
                            fewshot_k=args.fewshot, progress_fn=progress,
-                           max_items_per_subject=args.max_items)
+                           max_items_per_subject=args.max_items,
+                           letter_encode_fn=letter_encode)
 
     report = {
         "split": args.split, "fewshot": args.fewshot,

@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import argparse
 import json as json_mod
-import os
 import sys
 import time
 
@@ -37,24 +36,15 @@ from mobilefinetuner_tpu.models.generate import (SampleConfig, gemma3_generate,
 log = get_logger()
 
 
-def detect_model_type(model_dir: str) -> str:
-    cfg = os.path.join(model_dir, "config.json")
-    try:
-        with open(cfg, encoding="utf-8") as f:
-            d = json_mod.load(f)
-    except OSError:
-        raise SystemExit(f"no config.json under {model_dir}")
-    mt = str(d.get("model_type", "")).lower()
-    if "gemma" in mt or "text_config" in d:
-        return "gemma3"
-    return "gpt2"
+# single source of truth for the config.json family sniff
+from mobilefinetuner_tpu.cli.eval_ppl import detect_family
 
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         "generate", description="KV-cached sampling (GPT-2 / Gemma-3)")
     p.add_argument("--pretrained_dir", required=True)
-    p.add_argument("--model", choices=["auto", "gpt2", "gemma3"],
+    p.add_argument("--model", choices=["auto", "gpt2", "gemma"],
                    default="auto")
     p.add_argument("--prompt", action="append", default=[],
                    help="repeatable; one generation per prompt")
@@ -85,7 +75,7 @@ def main(argv=None) -> int:
             prompts += [ln.rstrip("\n") for ln in f if ln.strip()]
     if not prompts:
         raise SystemExit("no prompts (--prompt / --prompt_file)")
-    model_type = (detect_model_type(args.pretrained_dir)
+    model_type = (detect_family(args.pretrained_dir)
                   if args.model == "auto" else args.model)
     compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" \
         else jnp.float32
@@ -115,7 +105,11 @@ def main(argv=None) -> int:
         params = merge(params, lora_tree)
         log.info(f"merged adapter {args.lora_path} (r={spec.rank})")
 
-    ids, mask = left_pad([encode(p) for p in prompts], tok.pad_id)
+    encoded = [encode(p) for p in prompts]
+    empty = [p for p, e in zip(prompts, encoded) if not e]
+    if empty:
+        raise SystemExit(f"prompt(s) encode to zero tokens: {empty!r}")
+    ids, mask = left_pad(encoded, tok.pad_id)
     cfg = SampleConfig(
         max_new_tokens=args.max_new_tokens,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,

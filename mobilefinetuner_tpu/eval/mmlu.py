@@ -206,8 +206,13 @@ def evaluate(by_subject: Dict[str, List[MCQItem]],
              encode_fn: Callable[[str], List[int]],
              fewshot_k: int = 0,
              progress_fn: Optional[Callable[[str, int, int], None]] = None,
-             max_items_per_subject: int = 0) -> MMLUResult:
-    letter_ids = letter_token_ids(encode_fn)
+             max_items_per_subject: int = 0,
+             letter_encode_fn: Optional[Callable[[str], List[int]]] = None
+             ) -> MMLUResult:
+    # letter_encode_fn: encoder WITHOUT sequence-start decoration for the
+    # A-D id lookup (a Gemma-style auto-BOS encoder would make every
+    # letter's first token the BOS id); prompts keep using encode_fn.
+    letter_ids = letter_token_ids(letter_encode_fn or encode_fn)
     reports: List[SubjectReport] = []
     total_correct = total = 0
     for subject in sorted(by_subject):

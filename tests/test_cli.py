@@ -284,3 +284,21 @@ def test_eval_mmlu_smoke(gpt2_dir, tmp_path, capsys):
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["total_items"] == 8
     assert 0.0 <= rec["macro_accuracy"] <= 1.0
+
+
+def test_eval_mmlu_gemma_smoke(gemma_dir, tmp_path, capsys):
+    """Gemma family auto-detected; letter-id lookup must not collapse to
+    the auto-BOS token (eval/mmlu.py letter_encode_fn)."""
+    from mobilefinetuner_tpu.cli.eval_mmlu import main
+    from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
+    from mobilefinetuner_tpu.eval.mmlu import LETTERS, letter_token_ids
+    tok = GemmaTokenizer.from_pretrained(gemma_dir)
+    ids = letter_token_ids(lambda s: tok.encode(s, add_bos=False))
+    assert len(set(ids)) > 1, "letter ids collapsed (BOS leak?)"
+    mmlu_root = write_tiny_mmlu_dir(str(tmp_path / "mmlu"))
+    rc = main(["--pretrained_dir", gemma_dir, "--mmlu_root", mmlu_root,
+               "--split", "test"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["total_items"] == 8
+    assert 0.0 <= rec["macro_accuracy"] <= 1.0

@@ -31,10 +31,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mobilefinetuner_tpu.cli.eval_ppl import detect_family
+from mobilefinetuner_tpu.cli.family import apply_adapter, load_family
 from mobilefinetuner_tpu.core.logging import JSONLWriter, get_logger
 from mobilefinetuner_tpu.eval import mmlu
-from mobilefinetuner_tpu.lora import peft_io
 
 log = get_logger()
 
@@ -61,65 +60,37 @@ def build_parser() -> argparse.ArgumentParser:
 
 def setup_family(args):
     """(hidden_fn, head_key, compute_dtype, tok, letter_encode, max_len,
-    params, lora): family dispatch. hidden_fn(params, lora, ids) ->
-    [1, S, E] final-norm hidden states; params[head_key] is the (tied)
-    lm_head weight [V, E]; letter_encode is the BOS-free encoder for the
-    A-D letter-id lookup (None = use tok.encode as-is)."""
-    family = (detect_family(args.pretrained_dir) if args.family == "auto"
-              else args.family)
-    log.info(f"model family: {family}")
+    params, lora): family dispatch via cli/family.py. hidden_fn(params,
+    lora, ids) -> [1, S, E] final-norm hidden states; params[head_key] is
+    the (tied) lm_head weight [V, E]; letter_encode is the BOS-free
+    encoder for the A-D letter-id lookup (None = use tok.encode as-is)."""
     compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" \
         else jnp.float32
-    if family == "gemma":
-        from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
-        from mobilefinetuner_tpu.io.checkpoints import load_gemma3
-        from mobilefinetuner_tpu.lora.lora import merge_gemma3
-        from mobilefinetuner_tpu.models import gemma3
-        config, params = load_gemma3(args.pretrained_dir)
-        tok = GemmaTokenizer.from_pretrained(args.pretrained_dir)
+    b = load_family(args.pretrained_dir,
+                    "gemma" if args.family == "gemma" else args.family)
+    lora = apply_adapter(b, args.lora_path, args.lora_merge)
+    config, model = b.config, b.model
+
+    def hidden_fn(params, lora, ids):
+        return model.hidden_states(config, params, ids, lora=lora,
+                                   compute_dtype=compute_dtype)
+
+    if b.family == "gemma":
         # letter-id lookup must not see the auto-BOS (eval/mmlu.py)
+        tok = b.tok
         letter_encode = lambda s: tok.encode(s, add_bos=False)
-        merge = merge_gemma3
-
-        def hidden_fn(params, lora, ids):
-            return gemma3.hidden_states(config, params, ids, lora=lora,
-                                        compute_dtype=compute_dtype)
-
-        head_key = "embed"
         # prompts are bucketed; cap at 4096 (far above MMLU prompt sizes,
         # far below the 32k max — a 32k zero-pad bucket would be waste)
-        max_len = min(config.max_position_embeddings, 4096)
+        max_len = min(b.max_len, 4096)
     else:
-        from mobilefinetuner_tpu.data.tokenizer_bpe import GPT2BPETokenizer
-        from mobilefinetuner_tpu.io.checkpoints import load_gpt2
-        from mobilefinetuner_tpu.lora.lora import merge_gpt2
-        from mobilefinetuner_tpu.models import gpt2
-        config, params = load_gpt2(args.pretrained_dir)
-        tok = GPT2BPETokenizer.from_pretrained(args.pretrained_dir)
         letter_encode = None  # GPT-2 encode adds no sequence-start token
-        merge = merge_gpt2
+        max_len = b.max_len
 
-        def hidden_fn(params, lora, ids):
-            return gpt2.hidden_states(config, params, ids, lora=lora,
-                                      compute_dtype=compute_dtype)
-
-        head_key = "wte"
-        max_len = config.n_positions
-
-    lora = None
-    if args.lora_path:
-        lora, spec = peft_io.load_adapter(args.lora_path)
-        log.info(f"adapter: r={spec.rank} "
-                 f"({'merged' if args.lora_merge else 'dynamic'})")
-        if args.lora_merge:
-            params = merge(params, lora)
-            lora = None
     # Commit weights to device once; numpy-backed jit args would be
     # re-transferred per item (see eval_ppl.py).
-    params = jax.device_put(params)
-    if lora is not None:
-        lora = jax.device_put(lora)
-    return (hidden_fn, head_key, compute_dtype, tok, letter_encode,
+    params = jax.device_put(b.params)
+    lora = jax.device_put(lora) if lora is not None else None
+    return (hidden_fn, b.head_key, compute_dtype, b.tok, letter_encode,
             max_len, params, lora)
 
 

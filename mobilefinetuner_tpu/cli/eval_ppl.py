@@ -24,20 +24,16 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
 
+from mobilefinetuner_tpu.cli.family import (apply_adapter, detect_family,
+                                            load_family)
 from mobilefinetuner_tpu.core.logging import JSONLWriter, get_logger
-from mobilefinetuner_tpu.data.tokenizer_bpe import GPT2BPETokenizer
 from mobilefinetuner_tpu.data.wikitext2 import WT2Config, WikiText2Dataset
-from mobilefinetuner_tpu.io.checkpoints import load_gpt2
-from mobilefinetuner_tpu.lora import peft_io
-from mobilefinetuner_tpu.lora.lora import merge_gpt2
-from mobilefinetuner_tpu.models import gpt2
 from mobilefinetuner_tpu.ops.loss import (lm_cross_entropy_sum,
                                           perplexity_from_loss)
 
@@ -72,41 +68,17 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def detect_family(model_dir: str) -> str:
-    """gpt2 vs gemma from config.json (model_type or nested text_config)."""
-    with open(os.path.join(model_dir, "config.json")) as f:
-        raw = json.load(f)
-    mt = str(raw.get("model_type", "")).lower()
-    if "gemma" in mt or "text_config" in raw:
-        return "gemma"
-    return "gpt2"
-
-
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    family = args.family
-    if family == "auto":
-        family = detect_family(args.pretrained_dir)
     compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-
-    lora = spec = None
-    if args.lora_path:
-        lora, spec = peft_io.load_adapter(args.lora_path)
-        log.info(f"adapter: r={spec.rank} alpha={spec.alpha} "
-                 f"targets={spec.targets} "
-                 f"({'merged' if args.lora_merge else 'dynamic'})")
+    b = load_family(args.pretrained_dir, args.family)
+    family = b.family
+    lora = apply_adapter(b, args.lora_path, args.lora_merge)
+    config, params, tok = b.config, b.params, b.tok
 
     if family == "gemma":
-        from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
-        from mobilefinetuner_tpu.io.checkpoints import load_gemma3
-        from mobilefinetuner_tpu.lora.lora import merge_gemma3
         from mobilefinetuner_tpu.models import gemma3
         from mobilefinetuner_tpu.ops.loss import chunked_lm_cross_entropy_sum
-        config, params = load_gemma3(args.pretrained_dir)
-        if lora is not None and args.lora_merge:
-            params = merge_gemma3(params, lora)
-            lora = None
-        tok = GemmaTokenizer.from_pretrained(args.pretrained_dir)
         encode = lambda s: tok.encode(s, add_bos=False)
         eos_id, pad_id = tok.eos_id, tok.pad_id
 
@@ -119,14 +91,8 @@ def main(argv=None) -> int:
             return chunked_lm_cross_entropy_sum(
                 hidden, params["embed"], batch["labels"],
                 num_chunks=args.loss_chunks)
-
-        max_pos = config.max_position_embeddings
     else:
-        config, params = load_gpt2(args.pretrained_dir)
-        if lora is not None and args.lora_merge:
-            params = merge_gpt2(params, lora)
-            lora = None
-        tok = GPT2BPETokenizer.from_pretrained(args.pretrained_dir)
+        from mobilefinetuner_tpu.models import gpt2
         encode, eos_id, pad_id = tok.encode, tok.eos_id, None
 
         @jax.jit
@@ -136,7 +102,7 @@ def main(argv=None) -> int:
                                   lora=lora, compute_dtype=compute_dtype)
             return lm_cross_entropy_sum(logits, batch["labels"])
 
-        max_pos = config.n_positions
+    max_pos = b.max_len
 
     # Commit the weights to the device ONCE: checkpoint loading yields
     # host numpy arrays, and leaving them as jit arguments re-transfers

@@ -1,0 +1,92 @@
+"""Shared model-family dispatch for the eval/generate CLIs.
+
+One place that knows how to go from --pretrained_dir to (config, params,
+tokenizer, merge fn, model module) for both families — eval_ppl, eval_mmlu,
+and generate all consume this instead of keeping drifting copies of the
+same load/sniff/merge block. The reference has no analog (each of its
+binaries is single-family by construction); family auto-detection reads
+the HF config.json (model_type / nested text_config).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+
+from mobilefinetuner_tpu.core.logging import get_logger
+from mobilefinetuner_tpu.lora import peft_io
+
+log = get_logger()
+
+
+def detect_family(model_dir: str) -> str:
+    """gpt2 vs gemma from config.json (model_type or nested text_config)."""
+    with open(os.path.join(model_dir, "config.json")) as f:
+        raw = json.load(f)
+    mt = str(raw.get("model_type", "")).lower()
+    if "gemma" in mt or "text_config" in raw:
+        return "gemma"
+    return "gpt2"
+
+
+@dataclasses.dataclass
+class FamilyBundle:
+    family: str              # "gpt2" | "gemma"
+    config: Any
+    params: Any              # host numpy tree (device_put is the caller's
+                             # decision — see eval_ppl's commit-once note)
+    tok: Any
+    model: Any               # models.gpt2 or models.gemma3 module
+    merge_fn: Callable       # merge_gpt2 / merge_gemma3
+    head_key: str            # tied lm_head weight key: "wte" / "embed"
+    max_len: int             # n_positions / max_position_embeddings
+
+
+def load_family(pretrained_dir: str, family: str = "auto") -> FamilyBundle:
+    if family == "auto":
+        try:
+            family = detect_family(pretrained_dir)
+        except OSError:
+            raise SystemExit(
+                f"no readable config.json under {pretrained_dir}")
+    log.info(f"model family: {family}")
+    if family == "gemma":
+        from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
+        from mobilefinetuner_tpu.io.checkpoints import load_gemma3
+        from mobilefinetuner_tpu.lora.lora import merge_gemma3
+        from mobilefinetuner_tpu.models import gemma3
+        config, params = load_gemma3(pretrained_dir)
+        return FamilyBundle(
+            family, config, params,
+            GemmaTokenizer.from_pretrained(pretrained_dir),
+            gemma3, merge_gemma3, "embed",
+            config.max_position_embeddings)
+    from mobilefinetuner_tpu.data.tokenizer_bpe import GPT2BPETokenizer
+    from mobilefinetuner_tpu.io.checkpoints import load_gpt2
+    from mobilefinetuner_tpu.lora.lora import merge_gpt2
+    from mobilefinetuner_tpu.models import gpt2
+    config, params = load_gpt2(pretrained_dir)
+    return FamilyBundle(
+        family, config, params,
+        GPT2BPETokenizer.from_pretrained(pretrained_dir),
+        gpt2, merge_gpt2, "wte", config.n_positions)
+
+
+def apply_adapter(bundle: FamilyBundle, lora_path: str,
+                  lora_merge: bool) -> Optional[Any]:
+    """Load an adapter; merged -> fold into bundle.params and return None,
+    dynamic -> return the LoRA tree for the model's lora= argument."""
+    if not lora_path:
+        return None
+    lora, spec = peft_io.load_adapter(lora_path)
+    log.info(f"adapter: r={spec.rank} alpha={spec.alpha} "
+             f"targets={spec.targets} "
+             f"({'merged' if lora_merge else 'dynamic'})")
+    if lora_merge:
+        bundle.params = bundle.merge_fn(bundle.params, lora)
+        return None
+    return lora

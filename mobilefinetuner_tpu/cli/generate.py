@@ -36,8 +36,7 @@ from mobilefinetuner_tpu.models.generate import (SampleConfig, gemma3_generate,
 log = get_logger()
 
 
-# single source of truth for the config.json family sniff
-from mobilefinetuner_tpu.cli.eval_ppl import detect_family
+from mobilefinetuner_tpu.cli.family import apply_adapter, load_family
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -75,35 +74,13 @@ def main(argv=None) -> int:
             prompts += [ln.rstrip("\n") for ln in f if ln.strip()]
     if not prompts:
         raise SystemExit("no prompts (--prompt / --prompt_file)")
-    model_type = (detect_family(args.pretrained_dir)
-                  if args.model == "auto" else args.model)
     compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" \
         else jnp.float32
-
-    if model_type == "gpt2":
-        from mobilefinetuner_tpu.data.tokenizer_bpe import GPT2BPETokenizer
-        from mobilefinetuner_tpu.io.checkpoints import load_gpt2
-        from mobilefinetuner_tpu.lora.lora import merge_gpt2
-        config, params = load_gpt2(args.pretrained_dir)
-        tok = GPT2BPETokenizer.from_pretrained(args.pretrained_dir)
-        merge = merge_gpt2
-        gen = gpt2_generate
-        encode = tok.encode
-    else:
-        from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
-        from mobilefinetuner_tpu.io.checkpoints import load_gemma3
-        from mobilefinetuner_tpu.lora.lora import merge_gemma3
-        config, params = load_gemma3(args.pretrained_dir)
-        tok = GemmaTokenizer.from_pretrained(args.pretrained_dir)
-        merge = merge_gemma3
-        gen = gemma3_generate
-        encode = tok.encode  # add_bos default True (HF parity)
-
-    if args.lora_path:
-        from mobilefinetuner_tpu.lora import peft_io
-        lora_tree, spec = peft_io.load_adapter(args.lora_path)
-        params = merge(params, lora_tree)
-        log.info(f"merged adapter {args.lora_path} (r={spec.rank})")
+    b = load_family(args.pretrained_dir, args.model)
+    gen = gpt2_generate if b.family == "gpt2" else gemma3_generate
+    tok, encode = b.tok, b.tok.encode  # Gemma: add_bos default (HF parity)
+    apply_adapter(b, args.lora_path, lora_merge=True)  # generation always
+    config, params = b.config, b.params                # reads merged base
 
     encoded = [encode(p) for p in prompts]
     empty = [p for p, e in zip(prompts, encoded) if not e]

@@ -18,7 +18,10 @@ Supported tokenizer.json mechanisms (the set Gemma uses):
 BPE uses a heap over adjacent-pair ranks (O(n log n)) instead of the naive
 quadratic rescan — the reference notes its Gemma tokenizer is slow enough to
 need offline pretokenization (SURVEY.md §2.4); ours keeps the same
-pretokenized-.bin escape hatch but is fast enough for online use.
+pretokenized-.bin escape hatch but is fast enough for online use. A native
+C++ engine (native/fast_gemma_bpe) runs the merge+lookup stage when it
+builds; this module's heap is the behavioral reference and fallback
+(MFT_NO_NATIVE_GEMMA_BPE=1 forces it — the oracle parity tests do).
 """
 
 from __future__ import annotations
@@ -162,6 +165,16 @@ class GemmaTokenizer:
         self.bos_id = _tid("<bos>", 2)
         self.unk_id = _tid("<unk>", 3)
         self.add_bos = True  # Gemma default (tokenizer_gemma.h add_bos)
+        self._native = None
+        try:
+            from mobilefinetuner_tpu.native.fast_gemma_bpe import \
+                NativeGemmaBPE
+            unk = (self.vocab[self.unk_token]
+                   if self.unk_token is not None else None)
+            self._native = NativeGemmaBPE(
+                norm_merges, self.vocab, unk, self.byte_fallback)
+        except Exception:
+            self._native = None  # pure-Python heap path below
 
     def _parse_pre_tokenizer(self, spec: Optional[dict]):
         if spec is None:
@@ -197,6 +210,8 @@ class GemmaTokenizer:
             if (scheme == "always" or (scheme == "first" and first)) \
                     and not text.startswith(rep):
                 text = rep + text
+        if self._native is not None:
+            return self._native.encode_chunk(text)
         pieces = _bpe_heap(list(text), self.ranks)
         ids: List[int] = []
         for piece in pieces:

@@ -1,0 +1,222 @@
+// Native SentencePiece-style BPE merge engine for the Gemma tokenizer.
+//
+// The runtime-native counterpart of data/tokenizer_gemma.py::_bpe_heap +
+// vocab/byte-fallback lookup (which stays as the behavioral reference and
+// automatic fallback). The reference's C++ Gemma tokenizer is slow enough
+// that it ships an offline pretokenizer (reference: core/tokenizer_gemma.cpp,
+// scripts/pretokenize_wikitext2_gemma.py; SURVEY.md §2.4) — this engine is
+// the opposite design: a heap over adjacent-pair ranks on a doubly-linked
+// symbol list (O(n log n) per chunk), loaded once, called per normalized
+// chunk.
+//
+// Exact-parity contract with the Python implementation, including heap
+// tie-breaking: entries order by (rank, left-position, left-sym, right-sym)
+// — bytewise string comparison equals Python's code-point comparison for
+// valid UTF-8.
+//
+// All strings cross the FFI length-prefixed (tokens may contain '\n', ' ',
+// or any byte): records are [u32 len][bytes] (+ [i32 id] in the vocab blob).
+//
+// Build: g++ -O2 -shared -fPIC fast_gemma_bpe.cpp -o libfast_gemma_bpe.so
+// (driven lazily by native/fast_gemma_bpe.py).
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct PairHash {
+  size_t operator()(const std::pair<std::string, std::string>& p) const {
+    std::hash<std::string> h;
+    return h(p.first) * 1000003u ^ h(p.second);
+  }
+};
+
+struct Engine {
+  std::unordered_map<std::pair<std::string, std::string>, int32_t, PairHash>
+      ranks;
+  std::unordered_map<std::string, int32_t> vocab;
+  int32_t byte_ids[256];       // <0xXX> token ids; -1 = absent
+  int32_t unk_id = -1;         // -1 = no unk (unmatched pieces dropped)
+  bool byte_fallback = false;
+};
+
+struct HeapEntry {
+  int32_t rank;
+  int32_t pos;
+  std::string a, b;
+  // min-heap via std::priority_queue (max-heap + inverted comparison);
+  // full tuple ordering mirrors Python's heapq tuples (r, i, a, b)
+  bool operator<(const HeapEntry& o) const {
+    if (rank != o.rank) return rank > o.rank;
+    if (pos != o.pos) return pos > o.pos;
+    if (a != o.a) return a > o.a;
+    return b > o.b;
+  }
+};
+
+std::vector<std::string> split_utf8(const char* s, size_t n) {
+  std::vector<std::string> out;
+  size_t i = 0;
+  while (i < n) {
+    unsigned char c = static_cast<unsigned char>(s[i]);
+    size_t len = 1;
+    if ((c & 0x80u) == 0x00u) len = 1;
+    else if ((c & 0xE0u) == 0xC0u) len = 2;
+    else if ((c & 0xF0u) == 0xE0u) len = 3;
+    else if ((c & 0xF8u) == 0xF0u) len = 4;
+    if (len > n - i) len = n - i;  // truncated tail: clamp, don't overrun
+    out.emplace_back(s + i, len);
+    i += len;
+  }
+  return out;
+}
+
+bool read_rec(const uint8_t*& p, const uint8_t* end, std::string* out) {
+  if (end - p < 4) return false;
+  uint32_t len;
+  memcpy(&len, p, 4);
+  p += 4;
+  if (uint32_t(end - p) < len) return false;
+  out->assign(reinterpret_cast<const char*>(p), len);
+  p += len;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* gbpe_create() {
+  Engine* e = new Engine();
+  for (int i = 0; i < 256; i++) e->byte_ids[i] = -1;
+  return e;
+}
+
+void gbpe_destroy(void* h) { delete static_cast<Engine*>(h); }
+
+// merges_blob: [u32 la][a][u32 lb][b]... in rank order.
+// vocab_blob:  [u32 lt][token][i32 id]...
+// Duplicate merge pairs keep their LAST rank index while still consuming a
+// slot (Python dict-comprehension semantics).
+int32_t gbpe_load(void* h, const uint8_t* merges_blob, int64_t merges_len,
+                  const uint8_t* vocab_blob, int64_t vocab_len,
+                  int32_t unk_id, int32_t byte_fallback) {
+  Engine* e = static_cast<Engine*>(h);
+  const uint8_t* p = merges_blob;
+  const uint8_t* end = merges_blob + merges_len;
+  int32_t rank = 0;
+  std::string a, b;
+  while (p < end) {
+    if (!read_rec(p, end, &a) || !read_rec(p, end, &b)) return -1;
+    e->ranks[std::make_pair(a, b)] = rank++;
+  }
+  p = vocab_blob;
+  end = vocab_blob + vocab_len;
+  std::string tok;
+  while (p < end) {
+    if (!read_rec(p, end, &tok)) return -1;
+    if (end - p < 4) return -1;
+    int32_t id;
+    memcpy(&id, p, 4);
+    p += 4;
+    e->vocab[tok] = id;
+    // register byte-fallback tokens: exactly "<0xXX>" with UPPERCASE hex
+    // (the Python reference looks up f"<0x{byte:02X}>" only — lowercase
+    // spellings must stay unregistered so both paths KeyError alike)
+    if (tok.size() == 6 && tok.compare(0, 3, "<0x") == 0 && tok[5] == '>') {
+      auto hex = [](char c) -> int {
+        if (c >= '0' && c <= '9') return c - '0';
+        if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+        return -1;
+      };
+      int hi = hex(tok[3]), lo = hex(tok[4]);
+      if (hi >= 0 && lo >= 0) e->byte_ids[hi * 16 + lo] = id;
+    }
+  }
+  e->unk_id = unk_id;
+  e->byte_fallback = byte_fallback != 0;
+  return 0;
+}
+
+// Heap-BPE one normalized chunk (utf-8, length-delimited) into token ids.
+// Returns the id count, -1 when cap is too small (caller retries), or -3
+// when byte_fallback needs a <0xXX> token the vocab lacks (the Python
+// reference raises KeyError there; the caller mirrors that).
+int32_t gbpe_encode(void* h, const char* text, int64_t text_len,
+                    int32_t* out, int32_t cap) {
+  Engine* e = static_cast<Engine*>(h);
+  std::vector<std::string> sym = split_utf8(text, size_t(text_len));
+  const int n = int(sym.size());
+  if (n == 0) return 0;
+
+  std::vector<int> nxt(n), prv(n);
+  std::vector<char> alive(n, 1);
+  for (int i = 0; i < n; i++) {
+    nxt[i] = (i + 1 < n) ? i + 1 : -1;
+    prv[i] = i - 1;
+  }
+  if (n > 1) {
+    std::priority_queue<HeapEntry> heap;
+    for (int i = 0; i + 1 < n; i++) {
+      auto it = e->ranks.find({sym[i], sym[i + 1]});
+      if (it != e->ranks.end())
+        heap.push({it->second, i, sym[i], sym[i + 1]});
+    }
+    while (!heap.empty()) {
+      HeapEntry t = heap.top();
+      heap.pop();
+      int i = t.pos;
+      if (!alive[i] || sym[i] != t.a) continue;
+      int j = nxt[i];
+      if (j == -1 || !alive[j] || sym[j] != t.b) continue;
+      sym[i] = t.a + t.b;
+      alive[j] = 0;
+      nxt[i] = nxt[j];
+      if (nxt[j] != -1) prv[nxt[j]] = i;
+      int p2 = prv[i];
+      if (p2 != -1 && alive[p2]) {
+        auto it = e->ranks.find({sym[p2], sym[i]});
+        if (it != e->ranks.end())
+          heap.push({it->second, p2, sym[p2], sym[i]});
+      }
+      int q = nxt[i];
+      if (q != -1 && alive[q]) {
+        auto it = e->ranks.find({sym[i], sym[q]});
+        if (it != e->ranks.end())
+          heap.push({it->second, i, sym[i], sym[q]});
+      }
+    }
+  }
+
+  // Emit ids by walking the surviving linked list: vocab hit, else
+  // byte-fallback (<0xXX> per utf-8 byte), else unk, else drop —
+  // tokenizer_gemma.py _encode_chunk order exactly.
+  int32_t count = 0;
+  for (int i = 0; i != -1; i = nxt[i]) {
+    if (!alive[i]) continue;
+    const std::string& piece = sym[i];
+    auto it = e->vocab.find(piece);
+    if (it != e->vocab.end()) {
+      if (count >= cap) return -1;
+      out[count++] = it->second;
+    } else if (e->byte_fallback) {
+      for (unsigned char c : piece) {
+        if (count >= cap) return -1;
+        int32_t bid = e->byte_ids[c];
+        if (bid < 0) return -3;  // Python raises KeyError here
+        out[count++] = bid;
+      }
+    } else if (e->unk_id >= 0) {
+      if (count >= cap) return -1;
+      out[count++] = e->unk_id;
+    }
+  }
+  return count;
+}
+
+}  // extern "C"

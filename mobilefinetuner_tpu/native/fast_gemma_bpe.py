@@ -1,0 +1,121 @@
+"""ctypes binding + lazy build for the native Gemma BPE merge engine.
+
+Same scheme as native/fast_bpe.py: libfast_gemma_bpe.so is compiled from
+fast_gemma_bpe.cpp on first use (plain C ABI, no pybind11) and cached next
+to the source; any failure degrades to None and data/tokenizer_gemma.py
+keeps its pure-Python heap BPE, which is the behavioral reference. Tables
+cross the FFI once, as length-prefixed blobs (Gemma vocab pieces may
+contain newlines/spaces, so no delimiter format is safe).
+
+Set MFT_NO_NATIVE_GEMMA_BPE=1 to force the Python path (parity tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fast_gemma_bpe.cpp")
+_LIB = os.path.join(_HERE, "libfast_gemma_bpe.so")
+_lock = threading.Lock()
+_lib_cache: list = []
+
+
+def _build() -> bool:
+    tmp = f"{_LIB}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    if os.environ.get("MFT_NO_NATIVE_GEMMA_BPE") == "1":
+        return None
+    with _lock:
+        if _lib_cache:
+            return _lib_cache[0]
+        lib = None
+        try:
+            stale = (not os.path.exists(_LIB)
+                     or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+            if not stale or _build():
+                lib = ctypes.CDLL(_LIB)
+                c = ctypes
+                lib.gbpe_create.restype = c.c_void_p
+                lib.gbpe_destroy.argtypes = [c.c_void_p]
+                lib.gbpe_load.restype = c.c_int32
+                lib.gbpe_load.argtypes = [
+                    c.c_void_p, c.c_char_p, c.c_int64, c.c_char_p,
+                    c.c_int64, c.c_int32, c.c_int32]
+                lib.gbpe_encode.restype = c.c_int32
+                lib.gbpe_encode.argtypes = [
+                    c.c_void_p, c.c_char_p, c.c_int64,
+                    c.POINTER(c.c_int32), c.c_int32]
+        except Exception:
+            lib = None
+        _lib_cache.append(lib)
+        return lib
+
+
+def _rec(b: bytes) -> bytes:
+    return struct.pack("<I", len(b)) + b
+
+
+class NativeGemmaBPE:
+    """One engine per tokenizer: ranks + vocab + byte-fallback table
+    loaded once; encode_chunk(normalized_text) -> ids, exactly matching
+    tokenizer_gemma._encode_chunk's BPE+lookup stage."""
+
+    def __init__(self, merges: List[Tuple[str, str]], vocab: Dict[str, int],
+                 unk_id: Optional[int], byte_fallback: bool):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native Gemma BPE library unavailable")
+        self._lib = lib
+        self._h = lib.gbpe_create()
+        mb = b"".join(_rec(a.encode()) + _rec(b.encode())
+                      for a, b in merges)
+        vb = b"".join(_rec(t.encode()) + struct.pack("<i", i)
+                      for t, i in vocab.items())
+        rc = lib.gbpe_load(self._h, mb, len(mb), vb, len(vb),
+                           -1 if unk_id is None else int(unk_id),
+                           int(bool(byte_fallback)))
+        if rc != 0:
+            raise RuntimeError(f"gbpe_load failed (rc={rc})")
+
+    def encode_chunk(self, text: str) -> List[int]:
+        raw = text.encode("utf-8")
+        # every emitted id consumes >= 1 source byte (vocab pieces and
+        # byte-fallback alike), so len(raw) always suffices; the retry
+        # loop is belt-and-braces
+        cap = max(len(raw), 1)
+        while True:
+            buf = (ctypes.c_int32 * cap)()
+            n = self._lib.gbpe_encode(self._h, raw, len(raw), buf, cap)
+            if n == -1:
+                cap *= 2
+                continue
+            if n == -3:
+                raise KeyError(
+                    "byte_fallback token missing from vocab "
+                    "(matches the Python reference's KeyError)")
+            return list(buf[:n])
+
+    def __del__(self):
+        try:
+            self._lib.gbpe_destroy(self._h)
+        except Exception:
+            pass

@@ -1,0 +1,101 @@
+"""Native Gemma BPE engine parity (native/fast_gemma_bpe).
+
+The native heap-merge engine must match the Python reference
+(data/tokenizer_gemma.py _bpe_heap + vocab/byte-fallback lookup) id-for-id
+— the Python side is itself HF-oracle-tested (test_tokenizers.py), so
+transitively the native path is HF-aligned. Reference analog:
+core/test_tokenizer_gemma.cpp parity cases.
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from tests.fixtures import WIKI_LINES, train_tiny_gemma_tokenizer
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no g++ in environment")
+
+
+def make_tok(tmp_path_factory, native: bool):
+    from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
+    d = str(tmp_path_factory.mktemp("gtok"))
+    path = os.path.join(d, "tokenizer.json")
+    train_tiny_gemma_tokenizer(path)
+    if native:
+        return GemmaTokenizer(path)
+    os.environ["MFT_NO_NATIVE_GEMMA_BPE"] = "1"
+    try:
+        return GemmaTokenizer(path)
+    finally:
+        del os.environ["MFT_NO_NATIVE_GEMMA_BPE"]
+
+
+@pytest.fixture(scope="module")
+def tok_pair(tmp_path_factory):
+    native = make_tok(tmp_path_factory, True)
+    if native._native is None:
+        pytest.skip("native Gemma BPE library failed to build")
+    python = make_tok(tmp_path_factory, False)
+    assert python._native is None
+    return native, python
+
+
+def test_native_library_builds():
+    if os.environ.get("MFT_NO_NATIVE_GEMMA_BPE") == "1":
+        pytest.skip("disabled by env")
+    from mobilefinetuner_tpu.native.fast_gemma_bpe import load_library
+    assert load_library() is not None
+
+
+def test_corpus_parity(tok_pair):
+    native, python = tok_pair
+    text = "\n".join(WIKI_LINES)
+    assert native.encode(text) == python.encode(text)
+
+
+@pytest.mark.parametrize("text", [
+    "",
+    " ",
+    "hello world",
+    "  double  spaces  ",
+    "newlines\nare\nreal\n\ntokens",
+    "unicode: émigré Σigma 中文 🙂",
+    "tabs\tand\rcarriage",
+    "<eos> special <pad> tokens <bos>",
+    "a" * 500,
+    "word " * 200,
+])
+def test_case_parity(tok_pair, text):
+    native, python = tok_pair
+    assert native.encode(text) == python.encode(text)
+    assert native.encode(text, add_bos=False) == \
+        python.encode(text, add_bos=False)
+
+
+def test_fuzz_parity(tok_pair):
+    native, python = tok_pair
+    rng = np.random.default_rng(0)
+    alphabet = list("abcdefgh ABZ.\n\t字émo🙂") + ["<eos>", "▁"]
+    for _ in range(200):
+        n = int(rng.integers(0, 40))
+        s = "".join(rng.choice(alphabet) for _ in range(n))
+        assert native.encode(s) == python.encode(s), repr(s)
+
+
+def test_byte_fallback_parity(tok_pair):
+    """Characters outside the tiny training corpus exercise the <0xXX>
+    byte-fallback path in both engines."""
+    native, python = tok_pair
+    for s in ["ß", "ß鬼🙃", "mix ß end", "\x00\x01"]:
+        assert native.encode(s) == python.encode(s), repr(s)
+
+
+def test_decode_roundtrip_unchanged(tok_pair):
+    """decode stays pure-Python; native encode must feed it identically."""
+    native, python = tok_pair
+    s = "hello ß world\nnext"
+    assert native.decode(native.encode(s)) == \
+        python.decode(python.encode(s))

@@ -109,9 +109,10 @@ def offload_setup(params, budget_bytes=0):
 
 
 def bench_gpt2_lora(B, S, dtype, accum=1, offload=False, impl="auto",
-                    steps=40):
-    config = dataclasses.replace(GPT2Config.gpt2_small(),
-                                 attention_impl=impl)
+                    steps=40, size="small"):
+    base = (GPT2Config.gpt2_medium() if size == "medium"
+            else GPT2Config.gpt2_small())
+    config = dataclasses.replace(base, attention_impl=impl)
     params = gpt2.init_params(config, jax.random.PRNGKey(0))
     spec = LoRASpec(rank=8, alpha=16.0)
     lora = init_lora_gpt2(config, spec, jax.random.PRNGKey(1))
@@ -167,8 +168,9 @@ def bench_gpt2_full(B, S, dtype, steps=40):
 
 
 def bench_gemma_lora(B, S, dtype, accum=1, offload=False, steps=20,
-                     loss_chunks=4):
-    config = Gemma3TextConfig.gemma3_270m()
+                     loss_chunks=4, size="270m"):
+    config = (Gemma3TextConfig.gemma3_1b() if size == "1b"
+              else Gemma3TextConfig.gemma3_270m())
     params = gemma3.init_params(config, jax.random.PRNGKey(0))
     spec = LoRASpec(rank=8, alpha=32.0, targets="full")
     lora = init_lora_gemma3(config, spec, jax.random.PRNGKey(1))
@@ -257,6 +259,15 @@ def main():
             gsteps, B=GB, S=GS)
         run("gemma270m_lora_bf16_offload_stream", bench_gemma_lora, bf16,
             gsteps, B=GB, S=GS, offload=True)
+        # the reference's benchmark table spans GPT-2 S/M and Gemma
+        # 270M/1B (README.md:406-411); cover the larger two as well
+        run("gpt2m_lora_bf16_B32_S128", bench_gpt2_lora, bf16, steps,
+            B=32, S=S, size="medium")
+        run("gemma1b_lora_bf16_B8_S256", bench_gemma_lora, bf16,
+            max(gsteps // 2, 2), B=8, S=GS, loss_chunks=8, size="1b")
+        run("gemma1b_lora_bf16_offload_stream", bench_gemma_lora, bf16,
+            max(gsteps // 2, 2), B=8, S=GS, offload=True, loss_chunks=8,
+            size="1b")  # same B as the resident row: comparable
         # flash vs xla at the long-context shape ('auto' resolves flash)
         run("gpt2s_lora_bf16_S1024_flash", bench_gpt2_lora, bf16, steps,
             B=4, S=1024, impl="flash")

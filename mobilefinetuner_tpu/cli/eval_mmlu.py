@@ -66,8 +66,7 @@ def setup_family(args):
     encoder for the A-D letter-id lookup (None = use tok.encode as-is)."""
     compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" \
         else jnp.float32
-    b = load_family(args.pretrained_dir,
-                    "gemma" if args.family == "gemma" else args.family)
+    b = load_family(args.pretrained_dir, args.family)
     lora = apply_adapter(b, args.lora_path, args.lora_merge)
     config, model = b.config, b.model
 

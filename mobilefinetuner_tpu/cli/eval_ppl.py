@@ -30,8 +30,7 @@ import time
 import jax
 import jax.numpy as jnp
 
-from mobilefinetuner_tpu.cli.family import (apply_adapter, detect_family,
-                                            load_family)
+from mobilefinetuner_tpu.cli.family import apply_adapter, load_family
 from mobilefinetuner_tpu.core.logging import JSONLWriter, get_logger
 from mobilefinetuner_tpu.data.wikitext2 import WT2Config, WikiText2Dataset
 from mobilefinetuner_tpu.ops.loss import (lm_cross_entropy_sum,

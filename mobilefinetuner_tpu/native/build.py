@@ -1,0 +1,61 @@
+"""Shared lazy-build + load machinery for the native C++ engines.
+
+One copy of the scheme all three engines use (fast_bpe, fast_gemma_bpe,
+fast_safetensors): compile the .cpp next to it with the system g++ on
+first use (plain C ABI — no pybind11), cache the .so beside the source,
+rebuild when the source is newer, and degrade to None on ANY failure so
+the pure-Python reference path takes over. An env kill switch per engine
+forces the Python path (parity tests use it).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Optional
+
+_lock = threading.Lock()
+_caches: dict = {}  # lib_path -> [lib_or_None]
+
+
+def _build(src: str, lib_path: str) -> bool:
+    # unique temp output: concurrent builders (pytest-xdist, two CLIs)
+    # must not interleave writes into one file and install a corrupt .so
+    tmp = f"{lib_path}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", src, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, lib_path)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load_native_library(src: str, lib_path: str, disable_env: str,
+                        configure: Callable[[ctypes.CDLL], None]
+                        ) -> Optional[ctypes.CDLL]:
+    """Load (building if stale) the shared library; `configure` sets the
+    ctypes restype/argtypes. Returns None when disabled or unavailable."""
+    if os.environ.get(disable_env) == "1":
+        return None
+    with _lock:
+        cache = _caches.setdefault(lib_path, [])
+        if cache:
+            return cache[0]
+        lib = None
+        try:
+            stale = (not os.path.exists(lib_path)
+                     or os.path.getmtime(lib_path) < os.path.getmtime(src))
+            if not stale or _build(src, lib_path):
+                lib = ctypes.CDLL(lib_path)
+                configure(lib)
+        except Exception:
+            lib = None
+        cache.append(lib)
+        return lib

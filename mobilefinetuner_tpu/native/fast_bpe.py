@@ -14,68 +14,38 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
 from typing import List, Optional, Tuple
+
+from mobilefinetuner_tpu.native.build import load_native_library
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "fast_bpe.cpp")
 _LIB = os.path.join(_HERE, "libfast_bpe.so")
-_lock = threading.Lock()
-_lib_cache: list = []  # [lib_or_None] once resolved
 
 
-def _build() -> bool:
-    # unique temp output: concurrent builders (pytest-xdist, two CLIs)
-    # must not interleave writes into one file and install a corrupt .so
-    tmp = f"{_LIB}.tmp.{os.getpid()}"
-    cmd = ["g++", "-O2", "-shared", "-fPIC", _SRC, "-o", tmp]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _LIB)
-        return True
-    except Exception:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        return False
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.bpe_create.restype = ctypes.c_void_p
+    lib.bpe_destroy.argtypes = [ctypes.c_void_p]
+    lib.bpe_add_merge.argtypes = [ctypes.c_void_p,
+                                  ctypes.c_char_p,
+                                  ctypes.c_char_p]
+    lib.bpe_add_token.argtypes = [ctypes.c_void_p,
+                                  ctypes.c_char_p,
+                                  ctypes.c_int32]
+    lib.bpe_load.argtypes = [ctypes.c_void_p,
+                             ctypes.c_char_p,
+                             ctypes.c_char_p,
+                             ctypes.POINTER(ctypes.c_int32),
+                             ctypes.c_int32]
+    lib.bpe_encode_word.restype = ctypes.c_int32
+    lib.bpe_encode_word.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ctypes.c_int32]
 
 
 def load_library() -> Optional[ctypes.CDLL]:
-    if os.environ.get("MFT_NO_NATIVE_BPE") == "1":
-        return None
-    with _lock:
-        if _lib_cache:
-            return _lib_cache[0]
-        lib = None
-        try:
-            stale = (not os.path.exists(_LIB)
-                     or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
-            if not stale or _build():
-                lib = ctypes.CDLL(_LIB)
-                lib.bpe_create.restype = ctypes.c_void_p
-                lib.bpe_destroy.argtypes = [ctypes.c_void_p]
-                lib.bpe_add_merge.argtypes = [ctypes.c_void_p,
-                                              ctypes.c_char_p,
-                                              ctypes.c_char_p]
-                lib.bpe_add_token.argtypes = [ctypes.c_void_p,
-                                              ctypes.c_char_p,
-                                              ctypes.c_int32]
-                lib.bpe_load.argtypes = [ctypes.c_void_p,
-                                         ctypes.c_char_p,
-                                         ctypes.c_char_p,
-                                         ctypes.POINTER(ctypes.c_int32),
-                                         ctypes.c_int32]
-                lib.bpe_encode_word.restype = ctypes.c_int32
-                lib.bpe_encode_word.argtypes = [
-                    ctypes.c_void_p, ctypes.c_char_p,
-                    ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
-                    ctypes.c_int32]
-        except Exception:
-            lib = None
-        _lib_cache.append(lib)
-        return lib
+    return load_native_library(_SRC, _LIB, "MFT_NO_NATIVE_BPE", _configure)
 
 
 class NativeBPE:

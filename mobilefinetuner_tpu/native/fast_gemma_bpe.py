@@ -15,59 +15,32 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
-import subprocess
-import threading
 from typing import Dict, List, Optional, Tuple
+
+from mobilefinetuner_tpu.native.build import load_native_library
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "fast_gemma_bpe.cpp")
 _LIB = os.path.join(_HERE, "libfast_gemma_bpe.so")
-_lock = threading.Lock()
-_lib_cache: list = []
 
 
-def _build() -> bool:
-    tmp = f"{_LIB}.tmp.{os.getpid()}"
-    cmd = ["g++", "-O2", "-shared", "-fPIC", _SRC, "-o", tmp]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _LIB)
-        return True
-    except Exception:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        return False
+def _configure(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    lib.gbpe_create.restype = c.c_void_p
+    lib.gbpe_destroy.argtypes = [c.c_void_p]
+    lib.gbpe_load.restype = c.c_int32
+    lib.gbpe_load.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_int64, c.c_char_p,
+        c.c_int64, c.c_int32, c.c_int32]
+    lib.gbpe_encode.restype = c.c_int32
+    lib.gbpe_encode.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_int64,
+        c.POINTER(c.c_int32), c.c_int32]
 
 
 def load_library() -> Optional[ctypes.CDLL]:
-    if os.environ.get("MFT_NO_NATIVE_GEMMA_BPE") == "1":
-        return None
-    with _lock:
-        if _lib_cache:
-            return _lib_cache[0]
-        lib = None
-        try:
-            stale = (not os.path.exists(_LIB)
-                     or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
-            if not stale or _build():
-                lib = ctypes.CDLL(_LIB)
-                c = ctypes
-                lib.gbpe_create.restype = c.c_void_p
-                lib.gbpe_destroy.argtypes = [c.c_void_p]
-                lib.gbpe_load.restype = c.c_int32
-                lib.gbpe_load.argtypes = [
-                    c.c_void_p, c.c_char_p, c.c_int64, c.c_char_p,
-                    c.c_int64, c.c_int32, c.c_int32]
-                lib.gbpe_encode.restype = c.c_int32
-                lib.gbpe_encode.argtypes = [
-                    c.c_void_p, c.c_char_p, c.c_int64,
-                    c.POINTER(c.c_int32), c.c_int32]
-        except Exception:
-            lib = None
-        _lib_cache.append(lib)
-        return lib
+    return load_native_library(_SRC, _LIB, "MFT_NO_NATIVE_GEMMA_BPE",
+                               _configure)
 
 
 def _rec(b: bytes) -> bytes:

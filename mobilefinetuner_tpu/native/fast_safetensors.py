@@ -15,93 +15,65 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from mobilefinetuner_tpu.native.build import load_native_library
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "fast_safetensors.cpp")
 _LIB = os.path.join(_HERE, "libfast_safetensors.so")
-_lock = threading.Lock()
-_lib_cache: list = []
 
 
-def _build() -> bool:
-    tmp = f"{_LIB}.tmp.{os.getpid()}"
-    cmd = ["g++", "-O2", "-shared", "-fPIC", _SRC, "-o", tmp]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _LIB)
-        return True
-    except Exception:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        return False
+def _configure(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    lib.st_open.restype = c.c_void_p
+    lib.st_open.argtypes = [c.c_char_p]
+    lib.st_error.restype = c.c_char_p
+    lib.st_error.argtypes = [c.c_void_p]
+    lib.st_count.restype = c.c_int32
+    lib.st_count.argtypes = [c.c_void_p]
+    # *_n functions return raw byte pointers + explicit length
+    # (NOT c_char_p: names/metadata may contain NUL bytes)
+    lib.st_key_n.restype = c.c_void_p
+    lib.st_key_n.argtypes = [c.c_void_p, c.c_int32,
+                             c.POINTER(c.c_int32)]
+    lib.st_info_at.restype = c.c_int32
+    lib.st_info_at.argtypes = [
+        c.c_void_p, c.c_int32, c.c_char_p,
+        c.POINTER(c.c_int32), c.POINTER(c.c_int64),
+        c.POINTER(c.c_uint64), c.POINTER(c.c_uint64)]
+    lib.st_blob.restype = c.POINTER(c.c_uint8)
+    lib.st_blob.argtypes = [c.c_void_p]
+    lib.st_meta_count.restype = c.c_int32
+    lib.st_meta_count.argtypes = [c.c_void_p]
+    lib.st_meta_key_n.restype = c.c_void_p
+    lib.st_meta_key_n.argtypes = [c.c_void_p, c.c_int32,
+                                  c.POINTER(c.c_int32)]
+    lib.st_meta_val_n.restype = c.c_void_p
+    lib.st_meta_val_n.argtypes = [c.c_void_p, c.c_int32,
+                                  c.POINTER(c.c_int32)]
+    lib.st_close.argtypes = [c.c_void_p]
+    lib.stw_create.restype = c.c_void_p
+    lib.stw_create.argtypes = [c.c_char_p]
+    lib.stw_error.restype = c.c_char_p
+    lib.stw_error.argtypes = [c.c_void_p]
+    lib.stw_meta.argtypes = [c.c_void_p, c.c_char_p, c.c_int32,
+                             c.c_char_p, c.c_int32]
+    lib.stw_declare.restype = c.c_int32
+    lib.stw_declare.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_int32, c.c_char_p,
+        c.POINTER(c.c_int64), c.c_int32, c.c_uint64]
+    lib.stw_data.restype = c.c_int32
+    lib.stw_data.argtypes = [c.c_void_p, c.c_void_p, c.c_uint64]
+    lib.stw_finish.restype = c.c_int32
+    lib.stw_finish.argtypes = [c.c_void_p]
+    lib.stw_destroy.argtypes = [c.c_void_p]
 
 
 def load_library() -> Optional[ctypes.CDLL]:
-    if os.environ.get("MFT_NO_NATIVE_ST") == "1":
-        return None
-    with _lock:
-        if _lib_cache:
-            return _lib_cache[0]
-        lib = None
-        try:
-            stale = (not os.path.exists(_LIB)
-                     or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
-            if not stale or _build():
-                lib = ctypes.CDLL(_LIB)
-                c = ctypes
-                lib.st_open.restype = c.c_void_p
-                lib.st_open.argtypes = [c.c_char_p]
-                lib.st_error.restype = c.c_char_p
-                lib.st_error.argtypes = [c.c_void_p]
-                lib.st_count.restype = c.c_int32
-                lib.st_count.argtypes = [c.c_void_p]
-                # *_n functions return raw byte pointers + explicit length
-                # (NOT c_char_p: names/metadata may contain NUL bytes)
-                lib.st_key_n.restype = c.c_void_p
-                lib.st_key_n.argtypes = [c.c_void_p, c.c_int32,
-                                         c.POINTER(c.c_int32)]
-                lib.st_info_at.restype = c.c_int32
-                lib.st_info_at.argtypes = [
-                    c.c_void_p, c.c_int32, c.c_char_p,
-                    c.POINTER(c.c_int32), c.POINTER(c.c_int64),
-                    c.POINTER(c.c_uint64), c.POINTER(c.c_uint64)]
-                lib.st_blob.restype = c.POINTER(c.c_uint8)
-                lib.st_blob.argtypes = [c.c_void_p]
-                lib.st_meta_count.restype = c.c_int32
-                lib.st_meta_count.argtypes = [c.c_void_p]
-                lib.st_meta_key_n.restype = c.c_void_p
-                lib.st_meta_key_n.argtypes = [c.c_void_p, c.c_int32,
-                                              c.POINTER(c.c_int32)]
-                lib.st_meta_val_n.restype = c.c_void_p
-                lib.st_meta_val_n.argtypes = [c.c_void_p, c.c_int32,
-                                              c.POINTER(c.c_int32)]
-                lib.st_close.argtypes = [c.c_void_p]
-                lib.stw_create.restype = c.c_void_p
-                lib.stw_create.argtypes = [c.c_char_p]
-                lib.stw_error.restype = c.c_char_p
-                lib.stw_error.argtypes = [c.c_void_p]
-                lib.stw_meta.argtypes = [c.c_void_p, c.c_char_p, c.c_int32,
-                                         c.c_char_p, c.c_int32]
-                lib.stw_declare.restype = c.c_int32
-                lib.stw_declare.argtypes = [
-                    c.c_void_p, c.c_char_p, c.c_int32, c.c_char_p,
-                    c.POINTER(c.c_int64), c.c_int32, c.c_uint64]
-                lib.stw_data.restype = c.c_int32
-                lib.stw_data.argtypes = [c.c_void_p, c.c_void_p, c.c_uint64]
-                lib.stw_finish.restype = c.c_int32
-                lib.stw_finish.argtypes = [c.c_void_p]
-                lib.stw_destroy.argtypes = [c.c_void_p]
-        except Exception:
-            lib = None
-        _lib_cache.append(lib)
-        return lib
+    return load_native_library(_SRC, _LIB, "MFT_NO_NATIVE_ST", _configure)
 
 
 class NativeReader:

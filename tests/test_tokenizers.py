@@ -48,10 +48,11 @@ def gpt2_files(tmp_path_factory):
     return str(d), tok
 
 
-def test_gpt2_bpe_matches_oracle(gpt2_files):
+@pytest.mark.parametrize("use_native", [True, False])
+def test_gpt2_bpe_matches_oracle(gpt2_files, use_native):
     from mobilefinetuner_tpu.data.tokenizer_bpe import GPT2BPETokenizer
     d, oracle = gpt2_files
-    ours = GPT2BPETokenizer.from_pretrained(d)
+    ours = GPT2BPETokenizer.from_pretrained(d, use_native=use_native)
     for text in TRICKY + CORPUS[:7]:
         expect = oracle.encode(text).ids
         got = ours.encode(text)
@@ -92,20 +93,38 @@ def gemma_file(tmp_path_factory):
     return path, tok
 
 
-def test_gemma_bpe_matches_oracle(gemma_file):
+
+def make_gemma(path, backend):
+    """Construct GemmaTokenizer on the requested BPE backend; the oracle
+    suite runs BOTH so the pure-Python reference keeps direct HF-oracle
+    coverage even on machines where the native engine builds."""
+    import os
     from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
+    if backend == "python":
+        os.environ["MFT_NO_NATIVE_GEMMA_BPE"] = "1"
+        try:
+            t = GemmaTokenizer(path)
+        finally:
+            del os.environ["MFT_NO_NATIVE_GEMMA_BPE"]
+        assert t._native is None
+        return t
+    return GemmaTokenizer(path)
+
+
+@pytest.mark.parametrize("backend", ["native", "python"])
+def test_gemma_bpe_matches_oracle(gemma_file, backend):
     path, oracle = gemma_file
-    ours = GemmaTokenizer(path)
+    ours = make_gemma(path, backend)
     for text in TRICKY + CORPUS[:7]:
         expect = oracle.encode(text).ids
         got = ours.encode(text, add_bos=False)
         assert got == expect, (text, got, expect)
 
 
-def test_gemma_byte_fallback(gemma_file):
-    from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
+@pytest.mark.parametrize("backend", ["native", "python"])
+def test_gemma_byte_fallback(gemma_file, backend):
     path, oracle = gemma_file
-    ours = GemmaTokenizer(path)
+    ours = make_gemma(path, backend)
     # char far outside the training corpus -> byte-fallback tokens
     text = "☃ unseen 𝄞"
     got = ours.encode(text, add_bos=False)

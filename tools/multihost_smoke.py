@@ -94,6 +94,19 @@ def main():
                                          jnp.int32(step))
             loss = float(metrics["loss"])  # host sync (global scalar)
     assert np.isfinite(loss), loss
+
+    # checkpoint-path validation: gather the cross-process FSDP-sharded
+    # frozen tree to host (collective; every process calls it) and check
+    # a leaf's global shape survives the round trip
+    gathered = dist.gather_to_host(params)
+    qkv = gathered["blocks"]["attn"]["qkv_w"]
+    assert isinstance(qkv, np.ndarray), type(qkv)
+    assert qkv.shape == (config.n_layer, config.n_embd, 3 * config.n_embd)
+    assert np.isfinite(qkv).all()
+    # replicated trainables gather via the fully-replicated fast path
+    lora_h = dist.gather_to_host(lora)
+    assert all(isinstance(x, np.ndarray)
+               for x in jax.tree.leaves(lora_h))
     print(f"MULTIHOST_OK loss={loss:.6f} "
           f"proc={jax.process_index()}/{jax.process_count()}")
 

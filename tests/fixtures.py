@@ -74,37 +74,8 @@ def write_tiny_gpt2_dir(d: str, seed: int = 0) -> GPT2Config:
     return config
 
 
-def gemma3_params_to_hf(params) -> dict:
-    """Stacked pytree -> HF Gemma3 text key scheme (inverse of
-    io/checkpoints.gemma3_params_from_hf; linear weights back to [out, in])."""
-    p = {"model.embed_tokens.weight": np.asarray(params["embed"])}
-    b = params["blocks"]
-    L = np.asarray(b["input_ln"]).shape[0]
-    a, m = "model.layers.{}.self_attn.", "model.layers.{}.mlp."
-    per_layer = [
-        ("model.layers.{}.input_layernorm.weight", b["input_ln"], False),
-        (a + "q_proj.weight", b["attn"]["q_w"], True),
-        (a + "k_proj.weight", b["attn"]["k_w"], True),
-        (a + "v_proj.weight", b["attn"]["v_w"], True),
-        (a + "o_proj.weight", b["attn"]["o_w"], True),
-        (a + "q_norm.weight", b["attn"]["q_norm"], False),
-        (a + "k_norm.weight", b["attn"]["k_norm"], False),
-        ("model.layers.{}.post_attention_layernorm.weight",
-         b["post_attn_ln"], False),
-        ("model.layers.{}.pre_feedforward_layernorm.weight",
-         b["pre_ffn_ln"], False),
-        (m + "gate_proj.weight", b["mlp"]["gate_w"], True),
-        (m + "up_proj.weight", b["mlp"]["up_w"], True),
-        (m + "down_proj.weight", b["mlp"]["down_w"], True),
-        ("model.layers.{}.post_feedforward_layernorm.weight",
-         b["post_ffn_ln"], False),
-    ]
-    for fmt, arr, is_linear in per_layer:
-        arr = np.asarray(arr)
-        for i in range(L):
-            p[fmt.format(i)] = arr[i].T if is_linear else arr[i]
-    p["model.norm.weight"] = np.asarray(params["final_norm"])
-    return p
+from mobilefinetuner_tpu.io.checkpoints import \
+    gemma3_params_to_hf  # production inverse mapper (io/checkpoints.py)
 
 
 def train_tiny_gemma_tokenizer(path: str):

@@ -101,11 +101,15 @@ def make_gemma(path, backend):
     import os
     from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
     if backend == "python":
+        prior = os.environ.get("MFT_NO_NATIVE_GEMMA_BPE")
         os.environ["MFT_NO_NATIVE_GEMMA_BPE"] = "1"
         try:
             t = GemmaTokenizer(path)
-        finally:
-            del os.environ["MFT_NO_NATIVE_GEMMA_BPE"]
+        finally:  # restore a user-preset kill switch, don't clobber it
+            if prior is None:
+                del os.environ["MFT_NO_NATIVE_GEMMA_BPE"]
+            else:
+                os.environ["MFT_NO_NATIVE_GEMMA_BPE"] = prior
         assert t._native is None
         return t
     return GemmaTokenizer(path)

@@ -1,14 +1,15 @@
-"""End-to-end perplexity pipeline at full GPT-2-small scale.
+"""End-to-end perplexity pipeline at full model scale (both families).
 
 The correctness anchor for the rebuild is the reference's README numbers:
 WikiText-2 PPL ~29.5 pretrained -> ~26.8 after one LoRA epoch
 (reference: README.md:355-357). This environment has zero egress (no real
 checkpoint or WikiText-2 download), so this tool proves the FULL pipeline
-at the real size instead: it synthesizes a 124M-parameter GPT-2-small
-HF-format checkpoint (random weights, real key scheme/layouts, full 50257
-vocab) plus a WikiText-shaped synthetic corpus, then runs
+at the real size instead: it synthesizes a full-size HF-format checkpoint
+(random weights, real key schemes/layouts — 124M GPT-2-small with its
+50257 vocab, or 270M Gemma-3 with the full 262,144-entry tokenizer) plus
+a WikiText-shaped synthetic corpus, then runs
 
-  eval_ppl (baseline) -> gpt2_lora_finetune (short run)
+  eval_ppl (baseline) -> gpt2_lora_finetune | train_lora_gemma
                       -> eval_ppl (adapter merged)
 
 through the actual CLIs and records baseline/post PPLs + training
@@ -16,10 +17,12 @@ throughput as one JSON artifact. Against REAL data the exact same recipe
 applies — point the flags at real dirs:
 
   python tools/e2e_ppl_pipeline.py \
-      --gpt2_dir /path/gpt2 --data_root /path/wikitext-2 \
+      --model_dir /path/gpt2 --data_root /path/wikitext-2 \
       --train_steps 0 --epochs 1        # one epoch, reference protocol
   # expected with the real checkpoint: baseline ppl ~29.5 at S=1024,
   # post-LoRA ~26.8 (README.md:355-357)
+  python tools/e2e_ppl_pipeline.py --family gemma \
+      --model_dir /path/gemma-3-270m --data_root /path/wikitext-2
 
 With synthetic data the assertion is structural: the pipeline runs at
 full size end-to-end and LoRA training IMPROVES the eval PPL on held-out
@@ -71,6 +74,63 @@ def write_synthetic_gpt2(d: str, seed: int = 0):
         json.dump(vocab, f)
     with open(os.path.join(d, "merges.txt"), "w") as f:
         f.write("#version: 0.2\n")
+    return cfg
+
+
+def write_synthetic_gemma270m(d: str, seed: int = 0):
+    """Full-size Gemma-3-270M HF checkpoint dir with random weights: real
+    config.json (gemma3_text), model.safetensors in HF Gemma3 keys
+    ([out, in] linears), and a full 262,144-entry tokenizer.json — BPE
+    trained on the synthetic corpus's vocabulary for realistic merges,
+    padded with filler pieces to the real vocab size so the full 262k
+    head + chunked CE run at true scale."""
+    import jax
+    from mobilefinetuner_tpu.core.config import Gemma3TextConfig
+    from mobilefinetuner_tpu.io.checkpoints import gemma3_params_to_hf
+    from mobilefinetuner_tpu.io.safetensors_io import save_safetensors
+    from mobilefinetuner_tpu.models import gemma3
+
+    os.makedirs(d, exist_ok=True)
+    cfg = Gemma3TextConfig.gemma3_270m()
+    params = gemma3.init_params(cfg, jax.random.PRNGKey(seed))
+    sd = gemma3_params_to_hf(jax.device_get(params))
+    save_safetensors(os.path.join(d, "model.safetensors"),
+                     {k: np.asarray(v) for k, v in sd.items()})
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump({"model_type": "gemma3_text",
+                   "vocab_size": cfg.vocab_size,
+                   "hidden_size": cfg.hidden_size,
+                   "intermediate_size": cfg.intermediate_size,
+                   "num_hidden_layers": cfg.num_hidden_layers,
+                   "num_attention_heads": cfg.num_attention_heads,
+                   "num_key_value_heads": cfg.num_key_value_heads,
+                   "head_dim": cfg.head_dim,
+                   "sliding_window": cfg.sliding_window,
+                   "rope_theta": cfg.rope_theta,
+                   "rope_local_base_freq": cfg.rope_local_base_freq,
+                   "query_pre_attn_scalar": cfg.query_pre_attn_scalar},
+                  f)
+
+    # tokenizer: train a small real BPE on corpus-shaped text, then pad
+    from tokenizers import Tokenizer, models, normalizers, trainers
+    byte_tokens = [f"<0x{b:02X}>" for b in range(256)]
+    tok = Tokenizer(models.BPE(unk_token="<unk>", byte_fallback=True))
+    tok.normalizer = normalizers.Replace(" ", "▁")
+    trainer = trainers.BpeTrainer(
+        vocab_size=4000,
+        special_tokens=["<pad>", "<eos>", "<bos>", "<unk>"] + byte_tokens,
+        show_progress=False)
+    corpus_words = [f"w{i:03d}" for i in range(400)]
+    tok.train_from_iterator(
+        (" ".join(corpus_words[i % 400] for i in range(j, j + 12))
+         for j in range(3000)), trainer)
+    spec = json.loads(tok.to_str())
+    vocab = spec["model"]["vocab"]
+    for i in range(len(vocab), cfg.vocab_size):
+        vocab[f"<unused{i}>"] = i
+    spec["model"]["vocab"] = vocab
+    with open(os.path.join(d, "tokenizer.json"), "w") as f:
+        json.dump(spec, f)
     return cfg
 
 
@@ -132,8 +192,10 @@ def run_eval(gpt2_dir, data_root, seq_len, batch_size, max_batches,
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--gpt2_dir", default="",
-                    help="real HF GPT-2 dir; default: synthesize 124M")
+    ap.add_argument("--family", choices=["gpt2", "gemma"], default="gpt2")
+    ap.add_argument("--gpt2_dir", "--model_dir", dest="model_dir",
+                    default="",
+                    help="real HF model dir; default: synthesize full size")
     ap.add_argument("--data_root", default="",
                     help="real WikiText-2 dir; default: synthesize")
     ap.add_argument("--work_dir", default="/tmp/e2e_ppl")
@@ -141,46 +203,68 @@ def main(argv=None):
     ap.add_argument("--train_steps", type=int, default=300)
     ap.add_argument("--epochs", type=int, default=0,
                     help="overrides train_steps when > 0 (real-data use)")
-    ap.add_argument("--batch_size", type=int, default=16)
-    ap.add_argument("--seq_len", type=int, default=128)
-    ap.add_argument("--eval_seq_len", type=int, default=128)
+    ap.add_argument("--batch_size", type=int, default=0,
+                    help="0 = family default (16 gpt2 / 8 gemma)")
+    ap.add_argument("--seq_len", type=int, default=0,
+                    help="0 = family default (128 gpt2 / 256 gemma, the "
+                         "BASELINE configs)")
+    ap.add_argument("--eval_seq_len", type=int, default=0)
     ap.add_argument("--eval_batches", type=int, default=30)
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--dtype", default="bfloat16")
     args = ap.parse_args(argv)
 
+    gemma = args.family == "gemma"
+    args.batch_size = args.batch_size or (8 if gemma else 16)
+    args.seq_len = args.seq_len or (256 if gemma else 128)
+    args.eval_seq_len = args.eval_seq_len or args.seq_len
+
     os.makedirs(args.work_dir, exist_ok=True)
-    synthetic = not args.gpt2_dir
-    gpt2_dir = args.gpt2_dir or os.path.join(args.work_dir, "gpt2s")
+    synthetic = not args.model_dir
+    model_dir = args.model_dir or os.path.join(
+        args.work_dir, "gemma270m" if gemma else "gpt2s")
     data_root = args.data_root or os.path.join(args.work_dir, "corpus")
     if synthetic:
-        print("synthesizing 124M GPT-2-small checkpoint + corpus...",
+        name = "270M Gemma-3" if gemma else "124M GPT-2-small"
+        print(f"synthesizing {name} checkpoint + corpus...",
               file=sys.stderr)
-        write_synthetic_gpt2(gpt2_dir)
+        if gemma:
+            write_synthetic_gemma270m(model_dir)
+        else:
+            write_synthetic_gpt2(model_dir)
     if not args.data_root:
         write_synthetic_corpus(data_root)
 
-    base = run_eval(gpt2_dir, data_root, args.eval_seq_len,
+    base = run_eval(model_dir, data_root, args.eval_seq_len,
                     8, args.eval_batches, dtype=args.dtype)
     print(f"baseline: ppl={base['ppl']:.2f}", file=sys.stderr)
 
-    from mobilefinetuner_tpu.cli import gpt2_lora_finetune
-    adapter = os.path.join(args.work_dir, "adapter.safetensors")
-    train_argv = ["--pretrained_dir", gpt2_dir, "--data_dir", data_root,
-                  "--batch_size", str(args.batch_size),
-                  "--seq_len", str(args.seq_len), "--lr", str(args.lr),
-                  "--dtype", args.dtype, "--lora_out", adapter,
-                  "--log_interval", "50",
-                  "--lora_targets",
-                  "attn_qkv,attn_proj,mlp_fc_in,mlp_fc_out"]
-    train_argv += (["--epochs", str(args.epochs)] if args.epochs
-                   else ["--steps", str(args.train_steps)])
+    common_argv = ["--data_dir", data_root,
+                   "--batch_size", str(args.batch_size),
+                   "--seq_len", str(args.seq_len),
+                   "--lr", str(args.lr), "--dtype", args.dtype,
+                   "--log_interval", "50"] + \
+        (["--epochs", str(args.epochs)] if args.epochs
+         else ["--steps", str(args.train_steps)])
     t0 = time.time()
-    rc = gpt2_lora_finetune.main(train_argv)
+    if gemma:
+        from mobilefinetuner_tpu.cli import train_lora_gemma
+        out_dir = os.path.join(args.work_dir, "gemma_out")
+        rc = train_lora_gemma.main(
+            ["--model_dir", model_dir, "--output_dir", out_dir,
+             "--targets", "full"] + common_argv)
+        adapter = os.path.join(out_dir, "gemma_lora.safetensors")
+    else:
+        from mobilefinetuner_tpu.cli import gpt2_lora_finetune
+        adapter = os.path.join(args.work_dir, "adapter.safetensors")
+        rc = gpt2_lora_finetune.main(
+            ["--pretrained_dir", model_dir, "--lora_out", adapter,
+             "--lora_targets",
+             "attn_qkv,attn_proj,mlp_fc_in,mlp_fc_out"] + common_argv)
     train_s = time.time() - t0
     assert rc == 0
 
-    post = run_eval(gpt2_dir, data_root, args.eval_seq_len,
+    post = run_eval(model_dir, data_root, args.eval_seq_len,
                     8, args.eval_batches, lora_path=adapter,
                     dtype=args.dtype)
     print(f"post-LoRA: ppl={post['ppl']:.2f}", file=sys.stderr)
@@ -188,7 +272,7 @@ def main(argv=None):
     steps = args.train_steps if not args.epochs else None
     report = {
         "synthetic": synthetic,
-        "model": "gpt2-small-124M",
+        "model": "gemma3-270m" if gemma else "gpt2-small-124M",
         "baseline_ppl": round(base["ppl"], 3),
         "post_lora_ppl": round(post["ppl"], 3),
         "ppl_improvement": round(base["ppl"] - post["ppl"], 3),
@@ -200,7 +284,7 @@ def main(argv=None):
         "reference_anchor": {"baseline_ppl": 29.5, "post_lora_ppl": 26.8,
                              "source": "/root/reference/README.md:355-357",
                              "note": "real-checkpoint numbers; this run "
-                                     "is synthetic unless --gpt2_dir"},
+                                     "is synthetic unless --model_dir"},
     }
     with open(args.out, "w") as f:
         json.dump(report, f, indent=1)

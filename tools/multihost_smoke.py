@@ -99,14 +99,17 @@ def main():
     # frozen tree to host (collective; every process calls it) and check
     # a leaf's global shape survives the round trip
     gathered = dist.gather_to_host(params)
-    qkv = gathered["blocks"]["attn"]["qkv_w"]
-    assert isinstance(qkv, np.ndarray), type(qkv)
+    # single-process (N=1) standalone runs get the tree back unchanged;
+    # multi-process must yield host numpy for every leaf
+    qkv = np.asarray(gathered["blocks"]["attn"]["qkv_w"])
     assert qkv.shape == (config.n_layer, config.n_embd, 3 * config.n_embd)
     assert np.isfinite(qkv).all()
-    # replicated trainables gather via the fully-replicated fast path
     lora_h = dist.gather_to_host(lora)
-    assert all(isinstance(x, np.ndarray)
-               for x in jax.tree.leaves(lora_h))
+    if jax.process_count() > 1:
+        assert isinstance(gathered["blocks"]["attn"]["qkv_w"], np.ndarray)
+        # replicated trainables gather via the fully-replicated fast path
+        assert all(isinstance(x, np.ndarray)
+                   for x in jax.tree.leaves(lora_h))
     print(f"MULTIHOST_OK loss={loss:.6f} "
           f"proc={jax.process_index()}/{jax.process_count()}")
 

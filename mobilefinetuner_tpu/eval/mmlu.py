@@ -177,6 +177,26 @@ def build_prompt(item: MCQItem,
 LETTERS = ("A", "B", "C", "D")
 
 
+def restricted_argmax(logits_row: np.ndarray,
+                      letter_ids: Sequence[int]) -> str:
+    """argmax over the A-D letter token ids (out-of-range ids score -inf);
+    raw logits are rank-equivalent to the reference's log-softmax."""
+    scores = [logits_row[i] if 0 <= i < logits_row.shape[-1] else -1e30
+              for i in letter_ids]
+    return LETTERS[int(np.argmax(scores))]
+
+
+def finalize_reports(correct: Dict[str, int],
+                     totals: Dict[str, int]) -> "MMLUResult":
+    reports = [SubjectReport(s, correct[s], totals[s])
+               for s in sorted(totals)]
+    macro = (sum(r.accuracy for r in reports) / len(reports)
+             if reports else 0.0)
+    total = sum(totals.values())
+    micro = sum(correct.values()) / total if total else 0.0
+    return MMLUResult(reports, macro, micro, total)
+
+
 def predict_letter(prompt: str, logits_fn: Callable[[np.ndarray], np.ndarray],
                    encode_fn: Callable[[str], List[int]],
                    letter_ids: Sequence[int]) -> str:
@@ -187,9 +207,7 @@ def predict_letter(prompt: str, logits_fn: Callable[[np.ndarray], np.ndarray],
     predict_letter; we skip the normalization)."""
     ids = encode_fn(prompt) or [0]
     logits = logits_fn(np.asarray(ids, np.int32)[None, :])
-    scores = [logits[i] if 0 <= i < logits.shape[-1] else -1e30
-              for i in letter_ids]
-    return LETTERS[int(np.argmax(scores))]
+    return restricted_argmax(logits, letter_ids)
 
 
 def letter_token_ids(encode_fn: Callable[[str], List[int]]) -> List[int]:
@@ -199,6 +217,80 @@ def letter_token_ids(encode_fn: Callable[[str], List[int]]) -> List[int]:
         ids = encode_fn(letter)
         out.append(ids[0] if ids else fallback)
     return out
+
+
+def evaluate_batched(by_subject: Dict[str, List[MCQItem]],
+                     batched_logits_fn: Callable[[np.ndarray, np.ndarray],
+                                                 np.ndarray],
+                     encode_fn: Callable[[str], List[int]],
+                     fewshot_k: int = 0,
+                     progress_fn: Optional[Callable[[str, int, int],
+                                                    None]] = None,
+                     max_items_per_subject: int = 0,
+                     letter_encode_fn: Optional[Callable[[str],
+                                                         List[int]]] = None,
+                     batch_size: int = 16,
+                     max_len: int = 1024,
+                     min_bucket: int = 32) -> MMLUResult:
+    """TPU-first runner: identical predictions/reporting to evaluate(),
+    but prompts are grouped into power-of-two length buckets and fed
+    batch_size at a time — one compiled program per (bucket, batch) shape
+    instead of a [1, S] forward per item (the reference runs per-item,
+    mmlu_runner.cpp; on the MXU that leaves 15/16ths of the batch
+    dimension idle).
+
+    batched_logits_fn(ids [B, S], last_idx [B]) -> [B, V] last-REAL-token
+    logits (right-padded rows; last_idx selects the real last token).
+    Partial batches are padded by repeating the first row; padded rows'
+    predictions are discarded.
+
+    progress_fn fires in BUCKET order (items of different subjects
+    interleave), unlike evaluate()'s strict per-subject order — only the
+    final reports are order-identical.
+    """
+    letter_ids = letter_token_ids(letter_encode_fn or encode_fn)
+    # materialize the exact evaluate() work list (same shot exclusion)
+    work = []   # (subject, item_no_in_subject, n_subject, item, ids)
+    totals: Dict[str, int] = {}
+    for subject in sorted(by_subject):
+        items = by_subject[subject]
+        if max_items_per_subject:
+            items = items[:max_items_per_subject]
+        shots = items[:fewshot_k] if fewshot_k > 0 else []
+        totals[subject] = len(items)
+        for n, item in enumerate(items):
+            shots_ex = [s for s in shots if s is not item]
+            ids = encode_fn(build_prompt(item, shots_ex or None)) or [0]
+            work.append((subject, n, len(items), item, ids[-max_len:]))
+
+    by_bucket: Dict[int, list] = {}
+    for w in work:
+        bucket = 1 << (len(w[4]) - 1).bit_length()
+        by_bucket.setdefault(min(max(bucket, min_bucket), max_len),
+                             []).append(w)
+
+    correct: Dict[str, int] = {s: 0 for s in totals}
+    for bucket in sorted(by_bucket):
+        ws = by_bucket[bucket]
+        for i in range(0, len(ws), batch_size):
+            chunk = ws[i:i + batch_size]
+            B = len(chunk)
+            ids = np.zeros((batch_size, bucket), np.int32)
+            last = np.zeros((batch_size,), np.int32)
+            for r, (_, _, _, _, tok_ids) in enumerate(chunk):
+                ids[r, :len(tok_ids)] = tok_ids
+                last[r] = len(tok_ids) - 1
+            if B < batch_size:       # pad rows: repeat row 0, discard
+                ids[B:] = ids[0]
+                last[B:] = last[0]
+            logits = np.asarray(batched_logits_fn(ids, last))  # [B, V]
+            for r, (subject, n, n_sub, item, _) in enumerate(chunk):
+                pred = restricted_argmax(logits[r], letter_ids)
+                correct[subject] += int(pred == item.answer)
+                if progress_fn:
+                    progress_fn(subject, n + 1, n_sub)
+
+    return finalize_reports(correct, totals)
 
 
 def evaluate(by_subject: Dict[str, List[MCQItem]],

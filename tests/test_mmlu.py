@@ -107,3 +107,38 @@ def test_fewshot_excludes_current_item(tmp_path):
         q = prompt.rsplit("Question: ", 1)[1]
         shots_part = prompt[: len(prompt) - len("Question: " + q)]
         assert q.split("\n")[0] not in shots_part
+
+
+def test_batched_matches_itemwise(tmp_path):
+    """evaluate_batched must produce identical predictions/reports to
+    evaluate() for any logits function — here a deterministic hash of the
+    prompt ids, so every item has a well-defined 'model opinion' and the
+    two runners must agree item for item (incl. fewshot exclusion and the
+    padded partial final batch)."""
+    from mobilefinetuner_tpu.eval.mmlu import evaluate_batched
+    root = write_tiny_mmlu_dir(str(tmp_path))
+    by_subject = load_split(root, "test")
+    encode = lambda s: [ord(c) for c in s]
+
+    def fake_logits_row(ids_row):
+        h = (np.int64(7) * np.sum(ids_row, dtype=np.int64)) % 997
+        v = np.zeros(300, np.float32)
+        v[h % 300] = 5.0
+        v[(h * 3) % 300] = 4.0
+        return v
+
+    def itemwise(ids):  # [1, S] (no padding in the itemwise runner)
+        return fake_logits_row(ids[0])
+
+    def batched(ids, last):  # [B, S] right-padded; sum ignores pad zeros
+        return np.stack([fake_logits_row(ids[r, :last[r] + 1])
+                         for r in range(ids.shape[0])])
+
+    for k in (0, 1):
+        a = evaluate(by_subject, itemwise, encode, fewshot_k=k)
+        b = evaluate_batched(by_subject, batched, encode, fewshot_k=k,
+                             batch_size=3, max_len=512)
+        assert a.total == b.total
+        assert a.micro == b.micro and a.macro == b.macro
+        assert [(r.subject, r.correct, r.total) for r in a.per_subject] \
+            == [(r.subject, r.correct, r.total) for r in b.per_subject]

@@ -204,6 +204,32 @@ def bench_gemma_lora(B, S, dtype, accum=1, offload=False, steps=20,
     return r
 
 
+def bench_generate(B=8, P=128, N=64, dtype=jnp.bfloat16):
+    """KV-cached decode throughput (models/generate.py): B prompts of
+    length P, N greedy tokens each. One compiled program; timed on the
+    second call (the first pays compile)."""
+    from mobilefinetuner_tpu.models.generate import SampleConfig, \
+        gpt2_generate
+    config = GPT2Config.gpt2_small()
+    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, config.vocab_size, (B, P)), jnp.int32)
+    mask = jnp.ones_like(ids)
+    cfg = SampleConfig(max_new_tokens=N, greedy=True, eos_id=None)
+    # params as a jit ARGUMENT (a closure would bake 124M weights into the
+    # HLO as constants — oversized programs for the compile service)
+    fn = jax.jit(lambda p, i, m: gpt2_generate(config, p, i, m, cfg,
+                                               compute_dtype=dtype))
+    out = fn(params, ids, mask)
+    np.asarray(out)  # compile + run
+    t0 = time.perf_counter()
+    out = fn(params, ids, mask)
+    np.asarray(out)  # host sync
+    dt = time.perf_counter() - t0
+    return {"dt": dt, "tokens": B * N, "loss": 0.0, "peak_bytes": 0,
+            "flops": 0}
+
+
 def finish(name, r, dtype, steps) -> dict:
     toks_per_sec = r["tokens"] * steps / r["dt"]
     return {
@@ -231,11 +257,11 @@ def main():
 
     suite = []
 
-    def run(name, fn, dtype, n, **kw):
+    def run(name, fn, dtype, n, finisher=finish, **kw):
         try:
             r = fn(dtype=jnp.bfloat16 if dtype == bf16 else jnp.float32,
                    steps=n, **kw)
-            row = finish(name, r, dtype, n)
+            row = finisher(name, r, dtype, n)
         except Exception as e:  # record, don't kill the suite
             row = {"config": name, "error": f"{type(e).__name__}: {e}"}
         suite.append(row)
@@ -273,6 +299,16 @@ def main():
             B=4, S=1024, impl="flash")
         run("gpt2s_lora_bf16_S1024_xla", bench_gpt2_lora, bf16, steps,
             B=4, S=1024, impl="xla")
+        # KV-cached decode throughput (generation surface; tokens/sec
+        # here = B*N / wall, i.e. decode steps are sequential by nature).
+        # finish() is training-shaped, so pass run() a custom finisher.
+        run("gpt2s_generate_decode_B8_P128_N64",
+            lambda dtype, steps: bench_generate(dtype=dtype), bf16, 1,
+            finisher=lambda name, r, dtype, n: {
+                "config": name,
+                "tokens_per_sec_per_chip": round(r["tokens"] / r["dt"], 1),
+                "vs_baseline": None, "mfu": None, "peak_hbm_mb": None,
+                "loss": None})
 
     with open("BENCH_SUITE.json", "w") as f:
         json.dump({"suite": suite,

@@ -96,9 +96,12 @@ def main(argv=None) -> int:
     rng = jax.random.PRNGKey(args.seed)
 
     t0 = time.time()
-    out = np.asarray(gen(config, params, jnp.asarray(ids),
-                         jnp.asarray(mask), cfg, rng,
-                         compute_dtype=compute_dtype))
+    # jit with params/rng as ARGUMENTS: closing over full-size weights
+    # would embed them in the HLO as constants (oversized programs)
+    gen_jit = jax.jit(lambda p, i, m, r: gen(config, p, i, m, cfg, r,
+                                             compute_dtype=compute_dtype))
+    out = np.asarray(gen_jit(params, jnp.asarray(ids), jnp.asarray(mask),
+                             rng))
     dt = time.time() - t0
     n_tok = int(out.size)
     log.info(f"{n_tok} tokens in {dt:.2f}s "

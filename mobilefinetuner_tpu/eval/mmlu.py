@@ -305,8 +305,8 @@ def evaluate(by_subject: Dict[str, List[MCQItem]],
     # A-D id lookup (a Gemma-style auto-BOS encoder would make every
     # letter's first token the BOS id); prompts keep using encode_fn.
     letter_ids = letter_token_ids(letter_encode_fn or encode_fn)
-    reports: List[SubjectReport] = []
-    total_correct = total = 0
+    correct_by: Dict[str, int] = {}
+    totals: Dict[str, int] = {}
     for subject in sorted(by_subject):
         items = by_subject[subject]
         if max_items_per_subject:
@@ -320,10 +320,6 @@ def evaluate(by_subject: Dict[str, List[MCQItem]],
             correct += int(pred == item.answer)
             if progress_fn:
                 progress_fn(subject, n + 1, len(items))
-        reports.append(SubjectReport(subject, correct, len(items)))
-        total_correct += correct
-        total += len(items)
-    macro = (sum(r.accuracy for r in reports) / len(reports)
-             if reports else 0.0)
-    micro = total_correct / total if total else 0.0
-    return MMLUResult(reports, macro, micro, total)
+        correct_by[subject] = correct
+        totals[subject] = len(items)
+    return finalize_reports(correct_by, totals)

@@ -205,9 +205,11 @@ def bench_gemma_lora(B, S, dtype, accum=1, offload=False, steps=20,
 
 
 def bench_generate(B=8, P=128, N=64, dtype=jnp.bfloat16):
-    """KV-cached decode throughput (models/generate.py): B prompts of
-    length P, N greedy tokens each. One compiled program; timed on the
-    second call (the first pays compile)."""
+    """END-TO-END generate throughput (models/generate.py): B prompts of
+    length P, N greedy tokens each — the timed window covers prefill AND
+    the N decode steps (what a generate-CLI user experiences); tokens/sec
+    counts only the B*N GENERATED tokens. One compiled program; timed on
+    the second call (the first pays compile)."""
     from mobilefinetuner_tpu.models.generate import SampleConfig, \
         gpt2_generate
     config = GPT2Config.gpt2_small()
@@ -299,10 +301,10 @@ def main():
             B=4, S=1024, impl="flash")
         run("gpt2s_lora_bf16_S1024_xla", bench_gpt2_lora, bf16, steps,
             B=4, S=1024, impl="xla")
-        # KV-cached decode throughput (generation surface; tokens/sec
-        # here = B*N / wall, i.e. decode steps are sequential by nature).
+        # end-to-end generate throughput (prefill + sequential decode;
+        # tokens/sec counts generated tokens only).
         # finish() is training-shaped, so pass run() a custom finisher.
-        run("gpt2s_generate_decode_B8_P128_N64",
+        run("gpt2s_generate_e2e_B8_P128_N64",
             lambda dtype, steps: bench_generate(dtype=dtype), bf16, 1,
             finisher=lambda name, r, dtype, n: {
                 "config": name,

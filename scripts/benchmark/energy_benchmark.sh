@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Governor on/off wall-time comparison (the reference's
+# energy_benchmark.sh analog): same short training run, once at full
+# speed and once throttled by the deterministic schedule + mocked
+# telemetry. The throttled run should take ~1.5-2x longer (the
+# reference's published throttling cost, README.md:427-431).
+set -euo pipefail
+: "${GPT2_DIR:?set GPT2_DIR}" "${WT2_DIR:?set WT2_DIR}"
+OUT=${OUT:-out}; mkdir -p "$OUT"
+STEPS=${STEPS:-50}
+common=(--pretrained_dir "$GPT2_DIR" --data_dir "$WT2_DIR"
+        --steps "$STEPS" --batch_size 8 --seq_len 128 --dtype bfloat16
+        --log_interval 0)
+echo "== full speed =="
+time python -m mobilefinetuner_tpu.cli.gpt2_lora_finetune \
+    "${common[@]}" --lora_out "$OUT/e_base.safetensors"
+echo "== throttled (schedule 0-:40ms + low-battery telemetry) =="
+time python -m mobilefinetuner_tpu.cli.gpt2_lora_finetune \
+    "${common[@]}" --lora_out "$OUT/e_thr.safetensors" \
+    --pm_interval 10 --pm_schedule "0-:40" \
+    --pm_manual_batt 10 --pm_manual_temp 45

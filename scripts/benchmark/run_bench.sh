@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Full benchmark suite on the local accelerator -> BENCH_SUITE.json
+# (tokens/sec/chip, MFU, compiled peak HBM per config).
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+python bench.py

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Alignment run: dump one batch's activations/grads/post-step adapter as
+# npy, then compare against a real transformers+PEFT mirror
+# (reference: train_lora_gemma.cpp --align_dump_dir + pytorch_alignment/).
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+: "${GPT2_DIR:?set GPT2_DIR}" "${WT2_DIR:?set WT2_DIR}"
+OUT=${OUT:-out}; mkdir -p "$OUT"
+python -m mobilefinetuner_tpu.cli.gpt2_lora_finetune \
+    --pretrained_dir "$GPT2_DIR" --data_dir "$WT2_DIR" \
+    --batch_size 2 --seq_len 64 --align_dump_dir "$OUT/align_gpt2" "$@"
+python tools/align_torch_mirror.py --dump_dir "$OUT/align_gpt2"

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Gemma-3-1B LoRA with host-offload streaming: frozen weights live in
+# host RAM and stream into HBM one layer at a time (the reference's
+# ParameterSharder analog; ~1.5 GB peak HBM instead of ~14 GB).
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+: "${GEMMA1B_DIR:?set GEMMA1B_DIR}" "${WT2_DIR:?set WT2_DIR}"
+OUT=${OUT:-out}; mkdir -p "$OUT"
+python -m mobilefinetuner_tpu.cli.train_lora_gemma \
+    --model_dir "$GEMMA1B_DIR" --data_dir "$WT2_DIR" \
+    --epochs 1 --batch_size 8 --seq_len 256 --dtype bfloat16 \
+    --rank 8 --alpha 32 --targets full --lr 1e-4 \
+    --shard_enable --shard_budget_mb 2048 --shard_stream 1 \
+    --metrics_csv "$OUT/gemma1b_metrics.csv" \
+    --output_dir "$OUT/gemma1b" "$@"

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Gemma-3-270M LoRA, the BASELINE driver config (r=8 alpha=32, S=256,
+# full targets, chunked 262k-vocab CE) then eval_ppl merged.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+: "${GEMMA_DIR:?set GEMMA_DIR}" "${WT2_DIR:?set WT2_DIR}"
+OUT=${OUT:-out}; mkdir -p "$OUT"
+python -m mobilefinetuner_tpu.cli.train_lora_gemma \
+    --model_dir "$GEMMA_DIR" --data_dir "$WT2_DIR" \
+    --epochs 1 --batch_size 16 --seq_len 256 --dtype bfloat16 \
+    --rank 8 --alpha 32 --targets full --lr 1e-4 --warmup_ratio 0.03 \
+    --metrics_csv "$OUT/gemma270m_metrics.csv" \
+    --output_dir "$OUT/gemma270m" "$@"
+python -m mobilefinetuner_tpu.cli.eval_ppl \
+    --pretrained_dir "$GEMMA_DIR" --data_root "$WT2_DIR" --split test \
+    --seq_len 1024 --lora_path "$OUT/gemma270m/gemma_lora.safetensors" \
+    --lora_merge

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# GPT-2-medium (355M) LoRA — same recipe as small at B=32.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+: "${GPT2M_DIR:?set GPT2M_DIR}" "${WT2_DIR:?set WT2_DIR}"
+OUT=${OUT:-out}; mkdir -p "$OUT"
+python -m mobilefinetuner_tpu.cli.gpt2_lora_finetune \
+    --pretrained_dir "$GPT2M_DIR" --data_dir "$WT2_DIR" \
+    --epochs 1 --batch_size 32 --seq_len 128 --dtype bfloat16 \
+    --lr 2e-4 --warmup_ratio 0.03 \
+    --metrics_csv "$OUT/gpt2m_lora_metrics.csv" \
+    --lora_out "$OUT/gpt2m_adapter.safetensors" "$@"

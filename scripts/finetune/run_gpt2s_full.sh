@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# GPT-2-small full fine-tune: every parameter trained, Adam state
+# FSDP-sharded when a mesh is given.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+: "${GPT2_DIR:?set GPT2_DIR}" "${WT2_DIR:?set WT2_DIR}"
+OUT=${OUT:-out}; mkdir -p "$OUT"
+python -m mobilefinetuner_tpu.cli.gpt2_full_finetune \
+    --pretrained_dir "$GPT2_DIR" --data_dir "$WT2_DIR" \
+    --epochs 1 --batch_size 32 --seq_len 128 --dtype bfloat16 \
+    --lr 2e-5 --warmup_ratio 0.03 \
+    --metrics_csv "$OUT/gpt2s_full_metrics.csv" \
+    --output_path "$OUT/gpt2s_full_ft.safetensors" "$@"

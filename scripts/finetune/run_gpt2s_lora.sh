@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# GPT-2-small LoRA, the BASELINE driver config (r=8 alpha=16, S=128) —
+# 1 epoch of WikiText-2 then eval_ppl with the adapter merged.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+: "${GPT2_DIR:?set GPT2_DIR}" "${WT2_DIR:?set WT2_DIR}"
+OUT=${OUT:-out}; mkdir -p "$OUT"
+python -m mobilefinetuner_tpu.cli.gpt2_lora_finetune \
+    --pretrained_dir "$GPT2_DIR" --data_dir "$WT2_DIR" \
+    --epochs 1 --batch_size 64 --seq_len 128 --dtype bfloat16 \
+    --lr 2e-4 --warmup_ratio 0.03 --eval_interval 200 \
+    --metrics_csv "$OUT/gpt2s_lora_metrics.csv" \
+    --lora_out "$OUT/gpt2s_adapter.safetensors" \
+    --peft_export_dir "$OUT/gpt2s_peft" "$@"
+python -m mobilefinetuner_tpu.cli.eval_ppl \
+    --pretrained_dir "$GPT2_DIR" --data_root "$WT2_DIR" --split test \
+    --seq_len 1024 --lora_path "$OUT/gpt2s_adapter.safetensors" --lora_merge

@@ -1,0 +1,91 @@
+"""Loss-curve plotting from training metrics CSVs.
+
+The analog of the reference's loss-curve plotting in scripts/Finetune
+(reference: SURVEY.md §2.9). Reads one or more metrics CSVs written by
+--metrics_csv (columns: timestamp,epoch,step,loss,avg_loss,lr,
+step_time_ms,hbm_mb — core/logging.py MetricsLogger) and writes a PNG
+with loss + EMA curves (and LR on a twin axis), one series per file.
+Falls back to a text summary when matplotlib is unavailable.
+
+Usage:
+  python tools/plot_loss.py out/metrics.csv [more.csv ...] \
+      [--out loss_curve.png] [--title "..."]
+"""
+
+import argparse
+import csv
+import os
+import sys
+
+
+def read_metrics(path):
+    steps, loss, avg, lr = [], [], [], []
+    with open(path, newline="") as f:
+        for row in csv.DictReader(f):
+            # parse the whole row first, append only on full success — a
+            # truncated tail row (killed training mid-write) must not
+            # leave the series desynchronized or crash on float(None)
+            try:
+                s = int(row["step"])
+                lo = float(row["loss"])
+                av = float(row.get("avg_loss") or lo)
+                r = float(row.get("lr") or 0.0)
+            except (KeyError, ValueError, TypeError):
+                continue
+            steps.append(s)
+            loss.append(lo)
+            avg.append(av)
+            lr.append(r)
+    return steps, loss, avg, lr
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("csvs", nargs="+")
+    ap.add_argument("--out", default="loss_curve.png")
+    ap.add_argument("--title", default="training loss")
+    args = ap.parse_args(argv)
+
+    series = []
+    for path in args.csvs:
+        steps, loss, avg, lr = read_metrics(path)
+        if not steps:
+            print(f"warning: no rows in {path}", file=sys.stderr)
+            continue
+        name = os.path.splitext(os.path.basename(path))[0]
+        series.append((name, steps, loss, avg, lr))
+        print(f"{name}: {len(steps)} rows, loss {loss[0]:.4f} -> "
+              f"{loss[-1]:.4f} (ema {avg[-1]:.4f})")
+    if not series:
+        return 1
+
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except Exception as e:
+        print(f"matplotlib unavailable ({e}); text summary only",
+              file=sys.stderr)
+        return 0
+
+    fig, ax = plt.subplots(figsize=(8, 4.5))
+    ax2 = ax.twinx()
+    for name, steps, loss, avg, lr in series:
+        (line,) = ax.plot(steps, loss, alpha=0.3)
+        ax.plot(steps, avg, color=line.get_color(), label=name)
+        if any(lr):
+            ax2.plot(steps, lr, color=line.get_color(), linestyle=":",
+                     alpha=0.5)
+    ax.set_xlabel("optimizer step")
+    ax.set_ylabel("loss (faint: raw, solid: EMA)")
+    ax2.set_ylabel("learning rate (dotted)")
+    ax.set_title(args.title)
+    ax.legend(loc="upper right")
+    fig.tight_layout()
+    fig.savefig(args.out, dpi=120)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,82 @@
+"""scripts/ recipe guard: every --flag a shell script passes must exist on
+the CLI it invokes (catches parser/script drift without running the
+expensive recipes; the scripts themselves are smoke-run against tiny
+fixtures during verification, not in CI)."""
+
+import glob
+import os
+import re
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# script -> (CLI module(s) it drives, extra flags consumed by tools/)
+CLI_OF = {
+    "run_gpt2s_lora.sh": (["gpt2_lora_finetune", "eval_ppl"], set()),
+    "run_gpt2s_full.sh": (["gpt2_full_finetune"], set()),
+    "run_gpt2m_lora.sh": (["gpt2_lora_finetune"], set()),
+    "run_gemma270m_lora.sh": (["train_lora_gemma", "eval_ppl"], set()),
+    "run_gemma1b_lora_offload.sh": (["train_lora_gemma"], set()),
+    # --dump_dir belongs to tools/align_torch_mirror.py
+    "run_alignment_gpt2.sh": (["gpt2_lora_finetune"], {"--dump_dir"}),
+    "energy_benchmark.sh": (["gpt2_lora_finetune"], set()),
+}
+
+
+def parser_flags(cli_name):
+    import importlib
+    mod = importlib.import_module(f"mobilefinetuner_tpu.cli.{cli_name}")
+    p = mod.build_parser()
+    flags = set()
+    for a in p._actions:
+        flags.update(a.option_strings)
+    return flags
+
+
+def script_flags(path):
+    src = open(path).read()
+    # strip full-line AND trailing comments; collect --words used as flags
+    # (flags may contain digits/hyphens — match the full token)
+    lines = []
+    for ln in src.splitlines():
+        if ln.lstrip().startswith("#"):
+            continue
+        lines.append(re.sub(r"\s#.*$", "", ln))
+    return set(re.findall(r"(?<![\w-])(--[a-z0-9_-]+)", "\n".join(lines)))
+
+
+@pytest.mark.parametrize("script", sorted(CLI_OF))
+def test_script_flags_exist(script):
+    paths = glob.glob(os.path.join(REPO, "scripts", "*", script))
+    assert paths, f"{script} missing"
+    used = script_flags(paths[0])
+    clis, extra = CLI_OF[script]
+    known = set(extra)
+    for cli in clis:
+        known |= parser_flags(cli)
+    unknown = used - known
+    assert not unknown, (f"{script} passes flags no target CLI accepts: "
+                         f"{sorted(unknown)}")
+
+
+def test_all_scripts_bash_parse():
+    for sh in glob.glob(os.path.join(REPO, "scripts", "*", "*.sh")):
+        subprocess.run(["bash", "-n", sh], check=True)
+
+
+def test_plot_loss_runs_on_metrics_csv(tmp_path):
+    import sys
+    p = tmp_path / "m.csv"
+    p.write_text(
+        "timestamp,epoch,step,loss,avg_loss,lr,step_time_ms,hbm_mb\n"
+        "1,0,1,2.5,2.5,0.001,10,100\n"
+        "1,0,2,2.4,2.45,0.001,10,100\n"
+        "1,0,3\n")  # truncated tail row must be tolerated
+    out = tmp_path / "c.png"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "plot_loss.py"),
+         str(p), "--out", str(out)], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert out.exists()

@@ -24,9 +24,10 @@ is a compiled H2D transfer XLA can overlap with compute. The mapping:
                                     host arrays; XLA emits an async host->HBM
                                     dynamic-slice DMA it overlaps with the
                                     previous layer's compute
-  LRU eviction                      static largest-first spill plan (the
-                                    whole step's working set is known at
-                                    trace time — no runtime eviction needed)
+  LRU eviction                      static spill plan, streamable stacks
+                                    first then largest-first (the whole
+                                    step's working set is known at trace
+                                    time — no runtime eviction needed)
   offload_all()                     apply_placement(...)
   owner_ptr nulling                 functional pytrees: the host copy IS the
                                     storage; nothing to null
@@ -34,11 +35,26 @@ is a compiled H2D transfer XLA can overlap with compute. The mapping:
 Peak-HBM semantics: `fetch` pulls the whole tree, so fetched weights are
 device-resident for the entire step — the budget then governs only idle
 placement. `fetch_layer` is the reference's actual working-set bound
-(parameter_sharder.cpp:242-271): only ~one layer of offloaded weights is
-HBM-resident at a time, provided the layer scan body is rematerialized
+(parameter_sharder.cpp:242-271): only ~one-two layers of offloaded weights
+are HBM-resident at a time, provided the layer scan body is rematerialized
 (jax.checkpoint) so the backward re-fetches instead of keeping every
 layer's weights alive as saved residuals. The model forwards handle both
 (models/gpt2.py, models/gemma3.py `offload=` argument).
+
+Overlap engineering note (measured, v5e round 3): XLA's while-loop double
+buffering already pipelines each iteration's host->HBM dynamic-slice DMA
+behind the adjacent iteration's compute. An explicit double-buffer —
+carrying prefetched layer-(i+d) weights through the scan carry under a
+custom_vjp so the backward could re-fetch in reverse with the same
+pipeline — measured STRICTLY WORSE (gpt2s budget-0: 120k vs 140k tok/s;
+gemma-1B stream B=32: 12.9k vs 15.9k), because an HLO while-loop carry is
+a concrete value: every prefetch issued in iteration i must COMPLETE in
+iteration i to form the carry, so the manual pipeline only reorders waits
+while defeating the compiler's own transfer pipelining (and lax.scan
+unroll=2 was neutral-to-worse as well). The levers that do cut streaming
+overhead are placement (spill streamable >=3-D stacks before whole-fetch
+leaves — plan_placement below) and batch amortization (bench.py offload
+B=32 rows: overhead vs same-batch resident within noise).
 
 Budget semantics are strict (test_sharder_strict.cpp analog): the PLANNED
 resident set never exceeds `max_resident_bytes`. The reference must auto-raise
@@ -88,18 +104,70 @@ def _leaf_bytes(x, dtype=None) -> int:
     return int(np.prod(np.shape(x))) * d.itemsize
 
 
-def plan_placement(params, config: OffloadConfig) -> Any:
+def is_streamable(x) -> bool:
+    """Leaf-level half of the streaming predicate: >=3-D [L, in, out]
+    stacks. 2-D stacks (biases/norms) and plain 2-D tables (embeddings)
+    are fetched whole — both because their per-layer slices hit the TPU
+    host-DMA small-transfer limitation (see resolve_offload) and because a
+    whole-tensor fetch is a serial transfer the placement plan should
+    treat as expensive. The FULL predicate is positional too: only leaves
+    under the model tree's `blocks` entry stream (resolve_offload fetches
+    every top-level leaf whole), so plan_placement / streams_only_budget
+    combine this with a blocks_key path check via _streamable_mask."""
+    return np.ndim(x) >= 3
+
+
+def _streamable_mask(params, blocks_key):
+    """(flat streamable flags, flat leaves, treedef) for a model tree:
+    a leaf is streamable iff it sits under `blocks_key` AND is_streamable.
+    Trees without a `blocks_key` entry (generic pytrees) get all-False —
+    plan_placement then degrades to pure largest-first."""
+    paths, treedef = jax.tree_util.tree_flatten_with_path(params)
+    flags = [len(p) > 0 and getattr(p[0], "key", None) == blocks_key
+             and is_streamable(x) for p, x in paths]
+    return flags, [x for _, x in paths], treedef
+
+
+def streams_only_budget(params, min_offload_size: int = None,
+                        blocks_key: str = "blocks") -> int:
+    """The intermediate-budget point on the overhead/residency curve: the
+    smallest budget whose plan spills ONLY streamable leaves (those
+    >= min_offload_size — smaller ones can never spill), keeping every
+    whole-fetch leaf (embedding table, norms, biases) HBM-resident so no
+    serial whole-tensor transfer lands on the step's critical path."""
+    if min_offload_size is None:
+        min_offload_size = OffloadConfig.min_offload_size
+    flags, leaves, _ = _streamable_mask(params, blocks_key)
+    total = spill = 0
+    for x, streamable in zip(leaves, flags):
+        b = _leaf_bytes(x)
+        total += b
+        if streamable and b >= min_offload_size:
+            spill += b
+    return total - spill
+
+
+def plan_placement(params, config: OffloadConfig,
+                   blocks_key: str = "blocks") -> Any:
     """Pytree of bool: True = offload this leaf to host RAM.
 
-    Greedy largest-first spill: keep everything resident if it fits;
-    otherwise offload the largest parameters until the resident set is
-    under budget. Large weights amortize transfer latency best (XLA can
-    overlap the H2D prefetch of layer i+1 with layer i's compute under
-    lax.scan), so spilling big-first both meets the budget with the fewest
-    transfers and hides them best — where the reference's LRU had to guess,
-    the static plan knows the whole step's access pattern.
+    Greedy spill, streamable-first then largest-first: keep everything
+    resident if it fits; otherwise offload until the resident set is under
+    budget, preferring streamable leaves (>=3-D [L, in, out] stacks under
+    `blocks_key` — _streamable_mask). Those are the leaves resolve_offload
+    streams one layer at a time inside the scan, where XLA's while-loop
+    double buffering hides the H2D DMA behind the adjacent layers' compute
+    — so their spill is cheap. Whole-fetch leaves (embedding tables,
+    stacked biases/norms, anything outside `blocks_key`) cost a serial
+    transfer on the step's critical path (measured on v5e: the host link
+    is latency-bound, ~2 GiB/s for a single stream vs ~8 GiB/s for the
+    concurrent per-layer leaf fetches), so they spill only when the
+    streamable leaves alone cannot meet the budget. Within each class,
+    largest-first meets the budget with the fewest transfers — where the
+    reference's LRU had to guess, the static plan knows the whole step's
+    access pattern.
     """
-    leaves, treedef = jax.tree.flatten(params)
+    streamable, leaves, treedef = _streamable_mask(params, blocks_key)
     if not config.enable:
         return jax.tree.unflatten(treedef, [False] * len(leaves))
     sizes = [_leaf_bytes(x) for x in leaves]
@@ -107,7 +175,8 @@ def plan_placement(params, config: OffloadConfig) -> Any:
     budget = config.max_resident_bytes
     offload = [False] * len(leaves)
     resident = total
-    order = sorted(range(len(leaves)), key=lambda i: -sizes[i])
+    order = sorted(range(len(leaves)),
+                   key=lambda i: (not streamable[i], -sizes[i]))
     for i in order:
         if resident <= budget:
             break
@@ -244,16 +313,16 @@ def resolve_offload(params, offload, blocks_key: str = "blocks"):
                 {k: shardings[k] for k in top})
     blocks, bplan, bshard = (params[blocks_key], plan[blocks_key],
                              shardings[blocks_key])
-    # Only >=3-D stacks ([L, in, out] weights) stream per layer. 2-D
-    # stacks (biases/norms, [L, n]) are fetched whole up front: their
-    # per-layer slices would be 1-row transfers, which the TPU host-DMA
-    # path rejects at larger n (observed on v5e: [2304] and [1, 2304]
-    # host->device dynamic slices fail with INTERNAL while [768, 2304]
-    # works), and all of a model's 2-D stacks together are <1% of its
-    # bytes — streaming them would save nothing.
-    whole = jax.tree.map(lambda t, o: bool(o) and jnp.ndim(t) <= 2,
+    # Only streamable leaves (>=3-D [L, in, out] stacks — is_streamable)
+    # stream per layer. 2-D stacks (biases/norms, [L, n]) are fetched whole
+    # up front: their per-layer slices would be 1-row transfers, which the
+    # TPU host-DMA path rejects at larger n (observed on v5e: [2304] and
+    # [1, 2304] host->device dynamic slices fail with INTERNAL while
+    # [768, 2304] works), and all of a model's 2-D stacks together are <1%
+    # of its bytes — streaming them would save nothing.
+    whole = jax.tree.map(lambda t, o: bool(o) and not is_streamable(t),
                          blocks, bplan)
-    stream_plan = jax.tree.map(lambda t, o: bool(o) and jnp.ndim(t) >= 3,
+    stream_plan = jax.tree.map(lambda t, o: bool(o) and is_streamable(t),
                                blocks, bplan)
     blocks = fetch(blocks, whole, bshard)
     params = dict(top, **{blocks_key: blocks})

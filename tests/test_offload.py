@@ -251,3 +251,83 @@ def test_offload_composes_with_fsdp_mesh():
     assert not placed["w"].sharding.is_fully_replicated  # still FSDP-sharded
     back = fetch(placed, plan, shardings)
     np.testing.assert_array_equal(np.asarray(back["w"]), np.ones((256, 64)))
+
+
+def test_gemma_streamed_lora_grads_match_resident():
+    """Gemma-3 per-layer streaming (budget 0): forward and LoRA grads match
+    the fully-resident path (gpt2 analog above; this covers the gemma block
+    wiring through layer_slicer/fetch_layer)."""
+    from mobilefinetuner_tpu.core.config import Gemma3TextConfig
+    from mobilefinetuner_tpu.lora.lora import LoRASpec, init_lora_gemma3
+    from mobilefinetuner_tpu.models import gemma3
+    from mobilefinetuner_tpu.ops.loss import lm_cross_entropy_sum
+
+    config = Gemma3TextConfig.tiny()
+    params = gemma3.init_params(config, jax.random.PRNGKey(0))
+    cfg = OffloadConfig(enable=True, max_resident_bytes=0,
+                        offload_dtype="float32", min_offload_size=1024)
+    plan = plan_placement(params, cfg)
+    sh = replicated_sharding(make_mesh(1, 1, devices=jax.devices()[:1]))
+    shardings = jax.tree.map(lambda _: sh, params)
+    placed = apply_placement(params, plan, shardings, cfg)
+    offload = (plan, shardings)
+    spec = LoRASpec(rank=4, alpha=8.0, targets="attn")
+    lora = init_lora_gemma3(config, spec, jax.random.PRNGKey(7))
+    ids = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0,
+                             config.vocab_size)
+    labels = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0,
+                                config.vocab_size)
+
+    def loss(lora_t, p, off):
+        logits = gemma3.forward(config, p, ids, lora=lora_t, offload=off)
+        s, w = lm_cross_entropy_sum(logits, labels)
+        return s / w
+
+    f_ref = jax.jit(lambda l: loss(l, params, None))
+    f_str = jax.jit(lambda l: loss(l, placed, offload))
+    np.testing.assert_allclose(np.asarray(f_str(lora)),
+                               np.asarray(f_ref(lora)), rtol=1e-5)
+    g_ref = jax.jit(jax.grad(lambda l: loss(l, params, None)))(lora)
+    g_str = jax.jit(jax.grad(lambda l: loss(l, placed, offload)))(lora)
+    for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_str)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_plan_spills_streamable_stacks_before_whole_fetch_leaves():
+    """Placement prefers >=3-D layer stacks (streamed per layer, DMA
+    overlapped by XLA's while-loop double buffering) over 2-D whole-fetch
+    leaves like the embedding table (a serial transfer on the step's
+    critical path): at an intermediate budget, the big 2-D leaf stays
+    resident even though it is the largest."""
+    t = {"embed": jnp.ones((1024, 64), jnp.float32),        # 256 KiB, 2-D
+         "blocks": {
+             "stack": jnp.ones((4, 64, 128), jnp.float32),  # 128 KiB, 3-D
+             "stack2": jnp.ones((4, 32, 64), jnp.float32),  # 32 KiB, 3-D
+         },
+         # a >=3-D leaf OUTSIDE blocks is whole-fetched by resolve_offload,
+         # so the planner must NOT prefer it over keeping embed resident
+         "loose3d": jnp.ones((4, 16, 32), jnp.float32)}     # 8 KiB, 3-D
+    cfg = OffloadConfig(enable=True, max_resident_bytes=288 * 1024,
+                        min_offload_size=1024)
+    plan = plan_placement(t, cfg)
+    # spilling both stacks (160 KiB; 424 - 160 = 264 KiB resident) meets
+    # the 288 KiB budget without touching embed or the loose 3-D leaf,
+    # even though embed is the largest leaf
+    assert plan == {"embed": False, "loose3d": False,
+                    "blocks": {"stack": True, "stack2": True}}
+    # but when the budget cannot be met by streamable stacks alone, the
+    # whole-fetch leaves spill too (largest first)
+    cfg2 = OffloadConfig(enable=True, max_resident_bytes=100 * 1024,
+                         min_offload_size=1024)
+    plan2 = plan_placement(t, cfg2)
+    assert plan2["embed"] is True
+
+    from mobilefinetuner_tpu.parallel.offload import streams_only_budget
+    b = streams_only_budget(t, min_offload_size=1024)
+    assert b == (256 + 8) * 1024  # embed + loose3d stay resident
+    plan3 = plan_placement(t, OffloadConfig(enable=True,
+                                            max_resident_bytes=b,
+                                            min_offload_size=1024))
+    assert plan3 == {"embed": False, "loose3d": False,
+                     "blocks": {"stack": True, "stack2": True}}

@@ -82,8 +82,20 @@ class SafeTensorsReader:
             self._blob = None
             return
         with open(path, "rb") as f:
-            (header_len,) = struct.unpack("<Q", f.read(8))
-            header = json.loads(f.read(header_len).decode("utf-8"))
+            # malformed files raise ValueError from BOTH backends (the
+            # native reader's st_error path raises ValueError above):
+            # struct.error on a truncated length prefix is the one stdlib
+            # type here that is NOT already a ValueError subclass
+            # (json.JSONDecodeError and UnicodeDecodeError are).
+            try:
+                (header_len,) = struct.unpack("<Q", f.read(8))
+                header = json.loads(f.read(header_len).decode("utf-8"))
+            except (struct.error, ValueError) as e:
+                raise ValueError(
+                    f"{path}: malformed safetensors header: {e}") from e
+            if not isinstance(header, dict):
+                raise ValueError(f"{path}: malformed safetensors header: "
+                                 f"not a JSON object")
         self.metadata: Dict[str, str] = header.pop("__metadata__", {}) or {}
         self.entries: Dict[str, dict] = header
         self._blob = np.memmap(path, dtype=np.uint8, mode="r",
@@ -123,21 +135,31 @@ class SafeTensorsReader:
         return {k: self.load(k, promote_to_f32) for k in self.entries}
 
 
-def _encode_tensor(name, arr, bf16_keys) -> Tuple[str, tuple, bytes]:
-    """(tag, shape, raw_bytes) for one tensor, shared by both writers."""
+def _tensor_spec(name, arr, bf16_keys):
+    """(tag, shape, nbytes, encode) for one tensor — the single source of
+    the dtype-tag/encoding rules for both writers. `encode()` materializes
+    the payload bytes; the streamed native writer calls it one tensor at a
+    time, so declarations never require encoding up front."""
     arr = np.asarray(arr)
     # jax bf16 arrays arrive as ml_dtypes.bfloat16 numpy arrays — store
     # them as BF16, not silently upcast to F32.
     is_bf16_input = arr.dtype.name == "bfloat16"
-    if is_bf16_input:
-        arr = arr.astype(np.float32)
+    shape = arr.shape
+    n = int(np.prod(shape, dtype=np.int64))
     if is_bf16_input or (bf16_keys and name in bf16_keys):
-        return ("BF16", arr.shape,
-                _f32_to_bf16_u16(arr.astype(np.float32)).tobytes())
-    if arr.dtype not in _TO_TAG:
-        arr = arr.astype(np.float32)
-    return (_TO_TAG[arr.dtype], arr.shape,
-            np.ascontiguousarray(arr).tobytes())
+        encode = lambda: _f32_to_bf16_u16(arr.astype(np.float32)).tobytes()
+        return "BF16", shape, n * 2, encode
+    dtype = arr.dtype if arr.dtype in _TO_TAG else np.dtype(np.float32)
+    encode = lambda: np.ascontiguousarray(arr.astype(dtype)
+                                          if arr.dtype != dtype
+                                          else arr).tobytes()
+    return _TO_TAG[dtype], shape, n * dtype.itemsize, encode
+
+
+def _encode_tensor(name, arr, bf16_keys) -> Tuple[str, tuple, bytes]:
+    """(tag, shape, raw_bytes) — eager form, used by the Python writer."""
+    tag, shape, _, encode = _tensor_spec(name, arr, bf16_keys)
+    return tag, shape, encode()
 
 
 def save_safetensors(path: str, tensors: Dict[str, np.ndarray],
@@ -151,10 +173,13 @@ def save_safetensors(path: str, tensors: Dict[str, np.ndarray],
     nat = _native_mod()
     if nat is not None:
         # real write failures (IOError) propagate — a disk that rejects
-        # the native writer would reject the Python writer too
+        # the native writer would reject the Python writer too. Payloads
+        # go in as callables: the native writer declares the header from
+        # (tag, shape, nbytes) and encodes ONE tensor at a time during the
+        # data pass, so peak host memory is a single tensor's bytes.
         nat.native_write(
             path,
-            [(name,) + _encode_tensor(name, arr, bf16_keys)
+            [(name,) + _tensor_spec(name, arr, bf16_keys)
              for name, arr in tensors.items()],
             metadata)
         return

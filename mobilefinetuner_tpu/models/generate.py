@@ -121,6 +121,10 @@ def gpt2_generate(config: GPT2Config, params, input_ids, attention_mask,
     """
     B, P = input_ids.shape
     N = cfg.max_new_tokens
+    if N <= 0:
+        # honor max_new_tokens=0 instead of silently emitting the prefill
+        # sample (the decode scan below always appends the carried token)
+        return jnp.zeros((B, 0), jnp.int32)
     T = P + N
     if T > config.n_positions:
         # learned absolute positions: an out-of-range wpe gather would
@@ -214,6 +218,9 @@ def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
     c = config
     B, P = input_ids.shape
     N = cfg.max_new_tokens
+    if N <= 0:
+        # honor max_new_tokens=0 (see gpt2_generate)
+        return jnp.zeros((B, 0), jnp.int32)
     T = P + N
     nq, nkv, D = c.num_attention_heads, c.num_key_value_heads, c.head_dim
     G = nq // nkv

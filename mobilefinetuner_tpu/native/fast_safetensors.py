@@ -76,9 +76,19 @@ def load_library() -> Optional[ctypes.CDLL]:
     return load_native_library(_SRC, _LIB, "MFT_NO_NATIVE_ST", _configure)
 
 
+class _MmapView(np.ndarray):
+    """ndarray subclass that pins the owning NativeReader alive: raw()
+    views point into the reader's mmap, so a view outliving a GC'd reader
+    would dangle — the `_owner` reference makes the mmap live at least as
+    long as the view. An EXPLICIT close() still invalidates outstanding
+    views (documented contract below)."""
+    _owner = None
+
+
 class NativeReader:
     """Parsed header + mmap'd blob. raw(name) returns a ZERO-COPY numpy
-    byte window into the mmap (valid until close)."""
+    byte window into the mmap — valid until an explicit close(); views
+    keep the reader (and its mmap) alive across garbage collection."""
 
     def __init__(self, path: str):
         lib = load_library()
@@ -126,13 +136,16 @@ class NativeReader:
 
     def raw(self, name: str) -> np.ndarray:
         """uint8 view of the tensor's bytes, zero-copy from the mmap."""
+        if not self._h:
+            raise ValueError("reader is closed")
         begin, end = self.entries[name]["data_offsets"]
         base = self._lib.st_blob(self._h)
         if not base:
             raise ValueError("no blob mapped")
         buf = (ctypes.c_uint8 * (end - begin)).from_address(
             ctypes.addressof(base.contents) + begin)
-        arr = np.frombuffer(buf, dtype=np.uint8)
+        arr = np.frombuffer(buf, dtype=np.uint8).view(_MmapView)
+        arr._owner = self  # pin the mmap for the view's lifetime
         arr.flags.writeable = False
         return arr
 
@@ -148,10 +161,15 @@ class NativeReader:
             pass
 
 
-def native_write(path: str, tensors: List[Tuple[str, str, tuple, bytes]],
+def native_write(path: str, tensors: List[tuple],
                  metadata: Optional[Dict[str, str]] = None) -> None:
-    """Write a safetensors file natively. tensors: [(name, tag, shape,
-    raw_bytes), ...] in final order. Raises on any writer error."""
+    """Write a safetensors file natively, streamed: tensors is a list of
+    (name, tag, shape, nbytes, payload) in final order, where payload is
+    either the raw bytes or a zero-arg callable returning them. The header
+    is written from the declarations alone (two-pass stw_declare/stw_data
+    protocol), and callable payloads are materialized ONE AT A TIME during
+    the data pass — peak host memory is a single encoded tensor, not the
+    whole checkpoint. Raises on any writer error."""
     lib = load_library()
     if lib is None:
         raise RuntimeError("native safetensors library unavailable")
@@ -161,13 +179,17 @@ def native_write(path: str, tensors: List[Tuple[str, str, tuple, bytes]],
             for k, v in metadata.items():
                 kb, vb = str(k).encode(), str(v).encode()
                 lib.stw_meta(h, kb, len(kb), vb, len(vb))
-        for name, tag, shape, raw in tensors:
+        for name, tag, shape, nbytes, _payload in tensors:
             sh = (ctypes.c_int64 * max(len(shape), 1))(*shape)
             nb = name.encode()
             if lib.stw_declare(h, nb, len(nb), tag.encode(), sh,
-                               len(shape), len(raw)) != 0:
+                               len(shape), nbytes) != 0:
                 raise IOError(lib.stw_error(h).decode())
-        for name, tag, shape, raw in tensors:
+        for name, tag, shape, nbytes, payload in tensors:
+            raw = payload() if callable(payload) else payload
+            if len(raw) != nbytes:
+                raise IOError(f"{name}: payload {len(raw)} bytes != "
+                              f"declared {nbytes}")
             if lib.stw_data(h, raw, len(raw)) != 0:
                 raise IOError(lib.stw_error(h).decode())
         if lib.stw_finish(h) != 0:

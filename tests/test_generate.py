@@ -206,3 +206,15 @@ def test_generate_cli_end_to_end(tmp_path):
     rec = json.loads(lines[-1])
     assert rec["prompt"] == "hello world"
     assert len(rec["ids"]) <= 4 and isinstance(rec["text"], str)
+
+
+def test_zero_new_tokens_returns_empty(gpt2_params, gemma_params):
+    """max_new_tokens=0 returns [B, 0] — no silent extra token from the
+    prefill sample."""
+    ids = jnp.array([[1, 2, 3], [4, 5, 6]], jnp.int32)
+    mask = jnp.ones_like(ids)
+    cfg = SampleConfig(max_new_tokens=0, greedy=True)
+    assert gpt2_generate(GPT2_CFG, gpt2_params, ids, mask,
+                         cfg).shape == (2, 0)
+    assert gemma3_generate(GEMMA_CFG, gemma_params, ids, mask,
+                           cfg).shape == (2, 0)

@@ -214,3 +214,57 @@ def test_checkpoint_roundtrip_through_native(tmp_path):
     r = st.SafeTensorsReader(p)
     np.testing.assert_array_equal(r.load("x"), tensors["x"])
     assert r.metadata == {"k": "v"}
+
+
+def test_raw_view_survives_reader_gc(tmp_path):
+    """A raw() view pins the reader's mmap: dropping the last explicit
+    reader reference (GC would otherwise munmap) must not dangle the
+    view's memory."""
+    import gc
+    p = str(tmp_path / "gc.safetensors")
+    arr = np.arange(1024, dtype=np.float32)
+    python_write(p, {"a": arr})
+    r = nst.NativeReader(p)
+    w = r.raw("a")
+    del r
+    gc.collect()
+    np.testing.assert_array_equal(w.view(np.float32), arr)
+
+
+def test_raw_after_close_raises(tmp_path):
+    p = str(tmp_path / "closed.safetensors")
+    python_write(p, {"a": np.arange(4, dtype=np.float32)})
+    r = nst.NativeReader(p)
+    r.close()
+    with pytest.raises(ValueError):
+        r.raw("a")
+
+
+def test_malformed_files_raise_valueerror_both_backends(tmp_path):
+    """API contract: malformed files raise ValueError regardless of which
+    backend parses them (the Python fallback used to leak struct.error /
+    json.JSONDecodeError)."""
+    import os
+    cases = {
+        "trunc_len.safetensors": b"\x05\x00\x00",          # short prefix
+        "bad_json.safetensors":
+            (8).to_bytes(8, "little") + b"not-json",
+        "not_object.safetensors":
+            (4).to_bytes(8, "little") + b"1234",           # JSON number
+    }
+    paths = []
+    for fname, blob in cases.items():
+        p = str(tmp_path / fname)
+        with open(p, "wb") as f:
+            f.write(blob)
+        paths.append(p)
+    for p in paths:
+        with pytest.raises(ValueError):
+            st.SafeTensorsReader(p)
+    os.environ["MFT_NO_NATIVE_ST"] = "1"
+    try:
+        for p in paths:
+            with pytest.raises(ValueError):
+                st.SafeTensorsReader(p)
+    finally:
+        del os.environ["MFT_NO_NATIVE_ST"]

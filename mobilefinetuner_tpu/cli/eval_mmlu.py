@@ -142,11 +142,14 @@ def main(argv=None) -> int:
         letter_encode_fn=letter_encode,
         batch_size=max(args.eval_batch, 1), max_len=max_len)
 
+    from mobilefinetuner_tpu.eval.mmlu_categories import category_rollup
+    categories = category_rollup(result)
     report = {
         "split": args.split, "fewshot": args.fewshot,
         "macro_accuracy": round(result.macro, 4),
         "micro_accuracy": round(result.micro, 4),
         "total_items": result.total,
+        "categories": categories,
         "per_subject": {r.subject: {"accuracy": round(r.accuracy, 4),
                                     "correct": r.correct, "total": r.total}
                         for r in result.per_subject},
@@ -154,12 +157,18 @@ def main(argv=None) -> int:
     for r in result.per_subject:
         log.info(f"  {r.subject}: {r.accuracy:.3f} "
                  f"({r.correct}/{r.total})")
+    for cat, c in categories.items():
+        log.info(f"  [{cat}] macro={c['macro_accuracy']:.3f} "
+                 f"micro={c['micro_accuracy']:.3f} "
+                 f"({c['correct']}/{c['total']}, {c['subjects']} subjects)")
     log.info(f"macro={result.macro:.4f} micro={result.micro:.4f}")
     if args.out:
         JSONLWriter(args.out).write(report)
     print(json.dumps({"macro_accuracy": report["macro_accuracy"],
                       "micro_accuracy": report["micro_accuracy"],
-                      "total_items": result.total}))
+                      "total_items": result.total,
+                      "categories": {c: v["macro_accuracy"]
+                                     for c, v in categories.items()}}))
     return 0
 
 

@@ -104,7 +104,16 @@ def _subject_from_filename(path: str) -> str:
 def read_mmlu_csv(path: str) -> List[MCQItem]:
     """Load one CSV; headered or headerless-Hendrycks layouts."""
     with open(path, encoding="utf-8") as f:
-        lines = [ln.rstrip("\n") for ln in f if ln.strip()]
+        return parse_mmlu_text(f.read(), _subject_from_filename(path),
+                               origin=path)
+
+
+def parse_mmlu_text(text: str, default_subject: str,
+                    origin: str = "<text>") -> List[MCQItem]:
+    """Parse MMLU CSV text (headered or headerless-Hendrycks) — the single
+    parser behind read_mmlu_csv and tools/mmlu_prep.py's zip ingestion, so
+    header detection cannot diverge between sources."""
+    lines = [ln.rstrip("\n") for ln in text.splitlines() if ln.strip()]
     if not lines:
         return []
     first = parse_csv_line(lines[0])
@@ -118,7 +127,7 @@ def read_mmlu_csv(path: str) -> List[MCQItem]:
     if looks_headered and not headered:
         missing = [n for n in required if n not in lowered]
         raise ValueError(
-            f"{path}: headered MMLU CSV is missing column(s) "
+            f"{origin}: headered MMLU CSV is missing column(s) "
             f"{missing}; need all of {list(required)}")
     items: List[MCQItem] = []
     if headered:
@@ -130,7 +139,7 @@ def read_mmlu_csv(path: str) -> List[MCQItem]:
             if len(f2) <= max(idx.values()):
                 continue
             subject = (f2[subj_idx].strip() if subj_idx is not None
-                       else _subject_from_filename(path)) or "unknown"
+                       else default_subject) or "unknown"
             ans = f2[idx["answer"]].strip()
             items.append(MCQItem(
                 subject=subject, question=f2[idx["question"]].strip(),
@@ -138,7 +147,7 @@ def read_mmlu_csv(path: str) -> List[MCQItem]:
                 C=f2[idx["c"]].strip(), D=f2[idx["d"]].strip(),
                 answer=(ans[:1].upper() or "A")))
     else:
-        subject = _subject_from_filename(path)
+        subject = default_subject
         for line in lines:
             f2 = parse_csv_line(line)
             if len(f2) < 6:

@@ -142,3 +142,104 @@ def test_batched_matches_itemwise(tmp_path):
         assert a.micro == b.micro and a.macro == b.macro
         assert [(r.subject, r.correct, r.total) for r in a.per_subject] \
             == [(r.subject, r.correct, r.total) for r in b.per_subject]
+
+
+def test_category_rollup_math():
+    """4-macro-category rollup (reference: hendrycks_test/categories.py):
+    macro = mean of member subjects' accuracies, micro = pooled items;
+    non-official subjects land in 'uncategorized'."""
+    from mobilefinetuner_tpu.eval.mmlu import MMLUResult, SubjectReport
+    from mobilefinetuner_tpu.eval.mmlu_categories import (
+        category_rollup, subject_macro_category)
+    assert subject_macro_category("college_physics") == "STEM"
+    assert subject_macro_category("jurisprudence") == "humanities"
+    assert subject_macro_category("sociology") == "social sciences"
+    assert subject_macro_category("marketing") == \
+        "other (business, health, misc.)"
+    assert subject_macro_category("klingon_opera") == "uncategorized"
+
+    rs = [SubjectReport("college_physics", 3, 4),   # 0.75 STEM
+          SubjectReport("abstract_algebra", 1, 4),  # 0.25 STEM
+          SubjectReport("sociology", 2, 2),         # 1.00 social sciences
+          SubjectReport("klingon_opera", 0, 2)]     # uncategorized
+    result = MMLUResult(rs, 0.0, 0.0, 12)
+    cats = category_rollup(result)
+    assert cats["STEM"] == {"macro_accuracy": 0.5,
+                            "micro_accuracy": 0.5,
+                            "subjects": 2, "correct": 4, "total": 8}
+    assert cats["social sciences"]["macro_accuracy"] == 1.0
+    assert cats["uncategorized"]["total"] == 2
+    assert "humanities" not in cats  # no evaluated subjects -> omitted
+
+
+def test_mmlu_prep_synthetic_and_zip_roundtrip(tmp_path):
+    """tools/mmlu_prep.py: synthetic mode covers the full 57-subject
+    taxonomy in Hendrycks layout; zip normalization re-emits the same
+    items (quoted fields survive)."""
+    import io
+    import json as json_mod
+    import subprocess
+    import sys
+    import zipfile
+
+    import contextlib
+    import importlib
+    spec = importlib.util.spec_from_file_location(
+        "mmlu_prep", os.path.join(os.path.dirname(__file__), "..",
+                                  "tools", "mmlu_prep.py"))
+    prep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(prep)
+
+    out1 = str(tmp_path / "synth")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert prep.main(["--synthetic", "4", "--out", out1]) == 0
+    rep = json_mod.loads(buf.getvalue())
+    assert rep["splits"]["test"] == {"subjects": 57, "items": 57 * 4}
+    assert rep["official_subjects_missing"] == []
+
+    by_subject = load_split(out1, "test")
+    assert len(by_subject) == 57
+    item = by_subject["abstract_algebra"][0]
+    assert item.answer in "ABCD"
+    assert '"' in item.question  # quoted key survived the CSV round trip
+
+    # zip -> normalized dir round trip preserves items
+    zpath = str(tmp_path / "src.zip")
+    with zipfile.ZipFile(zpath, "w") as z:
+        with open(os.path.join(out1, "test",
+                               "abstract_algebra_test.csv")) as f:
+            z.writestr("data/test/abstract_algebra_test.csv", f.read())
+    out2 = str(tmp_path / "fromzip")
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert prep.main(["--source", zpath, "--out", out2]) == 0
+    again = load_split(out2, "test")["abstract_algebra"]
+    orig = by_subject["abstract_algebra"]
+    assert [(i.question, i.A, i.B, i.C, i.D, i.answer) for i in again] == \
+        [(i.question, i.A, i.B, i.C, i.D, i.answer) for i in orig]
+
+
+def test_mmlu_prep_zip_headered_csv_no_junk_row(tmp_path):
+    """Headered CSVs inside a zip go through the runner's own header
+    detection — the header row must NOT become a dataset item (regression:
+    the zip branch used to parse rows blindly)."""
+    import contextlib
+    import importlib
+    import io
+    import zipfile
+    spec = importlib.util.spec_from_file_location(
+        "mmlu_prep2", os.path.join(os.path.dirname(__file__), "..",
+                                   "tools", "mmlu_prep.py"))
+    prep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(prep)
+    zpath = str(tmp_path / "h.zip")
+    with zipfile.ZipFile(zpath, "w") as z:
+        z.writestr("data/test/astronomy_test.csv",
+                   "question,a,b,c,d,answer\nWhat is 2+2?,1,2,3,4,D\n")
+    out = str(tmp_path / "out")
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert prep.main(["--source", zpath, "--out", out]) == 0
+    items = load_split(out, "test")["astronomy"]
+    assert len(items) == 1
+    assert items[0].question == "What is 2+2?"
+    assert items[0].answer == "D"

@@ -99,12 +99,17 @@ def dot_product_attention(
 
 def resolve_impl(S: int, D: int) -> str:
     """The 'auto' dispatch rule, from TPU v5e measurements
-    (tools/bench_attention_v5e.json): the flash kernel wins 1.5-3× (fwd
-    and fwd+bwd) from S >= 1024 at small head dim (GPT-2, D=64) and from
-    S >= 2048 at large head dim (Gemma, D=256), thanks to causal/sliding-
-    window block skipping; XLA's fused attention keeps a slight edge below
-    those sizes. Shared by attention() and the model blocks that branch on
-    the impl themselves (models/gemma3.py) — retune in ONE place.
+    (tools/bench_attention_v5e.json, re-measured round 3): the flash
+    kernel wins 1.7-2.8× (fwd and fwd+bwd) from S >= 1024 at small head
+    dim (GPT-2, D=64) and from S >= 2048 at large head dim (Gemma-270M/1B
+    GQA layout, D=256 — re-benched at S=1024: 0.92-0.98×, XLA keeps the
+    edge, so the threshold stays), thanks to causal/sliding-window block
+    skipping. With train-mode attention dropout the gap explodes (4.6× at
+    S=1024, 6.6× at S=2048): the XLA path materializes + RNGs a
+    [B, H, S, S] probs mask while the kernel hashes its keep bits
+    in-register (flash_attention.py _keep_mask). Shared by attention()
+    and the model blocks that branch on the impl themselves
+    (models/gemma3.py) — retune in ONE place.
     """
     return "flash" if S >= (1024 if D <= 128 else 2048) else "xla"
 
@@ -115,14 +120,14 @@ def attention(q, k, v, *, impl: str = "auto", **kwargs):
     impl='auto' picks per shape (resolve_impl); 'flash' / 'xla' force the
     respective path.
     """
-    if kwargs.get("attn_dropout", 0.0) > 0.0 \
-            and kwargs.get("attn_dropout_rng") is not None:
-        # probs-dropout has no flash-kernel support; train-mode attention
-        # dropout always takes the XLA path
-        impl = "xla"
-    else:
+    if not (kwargs.get("attn_dropout", 0.0) > 0.0
+            and kwargs.get("attn_dropout_rng") is not None):
         kwargs.pop("attn_dropout", None)
         kwargs.pop("attn_dropout_rng", None)
+    # (train-mode probs dropout is supported by BOTH impls: the flash
+    # kernels generate the mask in-kernel from a counter-based hash —
+    # see flash_attention.py _keep_mask — so dropout no longer forces the
+    # XLA path)
     if impl == "auto":
         impl = resolve_impl(q.shape[2], q.shape[3])
     if impl == "flash":

@@ -118,10 +118,40 @@ def _block_mask(row0, col0, block_q, block_k, causal, window, pad_blk):
     return mask
 
 
+def _keep_mask(seed, b, h, row0, col0, block_q, block_k, p_drop):
+    """[BQ, BK] bool keep-mask for attention dropout on one tile.
+
+    Counter-based: a lowbias32-style integer mix of (seed, batch, head,
+    global row, global col) — each (b, h, i, j) cell's bit is a pure
+    function of its coordinates, so the forward and BOTH backward kernels
+    regenerate identical masks regardless of their different tile
+    iteration orders, with no [S, S] mask ever materialized. Plain 32-bit
+    jnp arithmetic (wrapping int32 mul/xor/shift), so hardware and
+    interpret mode agree bit-for-bit and the tests' numpy reimplementation
+    is exact (tests/test_flash_attention.py)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + row0
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + col0
+    x = (seed ^ (b * jnp.int32(-1640531527))        # 0x9E3779B9
+         ^ (h * jnp.int32(-2048144789)))            # 0x85EBCA6B
+    z = (x + rows * jnp.int32(-1028477387)          # 0xC2B2AE35
+         + cols * jnp.int32(668265263))             # 0x27D4EB2F
+    z = z ^ ((z >> 16) & 0xFFFF)
+    z = z * jnp.int32(0x7FEB352D)
+    z = z ^ ((z >> 15) & 0x1FFFF)
+    z = z * jnp.int32(-2073254261)                  # 0x846CA68B
+    z = z ^ ((z >> 16) & 0xFFFF)
+    # uniform u24 from the high bits; keep iff below the keep threshold
+    u24 = (z >> 8) & 0xFFFFFF
+    thresh = jnp.int32(round((1.0 - p_drop) * (1 << 24)))
+    return u24 < thresh
+
+
 # --------------------------------- forward ----------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, pad_ref, o_ref, lse_ref, *,
-                scale, block_q, block_k, causal, window, S):
+def _fwd_kernel(q_ref, k_ref, v_ref, pad_ref, seed_ref, o_ref, lse_ref, *,
+                scale, block_q, block_k, causal, window, S, p_drop):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
     qi = pl.program_id(2)
     row0 = qi * block_q
     q = q_ref[0, 0].astype(jnp.float32)           # [BQ, D]
@@ -137,9 +167,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, pad_ref, o_ref, lse_ref, *,
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.where(mask, jnp.exp(s - m_new), 0.0)        # [BQ, BK]
         alpha = jnp.exp(m - m_new)
+        # HF probs-dropout semantics: the softmax DENOMINATOR sums the
+        # undropped probs (l), only the value accumulation sees the
+        # dropped+rescaled weights — out = dropout(softmax(s)) @ v.
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if p_drop > 0.0:
+            keep = _keep_mask(seed_ref[0], b, h, row0, col0, block_q,
+                              k.shape[0], p_drop)
+            pv = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - p_drop))
+        else:
+            pv = p
         acc = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            pv, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l, acc
 
@@ -169,7 +208,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, pad_ref, o_ref, lse_ref, *,
     lse_ref[0, 0] = m + jnp.log(l_safe)            # [BQ, 1]
 
 
-def _fwd(q, k, v, padding_mask, *, scale, causal, window, block_q, block_k):
+def _fwd(q, k, v, padding_mask, seed, *, scale, causal, window, block_q,
+         block_k, p_drop=0.0):
     B, Hq, S, D = q.shape
     Hkv = k.shape[1]
     G = Hq // Hkv
@@ -177,7 +217,7 @@ def _fwd(q, k, v, padding_mask, *, scale, causal, window, block_q, block_k):
     pad3 = padding_mask.reshape(B, 1, S)
     kernel = functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
                                block_k=block_k, causal=causal,
-                               window=window, S=S)
+                               window=window, S=S, p_drop=p_drop)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -191,6 +231,7 @@ def _fwd(q, k, v, padding_mask, *, scale, causal, window, block_q, block_k):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D),
@@ -206,14 +247,17 @@ def _fwd(q, k, v, padding_mask, *, scale, causal, window, block_q, block_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel")),
         interpret=_interpret(),
-    )(q, k, v, pad3)
+    )(q, k, v, pad3, seed)
     return out, lse
 
 
 # --------------------------------- backward ---------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, pad_ref, lse_ref, delta_ref, do_ref,
-               dq_ref, *, scale, block_q, block_k, causal, window, S):
+def _dq_kernel(q_ref, k_ref, v_ref, pad_ref, seed_ref, lse_ref, delta_ref,
+               do_ref, dq_ref, *, scale, block_q, block_k, causal, window,
+               S, p_drop):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
     qi = pl.program_id(2)
     row0 = qi * block_q
     q = q_ref[0, 0].astype(jnp.float32)            # [BQ, D]
@@ -230,6 +274,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, pad_ref, lse_ref, delta_ref, do_ref,
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)          # [BQ, BK]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if p_drop > 0.0:
+            # regenerate the forward's keep mask for this tile; with
+            # probs-dropout, Δ = rowsum(dO∘O) already equals
+            # Σ_k p_ik·(m/keep·dp)_ik, so ds = p∘(dp∘m/keep − Δ)
+            keep = _keep_mask(seed_ref[0], b, h, row0, col0, block_q,
+                              k.shape[0], p_drop)
+            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - p_drop))
         ds = p * (dp - delta) * scale
         return dq + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -255,9 +306,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, pad_ref, lse_ref, delta_ref, do_ref,
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, pad_ref, lse_ref, delta_ref, do_ref,
-                dk_ref, dv_ref, *, scale, block_q, block_k, causal, window,
-                S, G):
+def _dkv_kernel(q_ref, k_ref, v_ref, pad_ref, seed_ref, lse_ref, delta_ref,
+                do_ref, dk_ref, dv_ref, *, scale, block_q, block_k, causal,
+                window, S, G, p_drop):
+    b = pl.program_id(0)
     ki = pl.program_id(1)
     h = pl.program_id(2)
     col0 = ki * block_k
@@ -288,11 +340,20 @@ def _dkv_kernel(q_ref, k_ref, v_ref, pad_ref, lse_ref, delta_ref, do_ref,
         mask = _block_mask(row0, col0, block_q, block_k, causal, window,
                            pad)
         p = jnp.where(mask, jnp.exp(s - lseb), 0.0)         # [BQ, BK]
+        if p_drop > 0.0:
+            keep = _keep_mask(seed_ref[0], b, h, row0, col0, block_q,
+                              block_k, p_drop)
+            inv_keep = 1.0 / (1.0 - p_drop)
+            pv = jnp.where(keep, p, 0.0) * inv_keep  # dropped+rescaled p̃
+        else:
+            pv = p
         dv = dv + jax.lax.dot_general(
-            p, dob, (((0,), (0,)), ((), ())),
+            pv, dob, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # [BK, D]
         dp = jax.lax.dot_general(dob, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if p_drop > 0.0:
+            dp = jnp.where(keep, dp, 0.0) * inv_keep
         ds = p * (dp - deltab) * scale                      # [BQ, BK]
         dk = dk + jax.lax.dot_general(
             ds, qb, (((0,), (0,)), ((), ())),
@@ -319,20 +380,27 @@ def _dkv_kernel(q_ref, k_ref, v_ref, pad_ref, lse_ref, delta_ref, do_ref,
             dv_ref[0, 0] += dv
 
 
-def _bwd(scale, causal, window, block_q, block_k, res, g):
-    q, k, v, padding_mask, out, lse = res
+def _bwd(scale, causal, window, block_q, block_k, res, g, dlse=None,
+         p_drop=0.0):
+    q, k, v, padding_mask, seed, out, lse = res
     do = g
     B, Hq, S, D = q.shape
     Hkv = k.shape[1]
     G = Hq // Hkv
     pad3 = padding_mask.reshape(B, 1, S)
-    # Δ = rowsum(dO ∘ O): one fused XLA pass, shared by both kernels
+    # Δ = rowsum(dO ∘ O): one fused XLA pass, shared by both kernels.
+    # A joint (out, lse) cotangent (the ring-attention partials) folds in
+    # exactly here: ∂lse/∂s_ij = p_ij, so ds_ij = p_ij(dO·v_j − Δ_i +
+    # dlse_i) — i.e. Δ ← Δ − dlse, with dv untouched (∂lse/∂v = 0). The
+    # kernels themselves are unchanged.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     dq_kernel = functools.partial(
         _dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
-        causal=causal, window=window, S=S)
+        causal=causal, window=window, S=S, p_drop=p_drop)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(B, Hq, S // block_q),
@@ -346,6 +414,7 @@ def _bwd(scale, causal, window, block_q, block_k, res, g):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0),
@@ -361,11 +430,11 @@ def _bwd(scale, causal, window, block_q, block_k, res, g):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel")),
         interpret=_interpret(),
-    )(q, k, v, pad3, lse, delta, do)
+    )(q, k, v, pad3, seed, lse, delta, do)
 
     dkv_kernel = functools.partial(
         _dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
-        causal=causal, window=window, S=S, G=G)
+        causal=causal, window=window, S=S, G=G, p_drop=p_drop)
     # head dim innermost: a kv-head's G q-heads hit the same dk/dv block on
     # consecutive steps (safe accumulate); fully parallel when G == 1
     dk, dv = pl.pallas_call(
@@ -382,6 +451,7 @@ def _bwd(scale, causal, window, block_q, block_k, res, g):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, block_k), lambda b, i, h: (b, 0, i),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, S, 1), lambda b, i, h: (b, h, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, S, 1), lambda b, i, h: (b, h, 0, 0),
@@ -405,31 +475,108 @@ def _bwd(scale, causal, window, block_q, block_k, res, g):
             dimension_semantics=("parallel", "parallel",
                                  "parallel" if G == 1 else "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v, pad3, lse, delta, do)
-    return dq, dk.astype(k.dtype), dv.astype(v.dtype), None
+    )(q, k, v, pad3, seed, lse, delta, do)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype), None, None
 
 
 # ------------------------------- public API ---------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, padding_mask, scale, causal, window, block_q, block_k):
-    out, _ = _fwd(q, k, v, padding_mask, scale=scale, causal=causal,
-                  window=window, block_q=block_q, block_k=block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, padding_mask, seed, scale, causal, window, block_q,
+           block_k, p_drop):
+    out, _ = _fwd(q, k, v, padding_mask, seed, scale=scale, causal=causal,
+                  window=window, block_q=block_q, block_k=block_k,
+                  p_drop=p_drop)
     return out
 
 
-def _flash_fwd(q, k, v, padding_mask, scale, causal, window, block_q,
-               block_k):
-    out, lse = _fwd(q, k, v, padding_mask, scale=scale, causal=causal,
-                    window=window, block_q=block_q, block_k=block_k)
-    return out, (q, k, v, padding_mask, out, lse)
+def _flash_fwd(q, k, v, padding_mask, seed, scale, causal, window, block_q,
+               block_k, p_drop):
+    out, lse = _fwd(q, k, v, padding_mask, seed, scale=scale, causal=causal,
+                    window=window, block_q=block_q, block_k=block_k,
+                    p_drop=p_drop)
+    return out, (q, k, v, padding_mask, seed, out, lse)
 
 
-def _flash_bwd(scale, causal, window, block_q, block_k, res, g):
-    return _bwd(scale, causal, window, block_q, block_k, res, g)
+def _flash_bwd(scale, causal, window, block_q, block_k, p_drop, res, g):
+    return _bwd(scale, causal, window, block_q, block_k, res, g,
+                p_drop=p_drop)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_lse(q, k, v, padding_mask, seed, scale, causal, window, block_q,
+               block_k):
+    """(out, lse) with gradients through BOTH outputs — the online-softmax
+    partial for ring attention's cross-device merge. No dropout: partials
+    compose across devices, and dropout on a renormalized merge would
+    change semantics — the ring path is eval/long-context training where
+    attention dropout is off."""
+    return _fwd(q, k, v, padding_mask, seed, scale=scale, causal=causal,
+                window=window, block_q=block_q, block_k=block_k)
+
+
+def _flash_lse_fwd(q, k, v, padding_mask, seed, scale, causal, window,
+                   block_q, block_k):
+    out, lse = _fwd(q, k, v, padding_mask, seed, scale=scale, causal=causal,
+                    window=window, block_q=block_q, block_k=block_k)
+    return (out, lse), (q, k, v, padding_mask, seed, out, lse)
+
+
+def _flash_lse_bwd(scale, causal, window, block_q, block_k, res, g):
+    do, dlse = g
+    return _bwd(scale, causal, window, block_q, block_k, res, do,
+                dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_partial_eligible(S: int, D: int) -> bool:
+    """Can flash_attention_partial serve a [.., S, D] shard? (The ring
+    dispatcher asks this OUTSIDE shard_map, where the decision must be
+    static.)"""
+    return D in (64, 128, 256) and _valid_blocks(S, 512, 512) is not None
+
+
+def flash_attention_partial(q, k, v, padding_mask=None, *,
+                            scale: Optional[float] = None,
+                            is_causal: bool = True,
+                            sliding_window: Optional[int] = None,
+                            block_q: int = 512, block_k: int = 512):
+    """Partial-attention stats (out, lse) for online-softmax composition
+    (parallel/ring_attention.py), or None when the shape is not
+    kernel-eligible (caller falls back to its dense path).
+
+    Unlike flash_attention, causal and sliding_window are INDEPENDENT
+    here: a ring hop t attends its queries against a K/V chunk sitting
+    t·S_chunk rows earlier, which is a non-causal band mask — expressed
+    as is_causal=False with sliding_window = window − t·S_chunk (negative
+    values shift the band above the local diagonal; the block-bounds and
+    mask arithmetic handle them as-is). Differentiable w.r.t. q/k/v
+    through BOTH out and lse (see _bwd's Δ−dlse folding)."""
+    B, Hq, S, D = q.shape
+    if D not in (64, 128, 256) or k.shape[2] != S:
+        return None
+    picked = _valid_blocks(S, block_q, block_k)
+    if _interpret() and S % block_q == 0 and S % block_k == 0:
+        picked = (block_q, block_k)
+    if picked is None:
+        return None
+    block_q, block_k = picked
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if padding_mask is None:
+        pad = jnp.ones((B, S), jnp.float32)
+    else:
+        pad = padding_mask.astype(jnp.float32)
+    return _flash_lse(q, k, v, pad, jnp.zeros((1,), jnp.int32),
+                      float(scale), bool(is_causal),
+                      None if sliding_window is None
+                      else int(sliding_window),
+                      int(block_q), int(block_k))
 
 
 def flash_attention(q, k, v, *,
@@ -439,6 +586,8 @@ def flash_attention(q, k, v, *,
                     padding_mask: Optional[jnp.ndarray] = None,
                     attn_mask: Optional[jnp.ndarray] = None,
                     logits_dtype=jnp.float32,
+                    attn_dropout: float = 0.0,
+                    attn_dropout_rng: Optional[jnp.ndarray] = None,
                     block_q: int = 512,
                     block_k: int = 512) -> jnp.ndarray:
     """Drop-in for ops.attention.dot_product_attention (same signature).
@@ -447,6 +596,15 @@ def flash_attention(q, k, v, *,
     kernel can exploit, so that case falls back to the XLA path — model code
     passes is_causal/sliding_window instead (gemma3 selects masks per layer
     by flags, not matrices, when using the flash impl).
+
+    attn_dropout (train-mode probs dropout, HF semantics): generated
+    INSIDE the kernels from a counter-based hash of (seed, b, h, row, col)
+    (_keep_mask) — no [.., S, S] mask is ever materialized, and the
+    backward kernels regenerate the identical mask from the same seed. The
+    keep decisions come from a different (hash-based) generator than the
+    XLA path's jax.random stream, so the two impls agree in DISTRIBUTION,
+    not per-mask — exactly like the reference's RNG vs ours. Dropout=0 or
+    rng=None compiles the dropout-free kernels (p_drop is static).
 
     Default blocks are 512×512 (clamped to S): measured on TPU v5e
     (tools/bench_attention.py), large blocks amortize the k-loop and win
@@ -473,13 +631,21 @@ def flash_attention(q, k, v, *,
         return dot_product_attention(
             q, k, v, scale=scale, is_causal=is_causal,
             sliding_window=sliding_window, padding_mask=padding_mask,
-            attn_mask=attn_mask, logits_dtype=logits_dtype)
+            attn_mask=attn_mask, logits_dtype=logits_dtype,
+            attn_dropout=attn_dropout,
+            attn_dropout_rng=attn_dropout_rng)
     if scale is None:
         scale = 1.0 / (D ** 0.5)
     if padding_mask is None:
         pad = jnp.ones((B, S), jnp.float32)
     else:
         pad = padding_mask.astype(jnp.float32)
-    return _flash(q, k, v, pad, float(scale), bool(is_causal),
+    p_drop = float(attn_dropout) if attn_dropout_rng is not None else 0.0
+    if p_drop > 0.0:
+        seed = jax.lax.bitcast_convert_type(
+            jax.random.bits(attn_dropout_rng, (1,), jnp.uint32), jnp.int32)
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
+    return _flash(q, k, v, pad, seed, float(scale), bool(is_causal),
                   None if sliding_window is None else int(sliding_window),
-                  int(block_q), int(block_k))
+                  int(block_q), int(block_k), p_drop)

@@ -59,6 +59,67 @@ def _chunk_attend(q, k, v, pad, row0, col0, scale, causal, window):
     return m, l, acc
 
 
+def _merge_lse(lse1, o1, lse2, o2):
+    """Online-softmax merge in (lse, out) form: logaddexp the lse's, and
+    weight each partial output by exp(lse_c − lse_new). NEG_INF is a
+    finite sentinel, so fully-masked partials merge to weight 0 without
+    producing NaN (−inf − −inf)."""
+    lse = jnp.logaddexp(lse1, lse2)
+    return lse, (o1 * jnp.exp(lse1 - lse) + o2 * jnp.exp(lse2 - lse))
+
+
+def _ring_hops(n: int, window, Sq: int) -> int:
+    """How many rotations the ring actually needs. Causal-only: n−1 (every
+    earlier chunk is visible). A sliding window w only reaches rows up to
+    w−1 columns back, so hop t (whose chunk sits t·Sq rows earlier) has
+    visible cells iff t·Sq − w < Sq, i.e. t ≤ (w−1)//Sq + 1 — chunks past
+    that never travel, saving both compute AND ppermute traffic."""
+    if window is None:
+        return n - 1
+    return min(n - 1, (int(window) - 1) // Sq + 1)
+
+
+def _ring_shard_flash(q, k, v, pad, *, axis, scale, window):
+    """Flash-kernel ring body: per-device memory is O(Sq·D) — scores only
+    ever exist blockwise in VMEM (ops/flash_attention.py), never as a
+    [.., Sq, Sk] tensor in HBM. The hop loop is unrolled so each hop's
+    mask is STATIC: hop t's chunk sits exactly t·Sq rows behind the local
+    queries, so the diagonal hop is plain causal(+window) and hop t ≥ 1 is
+    the non-causal band sliding_window = window − t·Sq (None = fully
+    visible). Wrap-around chunks (from devices AHEAD of this one) are
+    future tokens: computed in lockstep (SPMD — skipping wouldn't free the
+    step) and merged with weight 0 via an lse of NEG_INF. Gradients flow
+    through both out and lse of every partial (flash_attention_partial's
+    joint custom_vjp), so reverse-mode AD of the merge tree is exact."""
+    from mobilefinetuner_tpu.ops.flash_attention import \
+        flash_attention_partial
+
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    B, Hq, Sq, D = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    out, lse = flash_attention_partial(q, k, v, pad, scale=scale,
+                                       is_causal=True,
+                                       sliding_window=window)
+    out = out.astype(jnp.float32)
+    kc, vc, pc = k, v, pad
+    for t in range(1, _ring_hops(n, window, Sq) + 1):
+        kc = jax.lax.ppermute(kc, axis, perm)
+        vc = jax.lax.ppermute(vc, axis, perm)
+        pc = jax.lax.ppermute(pc, axis, perm)
+        weff = None if window is None else int(window) - t * Sq
+        o_c, lse_c = flash_attention_partial(q, kc, vc, pc, scale=scale,
+                                             is_causal=False,
+                                             sliding_window=weff)
+        # hop t carries the chunk of device idx−t; idx < t means it wrapped
+        # around from a device ahead — causally invisible
+        lse_c = jnp.where(idx >= t, lse_c, NEG_INF)
+        lse, out = _merge_lse(lse, out, lse_c,
+                              o_c.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 def _ring_shard(q, k, v, pad, *, axis, scale, causal, window):
     """Runs on each device inside shard_map: local Q stays, K/V/pad
     rotate; online-softmax merge across the n ring steps."""
@@ -128,10 +189,19 @@ def ring_attention(q, k, v, mesh: Mesh, *,
     ba = batch_axis if (batch_axis in mesh.axis_names) else None
     spec_s = P(ba, None, axis, None)     # batch + sequence sharded
     spec_p = P(ba, axis)
-    fn = partial(_ring_shard, axis=axis, scale=float(scale),
-                 causal=is_causal,
-                 window=None if sliding_window is None
-                 else int(sliding_window))
+    window = None if sliding_window is None else int(sliding_window)
+    # Flash-kernel ring body when the LOCAL shard shape is kernel-eligible
+    # (per-device scores stay blockwise in VMEM, O(Sq·D) HBM); the dense
+    # body is the fallback oracle for tiny/odd shapes and non-causal use.
+    from mobilefinetuner_tpu.ops.flash_attention import \
+        flash_partial_eligible
+    Sq = S // mesh.shape[axis]
+    if is_causal and flash_partial_eligible(Sq, D):
+        fn = partial(_ring_shard_flash, axis=axis, scale=float(scale),
+                     window=window)
+    else:
+        fn = partial(_ring_shard, axis=axis, scale=float(scale),
+                     causal=is_causal, window=window)
     return jax.shard_map(
         fn, mesh=mesh,
         in_specs=(spec_s, spec_s, spec_s, spec_p),

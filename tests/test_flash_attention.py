@@ -217,3 +217,145 @@ def test_flash_under_jit_and_scan():
     ref = dot_product_attention(q, k, v, is_causal=True, sliding_window=64)
     np.testing.assert_allclose(np.asarray(f(q, k, v)), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+# ------------------------- in-kernel attention dropout ----------------------
+
+def _np_keep_mask(seed, b, h, S, p_drop):
+    """Exact numpy reimplementation of flash_attention._keep_mask over the
+    full [S, S] grid (uint32 two's-complement arithmetic == the kernel's
+    wrapping int32)."""
+    rows = np.arange(S, dtype=np.uint32)[:, None] * np.uint32(1)
+    cols = np.arange(S, dtype=np.uint32)[None, :] * np.uint32(1)
+    with np.errstate(over="ignore"):
+        x = (np.uint32(seed & 0xFFFFFFFF)
+             ^ (np.uint32(b) * np.uint32(0x9E3779B9))
+             ^ (np.uint32(h) * np.uint32(0x85EBCA6B)))
+        z = (x + rows * np.uint32(0xC2B2AE35)
+             + cols * np.uint32(0x27D4EB2F))
+        z = z ^ (z >> np.uint32(16))
+        z = z * np.uint32(0x7FEB352D)
+        z = z ^ (z >> np.uint32(15))
+        z = z * np.uint32(0x846CA68B)
+        z = z ^ (z >> np.uint32(16))
+    u24 = (z >> np.uint32(8)) & np.uint32(0xFFFFFF)
+    thresh = np.uint32(round((1.0 - p_drop) * (1 << 24)))
+    return u24 < thresh
+
+
+def _masked_dropout_oracle(q, k, v, seed, p_drop, causal=True, window=None):
+    """Dense reference applying the EXACT kernel keep-mask: out =
+    dropout(softmax(s)) @ v with the hash-derived mask — jax throughout, so
+    jax.grad of this is the gradient oracle too."""
+    B, Hq, S, D = q.shape
+    Hkv = k.shape[1]
+    G = Hq // Hkv
+    scale = 1.0 / (D ** 0.5)
+    keep = np.stack([[_np_keep_mask(seed, b, h, S, p_drop)
+                      for h in range(Hq)] for b in range(B)])
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    qg = qf.reshape(B, Hkv, G, S, D)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kf) * scale
+    rows = np.arange(S)[:, None]
+    cols = np.arange(S)[None, :]
+    m = np.ones((S, S), bool)
+    if causal:
+        m &= cols <= rows
+    if window is not None:
+        m &= cols > rows - window
+    s = jnp.where(jnp.asarray(m)[None, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    keep_g = jnp.asarray(keep.reshape(B, Hkv, G, S, S))
+    pd = jnp.where(keep_g, p, 0.0) / (1.0 - p_drop)
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", pd, v.astype(jnp.float32))
+    return out.reshape(B, Hq, S, D)
+
+
+def test_dropout_forward_matches_hash_oracle():
+    """Kernel dropout == dense attention masked with the numpy-recomputed
+    hash mask: EXACT parity (not statistical), p=0.1 and p=0.5, causal and
+    sliding-window, multi-block."""
+    import mobilefinetuner_tpu.ops.flash_attention as fa
+    q, k, v = make_qkv(jax.random.PRNGKey(0), B=2, Hq=4, Hkv=2, S=128,
+                       D=64)
+    rng = jax.random.PRNGKey(42)
+    seed = int(np.asarray(jax.lax.bitcast_convert_type(
+        jax.random.bits(rng, (1,), jnp.uint32), jnp.int32))[0])
+    for p_drop in (0.1, 0.5):
+        for window in (None, 48):
+            out = flash_attention(q, k, v, attn_dropout=p_drop,
+                                  attn_dropout_rng=rng,
+                                  sliding_window=window,
+                                  block_q=64, block_k=64)
+            ref = _masked_dropout_oracle(q, k, v, seed, p_drop,
+                                         window=window)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=2e-5, rtol=2e-5,
+                                       err_msg=f"p={p_drop} w={window}")
+
+
+def test_dropout_gradients_match_hash_oracle():
+    """Backward with dropout: dq/dk/dv vs jax.grad of the dense
+    same-mask oracle — the dq and dkv kernels must regenerate the exact
+    forward mask."""
+    q, k, v = make_qkv(jax.random.PRNGKey(1), B=1, Hq=2, Hkv=1, S=128,
+                       D=64)
+    rng = jax.random.PRNGKey(7)
+    seed = int(np.asarray(jax.lax.bitcast_convert_type(
+        jax.random.bits(rng, (1,), jnp.uint32), jnp.int32))[0])
+    p_drop = 0.2
+
+    def loss_kernel(q, k, v):
+        out = flash_attention(q, k, v, attn_dropout=p_drop,
+                              attn_dropout_rng=rng, block_q=64,
+                              block_k=64)
+        return jnp.sum(out * jnp.cos(out))
+
+    def loss_ref(q, k, v):
+        out = _masked_dropout_oracle(q, k, v, seed, p_drop)
+        return jnp.sum(out * jnp.cos(out))
+
+    g_k = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_k, g_r, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5, err_msg=name)
+
+
+def test_dropout_keep_rate_and_determinism():
+    q, k, v = make_qkv(jax.random.PRNGKey(2), B=1, Hq=2, Hkv=2, S=128,
+                       D=64)
+    rng = jax.random.PRNGKey(3)
+    a = flash_attention(q, k, v, attn_dropout=0.3, attn_dropout_rng=rng)
+    b = flash_attention(q, k, v, attn_dropout=0.3, attn_dropout_rng=rng)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # same seed
+    c = flash_attention(q, k, v, attn_dropout=0.3,
+                        attn_dropout_rng=jax.random.PRNGKey(4))
+    assert np.abs(np.asarray(a) - np.asarray(c)).max() > 1e-3  # new mask
+    # empirical keep-rate of the raw hash, full grid
+    keep = _np_keep_mask(123456789, 0, 0, 512, 0.3)
+    rate = keep.mean()
+    assert abs(rate - 0.7) < 0.01, rate
+
+
+def test_dropout_zero_equals_no_dropout():
+    q, k, v = make_qkv(jax.random.PRNGKey(5), S=128, D=64)
+    base = flash_attention(q, k, v)
+    z = flash_attention(q, k, v, attn_dropout=0.0,
+                        attn_dropout_rng=jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(z))
+
+
+def test_attention_dispatcher_keeps_flash_with_dropout():
+    """Train-mode attention dropout no longer forces the XLA path: the
+    'flash' impl with dropout runs the kernel (and the auto rule is purely
+    shape-based)."""
+    from mobilefinetuner_tpu.ops.attention import attention
+    q, k, v = make_qkv(jax.random.PRNGKey(6), S=128, D=64)
+    rng = jax.random.PRNGKey(1)
+    out = attention(q, k, v, impl="flash", attn_dropout=0.25,
+                    attn_dropout_rng=rng)
+    # must differ from the dropout-free kernel result (mask engaged)
+    base = attention(q, k, v, impl="flash")
+    assert np.abs(np.asarray(out) - np.asarray(base)).max() > 1e-3

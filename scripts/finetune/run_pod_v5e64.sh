@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# v5e-64 pod recipe: GPT-2-small full fine-tune, FSDP over the pod
+# (BASELINE driver config "v5e-64 FSDP").
+#
+# A v5e-64 slice is 16 hosts x 4 chips. This script is what EACH host
+# runs; launch it on every worker at once, e.g.:
+#
+#   gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" \
+#       --worker=all --command "GPT2_DIR=... WT2_DIR=... \
+#           bash repo/scripts/finetune/run_pod_v5e64.sh"
+#
+# --multihost brings up jax.distributed with TPU-pod auto-detection (no
+# coordinator flags needed on a pod; off-pod, set JAX_COORDINATOR_ADDRESS/
+# JAX_NUM_PROCESSES/JAX_PROCESS_ID or --dist_coordinator per process —
+# tools/multihost_smoke.py demonstrates the explicit form at 8 procs x 8
+# devices on CPU). The DCN-aware hybrid mesh packs the fsdp axis inside
+# each host's ICI domain and lets the data axis cross hosts
+# (parallel/distributed.py make_hybrid_mesh); --mesh_fsdp 4 keeps param
+# all-gathers / grad reduce-scatters on ICI, and the data axis absorbs
+# the remaining 16x host dimension automatically (build_mesh resolves
+# data = devices/fsdp when --mesh_data is left at its default). Batch
+# below is GLOBAL (64 per chip x 64 chips would be 4096; 1024 keeps
+# S=128 steps short) and must divide data x fsdp. Every process reads
+# the same data dir; the input pipeline feeds each host only its
+# addressable shards.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+: "${GPT2_DIR:?set GPT2_DIR}" "${WT2_DIR:?set WT2_DIR}"
+OUT=${OUT:-out}; mkdir -p "$OUT"
+python -m mobilefinetuner_tpu.cli.gpt2_full_finetune \
+    --pretrained_dir "$GPT2_DIR" --data_dir "$WT2_DIR" \
+    --epochs 1 --batch_size 1024 --seq_len 128 --dtype bfloat16 \
+    --lr 2e-5 --warmup_ratio 0.03 \
+    --multihost --mesh_fsdp 4 \
+    --metrics_csv "$OUT/pod_v5e64_metrics.csv" \
+    --output_path "$OUT/pod_v5e64_full_ft.safetensors" "$@"

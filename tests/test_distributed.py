@@ -107,13 +107,12 @@ def test_shard_batch_routes_through_global_path():
     assert int(f(placed)) == 8 * 16
 
 
-def test_two_process_training_step_agrees():
-    """REAL multi-process validation: launch tools/multihost_smoke.py as
-    two coordinated processes (jax.distributed over CPU, 4 virtual devices
-    each -> a (2 procs × 4 dev) global mesh), run two FSDP LoRA optimizer
-    steps, and assert both processes converge to the SAME loss — which
-    requires the cross-process collectives (param all-gathers, grad
-    reductions) to have actually run."""
+def _launch_smoke(nprocs: int, ndev: int, timeout: int = 420):
+    """Launch tools/multihost_smoke.py as nprocs coordinated processes
+    (jax.distributed over CPU, ndev virtual devices each) and assert every
+    process converges to the SAME loss — which requires the cross-process
+    collectives (param all-gathers, grad reductions) to have actually
+    run."""
     import os
     import socket
     import subprocess
@@ -130,12 +129,12 @@ def test_two_process_training_step_agrees():
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     procs = [subprocess.Popen(
         [sys.executable, os.path.join(repo, "tools", "multihost_smoke.py"),
-         coord, "2", str(i), "4"],
+         coord, str(nprocs), str(i), str(ndev)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=env) for i in range(2)]
+        env=env) for i in range(nprocs)]
     outs = []
     for p in procs:
-        out, _ = p.communicate(timeout=420)
+        out, _ = p.communicate(timeout=timeout)
         outs.append(out)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out}"
@@ -144,6 +143,20 @@ def test_two_process_training_step_agrees():
               for out in outs for ln in out.splitlines()
               if "MULTIHOST_OK" in ln}
     assert len(losses) == 1, f"processes disagree: {losses}"
+
+
+def test_two_process_training_step_agrees():
+    """REAL multi-process validation at (2 procs × 4 dev)."""
+    _launch_smoke(nprocs=2, ndev=4)
+
+
+def test_four_process_hybrid_mesh_agrees():
+    """Four coordinated processes × 2 devices: the DCN-aware hybrid mesh
+    packs fsdp inside each process's slice and the data axis crosses all
+    four processes (the pod topology at CI scale; the 8-proc × 8-dev
+    v5e-64 shape runs as an artifact via tools/multihost_smoke.py and
+    the driver's dryrun_multichip(64))."""
+    _launch_smoke(nprocs=4, ndev=2)
 
 
 def test_shard_params_global_path():

@@ -88,12 +88,16 @@ def main():
         jax.sharding.PartitionSpec(("data", "fsdp"))
 
     with mesh:
-        loss = None
+        losses = []
         for step in range(2):
             lora, opt, metrics = step_fn(lora, params, opt, batch,
                                          jnp.int32(step))
-            loss = float(metrics["loss"])  # host sync (global scalar)
-    assert np.isfinite(loss), loss
+            losses.append(float(metrics["loss"]))  # host sync (global)
+    loss = losses[-1]
+    assert np.isfinite(loss), losses
+    # convergence, not just finiteness: the optimizer stepped on the same
+    # fixed batch, so the global loss must DECREASE
+    assert losses[1] < losses[0], losses
 
     # checkpoint-path validation: gather the cross-process FSDP-sharded
     # frozen tree to host (collective; every process calls it) and check

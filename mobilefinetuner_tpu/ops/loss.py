@@ -89,6 +89,17 @@ def lm_cross_entropy_with_count(
 @partial(jax.jit, static_argnames=("ignore_index", "num_chunks"))
 def _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks):
     B, S, H = hidden.shape
+    # Head matmul in the COMPUTE dtype with f32 accumulation: casting both
+    # operands to f32 (the old form) forces the multi-pass f32 MXU
+    # lowering on the [chunk, H] x [H, 262k] projection — the dominant
+    # matmul of the small-Gemma configs. Under the bf16 compute policy the
+    # hidden states arrive bf16; aligning the (frozen, tied) head weight
+    # to them keeps the projection a single bf16 MXU pass, while
+    # preferred_element_type=f32 in the dot and the f32 logsumexp in
+    # _token_nll keep the reduction math exact. f32 callers (parity tests,
+    # --dtype float32) are bit-for-bit unchanged.
+    if jnp.issubdtype(hidden.dtype, jnp.floating):
+        lm_head_w = lm_head_w.astype(hidden.dtype)
     # Shift first: positions 0..S-2 predict labels 1..S-1.
     hidden_s = hidden[:, :-1, :]
     labels_s = labels[:, 1:]
@@ -106,8 +117,9 @@ def _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks):
     def body(carry, xs):
         total, count = carry
         h, lab = xs
-        logits = (h.astype(jnp.float32)
-                  @ lm_head_w.astype(jnp.float32).T)
+        logits = jax.lax.dot_general(
+            h, lm_head_w, (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [B, chunk, V] f32
         nll, valid = _token_nll(logits, lab, ignore_index)
         return (total + nll.sum(), count + valid.sum()), None
 

@@ -276,6 +276,18 @@ def build_mesh(args):
 
 # --------------------------- loop helpers -----------------------------------
 
+def load_full_resume(path: str):
+    """Raw HF-keyed tensor dict from a full-model resume source: an HF
+    checkpoint dir (single-file or sharded) or a single safetensors file.
+    Shared by the full-FT CLIs (gpt2_full_finetune, gemma_full_finetune)
+    so the load idiom cannot drift between them."""
+    from mobilefinetuner_tpu.io.checkpoints import load_hf_state_dict
+    if os.path.isdir(path):
+        return load_hf_state_dict(path)
+    from mobilefinetuner_tpu.io.safetensors_io import SafeTensorsReader
+    return SafeTensorsReader(path).load_all(promote_to_f32=True)
+
+
 def resolve_total_steps(args, steps_per_epoch: int) -> int:
     """epochs overrides steps (reference CmdArgs semantics)."""
     if args.epochs > 0:

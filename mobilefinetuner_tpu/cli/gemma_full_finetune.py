@@ -1,0 +1,148 @@
+"""Gemma-3 full fine-tuning CLI (every parameter trainable).
+
+Beyond-reference capability: the reference has full fine-tuning for GPT-2
+only (gpt2_full_finetune/main.cpp) and LoRA-only for Gemma
+(train_lora_gemma.cpp) — this CLI completes the model×mode matrix with
+the same TPU-native skeleton as cli/gpt2_full_finetune.py: params are the
+trainable tree, FSDP-sharded over the mesh with Adam m/v inheriting the
+shardings (ZeRO optimizer-state partitioning), and the 262k-vocab
+lm_head+CE runs through the chunked loss so [B, S, 262144] fp32 logits are
+never materialized. The tied embedding is trainable, so its gradient sums
+the embedding-gather and lm-head paths — which the chunked CE's
+scan-accumulated dW provides (ops/loss.py).
+
+Usage (tiny smoke):
+  python -m mobilefinetuner_tpu.cli.gemma_full_finetune \
+      --model_dir /path/gemma-3-270m --data_dir /path/wikitext-2 \
+      --steps 10 --output_path out/gemma_full_ft.safetensors
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+
+import jax
+
+from mobilefinetuner_tpu.cli import common
+from mobilefinetuner_tpu.core.logging import get_logger
+from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
+from mobilefinetuner_tpu.data.wikitext2 import WT2Config, WikiText2Dataset
+from mobilefinetuner_tpu.io.checkpoints import (gemma3_params_from_hf,
+                                                load_gemma3, save_gemma3)
+from mobilefinetuner_tpu.models import gemma3
+from mobilefinetuner_tpu.ops.loss import chunked_lm_cross_entropy_sum
+from mobilefinetuner_tpu.optim import adam as adam_mod
+from mobilefinetuner_tpu.parallel.mesh import params_shardings
+
+log = get_logger()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gemma_full_finetune",
+        description="Gemma-3 full fine-tuning on WikiText-2 (TPU)")
+    p.add_argument("--model_dir", required=True,
+                   help="HF Gemma-3 checkpoint dir")
+    p.add_argument("--data_dir", required=True)
+    p.add_argument("--output_path", default="gemma_full_ft.safetensors")
+    p.add_argument("--resume_from", default="",
+                   help="full-model safetensors (or HF dir) to resume from")
+    p.add_argument("--eval_out", default="")
+    p.add_argument("--loss_chunks", type=int, default=8,
+                   help="sequence chunks for the 262k-vocab chunked CE")
+    common.add_train_flags(p, lr=2e-5, seq_len=256, batch_size=1)
+    common.add_pm_flags(p)
+    common.add_mesh_flags(p)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    config, params = load_gemma3(args.model_dir)
+    config = dataclasses.replace(
+        config, attention_impl=args.attention_impl)
+    log.info(f"Gemma-3 full FT: layers={config.num_hidden_layers} "
+             f"hidden={config.hidden_size} vocab={config.vocab_size}")
+    if args.resume_from:
+        params = gemma3_params_from_hf(
+            common.load_full_resume(args.resume_from), config)
+        log.info(f"resumed full model from {args.resume_from}")
+    if args.seq_len > config.max_position_embeddings:
+        args.seq_len = config.max_position_embeddings
+
+    tok = GemmaTokenizer.from_pretrained(args.model_dir)
+    encode = lambda s: tok.encode(s, add_bos=False)
+    wt2 = WT2Config(seq_len=args.seq_len, batch_size=args.batch_size,
+                    data_fraction=args.data_fraction, seed=args.seed)
+    train_ds = WikiText2Dataset(args.data_dir, "train", wt2, encode,
+                                tok.eos_id, pad_id=tok.pad_id)
+    valid_ds = None
+    if args.eval_interval:
+        wt2_eval = WT2Config(seq_len=args.seq_len,
+                             batch_size=args.eval_batch_size, shuffle=False)
+        valid_ds = WikiText2Dataset(args.data_dir, "valid", wt2_eval,
+                                    encode, tok.eos_id, pad_id=tok.pad_id)
+
+    steps_per_epoch = max(train_ds.num_batches() // args.grad_accum_steps, 1)
+    total_steps = common.resolve_total_steps(args, steps_per_epoch)
+    tc = common.train_config_from_args(args, total_steps)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    log.info(f"full FT: {n_params:,} trainable params, "
+             f"{total_steps} steps")
+
+    opt_state, start_step = common.maybe_resume_opt_state(
+        args, params, tc, None)
+
+    # Full FT: params themselves are the trainable tree — FSDP-shard them
+    # (and thus Adam m/v) over the mesh; no host offload of trainables.
+    mesh, cp_mesh = common.build_mesh(args)
+    shardings = params_shardings(params, mesh)
+    params = jax.device_put(params, shardings)
+    compute_dtype = common.compute_dtype_from_args(args)
+
+    def loss_fn(params_t, _unused, mb):
+        hidden = gemma3.hidden_states(
+            config, params_t, mb["input_ids"],
+            attention_mask=mb["attention_mask"],
+            compute_dtype=compute_dtype, remat=args.remat,
+            cp_mesh=cp_mesh)
+        return chunked_lm_cross_entropy_sum(
+            hidden, params_t["embed"], mb["labels"],
+            num_chunks=args.loss_chunks)
+
+    def nll_fn(params_t, _unused, mb):
+        hidden = gemma3.hidden_states(
+            config, params_t, mb["input_ids"],
+            attention_mask=mb["attention_mask"],
+            compute_dtype=compute_dtype, cp_mesh=cp_mesh)
+        return chunked_lm_cross_entropy_sum(
+            hidden, params_t["embed"], mb["labels"],
+            num_chunks=args.loss_chunks)
+
+    def save_hook(step, params_t, opt_st, final):
+        path = args.output_path
+        if not final:
+            root, ext = os.path.splitext(path)
+            path = f"{root}_step{step}{ext}"
+        if os.path.dirname(path):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        save_gemma3(path, params_t)
+        adam_mod.save_state(path + ".opt", jax.device_get(opt_st),
+                            tc.adam())
+        log.info(f"saved full model -> {path}")
+
+    common.run_training(
+        args, trainable=params, frozen=None, loss_fn=loss_fn,
+        nll_fn=nll_fn, train_ds=train_ds, valid_ds=valid_ds,
+        total_steps=total_steps, tc=tc, mask=None, start_step=start_step,
+        opt_state=opt_state, save_hook=save_hook, mesh=mesh,
+        replicate_trainable=False)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

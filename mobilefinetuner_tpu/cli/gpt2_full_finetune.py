@@ -28,8 +28,7 @@ from mobilefinetuner_tpu.core.logging import get_logger
 from mobilefinetuner_tpu.data.tokenizer_bpe import GPT2BPETokenizer
 from mobilefinetuner_tpu.data.wikitext2 import WT2Config, WikiText2Dataset
 from mobilefinetuner_tpu.io.checkpoints import (gpt2_params_from_hf,
-                                                load_gpt2, load_hf_state_dict,
-                                                save_gpt2)
+                                                load_gpt2, save_gpt2)
 from mobilefinetuner_tpu.models import gpt2
 from mobilefinetuner_tpu.ops.loss import lm_cross_entropy_sum
 from mobilefinetuner_tpu.optim import adam as adam_mod
@@ -68,14 +67,8 @@ def main(argv=None) -> int:
                     f"attention path during training; pass "
                     f"--no_model_dropout to keep the flash kernel")
     if args.resume_from:
-        if os.path.isdir(args.resume_from):
-            tensors = load_hf_state_dict(args.resume_from)
-        else:
-            from mobilefinetuner_tpu.io.safetensors_io import \
-                SafeTensorsReader
-            tensors = SafeTensorsReader(args.resume_from).load_all(
-                promote_to_f32=True)
-        params = gpt2_params_from_hf(tensors, config)
+        params = gpt2_params_from_hf(
+            common.load_full_resume(args.resume_from), config)
         log.info(f"resumed full model from {args.resume_from}")
     if args.seq_len > config.n_positions:
         args.seq_len = config.n_positions

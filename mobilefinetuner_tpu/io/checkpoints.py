@@ -243,6 +243,14 @@ def load_gemma3(model_dir: str, config: Optional[Gemma3TextConfig] = None):
     return config, gemma3_params_from_hf(tensors, config)
 
 
+def save_gemma3(path: str, params, metadata: Optional[dict] = None):
+    """Full-model Gemma-3 save in the HF key scheme (save_gpt2 analog —
+    the Gemma full-FT CLI's checkpoint; loads back via load_gemma3 or HF
+    transformers)."""
+    save_safetensors(path, gemma3_params_to_hf(jax_to_numpy(params)),
+                     metadata=metadata or {"format": "pt"})
+
+
 def jax_to_numpy(tree):
     import jax
     return jax.tree.map(lambda x: np.asarray(x), tree)

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Gemma-3-270M FULL fine-tune (all 268M params; beyond-reference — the
+# reference's full-FT binary is GPT-2-only) — 1 epoch, bf16, chunked
+# 262k-vocab CE. The saved full model reloads via --resume_from (or copy
+# it over model.safetensors in a checkpoint dir to run eval_ppl on it).
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+: "${GEMMA_DIR:?set GEMMA_DIR}" "${WT2_DIR:?set WT2_DIR}"
+OUT=${OUT:-out}; mkdir -p "$OUT"
+python -m mobilefinetuner_tpu.cli.gemma_full_finetune \
+    --model_dir "$GEMMA_DIR" --data_dir "$WT2_DIR" \
+    --epochs 1 --batch_size 8 --seq_len 256 --dtype bfloat16 \
+    --lr 2e-5 --warmup_ratio 0.03 \
+    --metrics_csv "$OUT/gemma270m_full_metrics.csv" \
+    --output_path "$OUT/gemma270m_full_ft.safetensors" "$@"

@@ -188,6 +188,40 @@ def test_gpt2_full_finetune_smoke(gpt2_dir, wiki_dir, tmp_path):
     assert "wte.weight" in keys and "h.0.attn.c_attn.weight" in keys
 
 
+def test_gemma_full_finetune_smoke(gemma_dir, wiki_dir, tmp_path):
+    """Gemma full FT (beyond-reference: the reference's full-FT binary is
+    GPT-2-only): trains, saves an HF-keyed full model, and the saved file
+    round-trips through the from_hf mapper (transpose inverse) AND the
+    CLI's --resume_from path."""
+    import numpy as np
+    from mobilefinetuner_tpu.cli.gemma_full_finetune import main
+    out = str(tmp_path / "gfull.safetensors")
+    rc = main(["--model_dir", gemma_dir, "--data_dir", wiki_dir,
+               "--steps", "2", "--batch_size", "2", "--seq_len", "32",
+               "--loss_chunks", "2", "--output_path", out])
+    assert rc == 0
+    from mobilefinetuner_tpu.core.config import Gemma3TextConfig
+    from mobilefinetuner_tpu.io.checkpoints import gemma3_params_from_hf
+    from mobilefinetuner_tpu.io.safetensors_io import SafeTensorsReader
+    tensors = SafeTensorsReader(out).load_all(promote_to_f32=True)
+    assert "model.embed_tokens.weight" in tensors
+    cfg = Gemma3TextConfig.from_pretrained(gemma_dir)
+    params = gemma3_params_from_hf(tensors, cfg)
+    # transpose round trip: the HF [out, in] q_proj equals our stacked
+    # [L, in, out] leaf transposed back
+    np.testing.assert_array_equal(
+        tensors["model.layers.0.self_attn.q_proj.weight"],
+        np.asarray(params["blocks"]["attn"]["q_w"][0]).T)
+    assert os.path.exists(out + ".opt")  # Adam state sidecar
+    # resume path: retrain 1 step FROM the saved file
+    out2 = str(tmp_path / "gfull2.safetensors")
+    rc = main(["--model_dir", gemma_dir, "--data_dir", wiki_dir,
+               "--steps", "1", "--batch_size", "2", "--seq_len", "32",
+               "--loss_chunks", "2", "--resume_from", out,
+               "--output_path", out2])
+    assert rc == 0 and os.path.exists(out2)
+
+
 def test_train_lora_gemma_smoke(gemma_dir, wiki_dir, tmp_path):
     from mobilefinetuner_tpu.cli.train_lora_gemma import main
     out_dir = str(tmp_path / "gl")

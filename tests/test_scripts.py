@@ -22,7 +22,21 @@ CLI_OF = {
     # --dump_dir belongs to tools/align_torch_mirror.py
     "run_alignment_gpt2.sh": (["gpt2_lora_finetune"], {"--dump_dir"}),
     "energy_benchmark.sh": (["gpt2_lora_finetune"], set()),
+    "run_gemma270m_full.sh": (["gemma_full_finetune"], set()),
+    "run_pod_v5e64.sh": (["gpt2_full_finetune"], set()),
 }
+
+
+def test_every_cli_script_is_guarded():
+    """Completeness: any scripts/*/*.sh that invokes a cli module must be
+    registered in CLI_OF, or it silently escapes the flag-drift guard."""
+    missing = []
+    for sh in glob.glob(os.path.join(REPO, "scripts", "*", "*.sh")):
+        name = os.path.basename(sh)
+        if "mobilefinetuner_tpu.cli." in open(sh).read() \
+                and name not in CLI_OF:
+            missing.append(name)
+    assert not missing, f"scripts not registered in CLI_OF: {missing}"
 
 
 def parser_flags(cli_name):

@@ -117,9 +117,9 @@ def offload_setup(params, budget_bytes=0):
 
 
 def bench_gpt2_lora(B, S, dtype, accum=1, offload=False, impl="auto",
-                    steps=40, size="small"):
-    base = (GPT2Config.gpt2_medium() if size == "medium"
-            else GPT2Config.gpt2_small())
+                    steps=40, size="small", remat=False):
+    base = {"small": GPT2Config.gpt2_small, "medium": GPT2Config.gpt2_medium,
+            "large": GPT2Config.gpt2_large, "xl": GPT2Config.gpt2_xl}[size]()
     config = dataclasses.replace(base, attention_impl=impl)
     params = gpt2.init_params(config, jax.random.PRNGKey(0))
     spec = LoRASpec(rank=8, alpha=16.0)
@@ -135,7 +135,7 @@ def bench_gpt2_lora(B, S, dtype, accum=1, offload=False, impl="auto",
         logits = gpt2.forward(config, p, mb["input_ids"],
                               attention_mask=mb["attention_mask"],
                               lora=lora_t, compute_dtype=dtype,
-                              offload=off)
+                              offload=off, remat=remat)
         return lm_cross_entropy_sum(logits, mb["labels"])
 
     step_fn = make_train_step(loss_fn, tc, mask=mask, donate=True)
@@ -310,6 +310,11 @@ def main():
         # 270M/1B (README.md:406-411); cover the larger two as well
         run("gpt2m_lora_bf16_B32_S128", bench_gpt2_lora, bf16, steps,
             B=32, S=S, size="medium")
+        # the README claims GPT-2 small/medium/large/xl: measure all four
+        run("gpt2l_lora_bf16_B16_S128", bench_gpt2_lora, bf16,
+            max(steps // 2, 2), B=16, S=S, size="large")
+        run("gpt2xl_lora_bf16_B8_S128", bench_gpt2_lora, bf16,
+            max(steps // 4, 2), B=8, S=S, size="xl", remat=True)
         run("gemma1b_lora_bf16_B8_S256", bench_gemma_lora, bf16,
             max(gsteps // 2, 2), B=8, S=GS, loss_chunks=8, size="1b")
         run("gemma1b_lora_bf16_offload_stream", bench_gemma_lora, bf16,

@@ -91,8 +91,9 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
     g.add_argument("--no_model_dropout", action="store_true",
                    help="zero the checkpoint's embd/resid/attn pdrop "
                         "(HF GPT-2 configs carry 0.1; dropout changes "
-                        "loss curves and attn-dropout forces the XLA "
-                        "attention path)")
+                        "loss curves — both attention impls support "
+                        "train-mode attn dropout, the flash kernel via "
+                        "its in-kernel hash mask)")
     g.add_argument("--profile_dir", default="",
                    help="emit a jax.profiler trace of a few steady-state "
                         "steps to this directory (the reference's "

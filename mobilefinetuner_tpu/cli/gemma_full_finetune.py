@@ -67,6 +67,11 @@ def main(argv=None) -> int:
         config, attention_impl=args.attention_impl)
     log.info(f"Gemma-3 full FT: layers={config.num_hidden_layers} "
              f"hidden={config.hidden_size} vocab={config.vocab_size}")
+    if args.no_model_dropout:
+        # the shared flag surface carries this for GPT-2 configs; Gemma-3
+        # checkpoints have no embd/resid/attn pdrop fields to zero
+        log.warning("--no_model_dropout is a no-op for Gemma-3 "
+                    "(the config has no dropout fields)")
     if args.resume_from:
         params = gemma3_params_from_hf(
             common.load_full_resume(args.resume_from), config)

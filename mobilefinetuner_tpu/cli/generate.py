@@ -1,5 +1,5 @@
 """Text-generation CLI: KV-cached autoregressive sampling for GPT-2 and
-Gemma-3, with optional merged LoRA adapters.
+Gemma-3, with optional LoRA adapters (merged or dynamic).
 
 A capability the reference framework ships only as excluded legacy code
 (reference: legacy/transformer/kv_cache.cpp + autoregressive_ops,
@@ -50,7 +50,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prompt_file", default="",
                    help="one prompt per line (adds to --prompt)")
     p.add_argument("--lora_path", default="",
-                   help="adapter safetensors; merged into the base weights")
+                   help="adapter safetensors; merged into the base weights "
+                        "by default")
+    p.add_argument("--lora_dynamic", action="store_true",
+                   help="apply the adapter dynamically at every site "
+                        "instead of merging — no merged weight copy, so "
+                        "many adapters can be served off one base")
     p.add_argument("--max_new_tokens", type=int, default=64)
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top_k", type=int, default=0)
@@ -79,8 +84,9 @@ def main(argv=None) -> int:
     b = load_family(args.pretrained_dir, args.model)
     gen = gpt2_generate if b.family == "gpt2" else gemma3_generate
     tok, encode = b.tok, b.tok.encode  # Gemma: add_bos default (HF parity)
-    apply_adapter(b, args.lora_path, lora_merge=True)  # generation always
-    config, params = b.config, b.params                # reads merged base
+    lora = apply_adapter(b, args.lora_path,
+                         lora_merge=not args.lora_dynamic)
+    config, params = b.config, b.params
 
     encoded = [encode(p) for p in prompts]
     empty = [p for p, e in zip(prompts, encoded) if not e]
@@ -98,10 +104,10 @@ def main(argv=None) -> int:
     t0 = time.time()
     # jit with params/rng as ARGUMENTS: closing over full-size weights
     # would embed them in the HLO as constants (oversized programs)
-    gen_jit = jax.jit(lambda p, i, m, r: gen(config, p, i, m, cfg, r,
-                                             compute_dtype=compute_dtype))
-    out = np.asarray(gen_jit(params, jnp.asarray(ids), jnp.asarray(mask),
-                             rng))
+    gen_jit = jax.jit(lambda p, l, i, m, r: gen(
+        config, p, i, m, cfg, r, compute_dtype=compute_dtype, lora=l))
+    out = np.asarray(gen_jit(params, lora, jnp.asarray(ids),
+                             jnp.asarray(mask), rng))
     dt = time.time() - t0
     n_tok = int(out.size)
     log.info(f"{n_tok} tokens in {dt:.2f}s "

@@ -62,10 +62,6 @@ def main(argv=None) -> int:
     if args.no_model_dropout:
         config = dataclasses.replace(config, embd_pdrop=0.0,
                                      resid_pdrop=0.0, attn_pdrop=0.0)
-    elif config.attn_pdrop > 0 and args.attention_impl == "flash":
-        log.warning(f"attn_pdrop={config.attn_pdrop} forces the XLA "
-                    f"attention path during training; pass "
-                    f"--no_model_dropout to keep the flash kernel")
     if args.resume_from:
         params = gpt2_params_from_hf(
             common.load_full_resume(args.resume_from), config)

@@ -93,11 +93,6 @@ def main(argv=None) -> int:
     if args.no_model_dropout:
         config = dataclasses.replace(config, embd_pdrop=0.0,
                                      resid_pdrop=0.0, attn_pdrop=0.0)
-    elif config.attn_pdrop > 0 and args.attention_impl == "flash":
-        log.warning(f"attn_pdrop={config.attn_pdrop} forces the XLA "
-                    f"attention path during training (probs-dropout has "
-                    f"no flash-kernel support); pass --no_model_dropout "
-                    f"to keep the flash kernel")
     if args.seq_len > config.n_positions:
         log.warning(f"seq_len({args.seq_len}) > n_positions"
                     f"({config.n_positions}), clamped")

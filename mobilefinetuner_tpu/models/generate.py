@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from mobilefinetuner_tpu.core.config import GPT2Config, Gemma3TextConfig
 from mobilefinetuner_tpu.models import gemma3, gpt2
+from mobilefinetuner_tpu.models.lora_apply import maybe_lora
 from mobilefinetuner_tpu.ops.rope import apply_rope, rope_cos_sin
 
 NEG_INF = -1e30
@@ -113,11 +114,17 @@ def _col_valid(attention_mask, P, T, t):
 
 def gpt2_generate(config: GPT2Config, params, input_ids, attention_mask,
                   cfg: SampleConfig, rng: Optional[jax.Array] = None,
-                  compute_dtype=jnp.float32):
+                  compute_dtype=jnp.float32, lora=None):
     """Generate [B, max_new_tokens] ids from LEFT-padded prompts [B, P].
 
     One jittable program: full-forward prefill (collect_kv) + scanned
     single-token decode over a [L, B, H, P+N, D] cache.
+
+    lora: optional adapter pytree (lora/lora.py) applied DYNAMICALLY —
+    prefill through the training forward's LoRA path, decode via
+    per-layer maybe_lora at every adapter site. Serving many adapters
+    without materializing merged weight copies; merge_gpt2 + lora=None
+    remains the (slightly faster) single-adapter path.
     """
     B, P = input_ids.shape
     N = cfg.max_new_tokens
@@ -138,9 +145,10 @@ def gpt2_generate(config: GPT2Config, params, input_ids, attention_mask,
     params = jax.tree.map(jnp.asarray, params)
 
     x, (pk, pv) = gpt2.hidden_states(
-        config, params, input_ids, attention_mask,
+        config, params, input_ids, attention_mask, lora=lora,
         compute_dtype=compute_dtype, collect_kv=True)
     logits0 = x[:, -1] @ params["wte"].astype(compute_dtype).T  # [B, V]
+    lora_b = None if lora is None else lora.get("blocks")
 
     pad_kv = lambda t: jnp.pad(t, ((0, 0), (0, 0), (0, 0), (0, N), (0, 0)))
     kc, vc = pad_kv(pk), pad_kv(pv)                  # [L, B, H, T, D]
@@ -160,10 +168,25 @@ def gpt2_generate(config: GPT2Config, params, input_ids, attention_mask,
             + params["wpe"][pos].astype(compute_dtype)          # [B, E]
         valid = _col_valid(attention_mask, P, T, t)             # [B, T]
 
+        def apply_lora(y, x_in, name, i):
+            entry = None if lora_b is None else lora_b.get(name)
+            return maybe_lora(y, x_in, entry, i)
+
         def layer(x, inp):
-            bp, kc_l, vc_l = inp                  # kc_l: [B, H, T, D]
+            bp, kc_l, vc_l, i = inp               # kc_l: [B, H, T, D]
             h = gpt2.layer_norm(x, bp["ln_1"]["g"], bp["ln_1"]["b"], eps)
             qkv = h @ bp["attn"]["qkv_w"] + bp["attn"]["qkv_b"]
+            qkv = apply_lora(qkv, h, "attn_qkv", i)
+            if lora_b is not None:
+                # split-QKV adapters hit their column range of the fused
+                # c_attn output (models/gpt2.py _block, same site salts)
+                from mobilefinetuner_tpu.lora.lora import \
+                    GPT2_SPLIT_QKV_SLOTS
+                for name, slot in GPT2_SPLIT_QKV_SLOTS.items():
+                    if name in lora_b:
+                        sl = (Ellipsis, slice(slot * E, (slot + 1) * E))
+                        qkv = qkv.at[sl].set(
+                            apply_lora(qkv[sl], h, name, i))
             q, k, v = jnp.split(qkv, 3, axis=-1)
             hd = lambda z: z.reshape(B, H, D)
             q, k, v = hd(q), hd(k), hd(v)
@@ -179,13 +202,17 @@ def gpt2_generate(config: GPT2Config, params, input_ids, attention_mask,
                              vc_l.astype(jnp.float32))
             ctx = ctx.reshape(B, E).astype(compute_dtype)
             proj = ctx @ bp["attn"]["proj_w"] + bp["attn"]["proj_b"]
+            proj = apply_lora(proj, ctx, "attn_proj", i)
             x = x + proj
             h2 = gpt2.layer_norm(x, bp["ln_2"]["g"], bp["ln_2"]["b"], eps)
-            fc = gpt2.gelu_new(h2 @ bp["mlp"]["fc_w"] + bp["mlp"]["fc_b"])
+            fc = h2 @ bp["mlp"]["fc_w"] + bp["mlp"]["fc_b"]
+            fc = gpt2.gelu_new(apply_lora(fc, h2, "mlp_fc_in", i))
             out = fc @ bp["mlp"]["proj_w"] + bp["mlp"]["proj_b"]
+            out = apply_lora(out, fc, "mlp_fc_out", i)
             return x + out, (kc_l, vc_l)
 
-        x, (kc, vc) = jax.lax.scan(layer, x, (wb, kc, vc))
+        x, (kc, vc) = jax.lax.scan(
+            layer, x, (wb, kc, vc, jnp.arange(L, dtype=jnp.int32)))
         x = gpt2.layer_norm(x, params["ln_f"]["g"].astype(compute_dtype),
                             params["ln_f"]["b"].astype(compute_dtype), eps)
         logits = x @ params["wte"].astype(compute_dtype).T
@@ -212,9 +239,11 @@ def gpt2_generate(config: GPT2Config, params, input_ids, attention_mask,
 def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
                     attention_mask, cfg: SampleConfig,
                     rng: Optional[jax.Array] = None,
-                    compute_dtype=jnp.float32):
+                    compute_dtype=jnp.float32, lora=None):
     """Gemma-3 generation: GQA cache [L, B, Hkv, T, D], per-layer
-    global/local RoPE + sliding-window validity over POSITION ids."""
+    global/local RoPE + sliding-window validity over POSITION ids.
+    lora: optional adapter pytree applied dynamically (see
+    gpt2_generate)."""
     c = config
     B, P = input_ids.shape
     N = cfg.max_new_tokens
@@ -228,9 +257,10 @@ def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
     params = jax.tree.map(jnp.asarray, params)
 
     x, (pk, pv) = gemma3.hidden_states(
-        c, params, input_ids, attention_mask,
+        c, params, input_ids, attention_mask, lora=lora,
         compute_dtype=compute_dtype, collect_kv=True)
     logits0 = x[:, -1] @ params["embed"].astype(compute_dtype).T
+    lora_b = None if lora is None else lora.get("blocks")
 
     pad_kv = lambda t: jnp.pad(t, ((0, 0), (0, 0), (0, 0), (0, N), (0, 0)))
     kc, vc = pad_kv(pk), pad_kv(pv)
@@ -259,13 +289,17 @@ def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
         # phases as the padded-batch training forward
         win_ok = (pos[:, None] - col_pos) < c.sliding_window    # [B, T]
 
+        def apply_lora(y, x_in, name, i):
+            entry = None if lora_b is None else lora_b.get(name)
+            return maybe_lora(y, x_in, entry, i)
+
         def layer(x, inp):
-            bp, kc_l, vc_l, glob = inp
+            bp, kc_l, vc_l, glob, i = inp
             a = bp["attn"]
             h = gemma3.rms_norm(x, bp["input_ln"], eps)
-            q = (h @ a["q_w"]).reshape(B, nq, D)
-            k = (h @ a["k_w"]).reshape(B, nkv, D)
-            v = (h @ a["v_w"]).reshape(B, nkv, D)
+            q = apply_lora(h @ a["q_w"], h, "q_proj", i).reshape(B, nq, D)
+            k = apply_lora(h @ a["k_w"], h, "k_proj", i).reshape(B, nkv, D)
+            v = apply_lora(h @ a["v_w"], h, "v_proj", i).reshape(B, nkv, D)
             q = gemma3.rms_norm(q, a["q_norm"], eps)
             k = gemma3.rms_norm(k, a["k_norm"], eps)
             cos = jnp.where(glob, cos_g, cos_l)
@@ -286,17 +320,22 @@ def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
             ctx = jnp.einsum("bkgt,bktd->bkgd", p,
                              vc_l.astype(jnp.float32))
             ctx = ctx.reshape(B, nq * D).astype(compute_dtype)
-            attn_out = ctx @ a["o_w"]
+            attn_out = apply_lora(ctx @ a["o_w"], ctx, "o_proj", i)
             attn_out = gemma3.rms_norm(attn_out, bp["post_attn_ln"], eps)
             x = x + attn_out
             h2 = gemma3.rms_norm(x, bp["pre_ffn_ln"], eps)
-            act = gemma3.gelu_tanh(h2 @ bp["mlp"]["gate_w"]) \
-                * (h2 @ bp["mlp"]["up_w"])
-            down = act @ bp["mlp"]["down_w"]
+            act = gemma3.gelu_tanh(
+                apply_lora(h2 @ bp["mlp"]["gate_w"], h2, "gate_proj", i)) \
+                * apply_lora(h2 @ bp["mlp"]["up_w"], h2, "up_proj", i)
+            down = apply_lora(act @ bp["mlp"]["down_w"], act,
+                              "down_proj", i)
             down = gemma3.rms_norm(down, bp["post_ffn_ln"], eps)
             return x + down, (kc_l, vc_l)
 
-        x, (kc, vc) = jax.lax.scan(layer, x, (wb, kc, vc, is_global))
+        x, (kc, vc) = jax.lax.scan(
+            layer, x,
+            (wb, kc, vc, is_global,
+             jnp.arange(c.num_hidden_layers, dtype=jnp.int32)))
         x = gemma3.rms_norm(x, params["final_norm"].astype(compute_dtype),
                             eps)
         logits = x @ params["embed"].astype(compute_dtype).T

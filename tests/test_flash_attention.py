@@ -359,3 +359,40 @@ def test_attention_dispatcher_keeps_flash_with_dropout():
     # must differ from the dropout-free kernel result (mask engaged)
     base = attention(q, k, v, impl="flash")
     assert np.abs(np.asarray(out) - np.asarray(base)).max() > 1e-3
+
+
+def test_gpt2_model_training_dropout_on_flash_path():
+    """Model-level: a GPT-2 block with attn_pdrop>0 and impl='flash' in
+    TRAIN mode (dropout_rng set) runs the kernel path end to end — fwd +
+    LoRA grads finite, seeded-deterministic, and actually dropping."""
+    import dataclasses
+    from mobilefinetuner_tpu.core.config import GPT2Config
+    from mobilefinetuner_tpu.lora.lora import LoRASpec, init_lora_gpt2
+    from mobilefinetuner_tpu.models import gpt2
+    cfg = dataclasses.replace(GPT2Config.tiny(vocab_size=128),
+                              attention_impl="flash", attn_pdrop=0.25,
+                              embd_pdrop=0.0, resid_pdrop=0.0)
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    lora = init_lora_gpt2(cfg, LoRASpec(rank=2, alpha=4.0),
+                          jax.random.PRNGKey(1))
+    ids = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 128)
+    rng = jax.random.PRNGKey(3)
+
+    def loss(lora_t, rng):
+        out = gpt2.forward(cfg, params, ids, lora=lora_t,
+                           dropout_rng=rng)
+        return (out.astype(jnp.float32) ** 2).mean()
+
+    l1 = float(loss(lora, rng))
+    l2 = float(loss(lora, rng))
+    assert l1 == l2, "same rng must give the same dropout mask"
+    l3 = float(loss(lora, jax.random.PRNGKey(9)))
+    assert l3 != l1, "different rng must give a different mask"
+    cfg_nd = dataclasses.replace(cfg, attn_pdrop=0.0)
+    l_nd = float((gpt2.forward(cfg_nd, params, ids, lora=lora,
+                               dropout_rng=rng).astype(jnp.float32) ** 2
+                  ).mean())
+    assert l_nd != l1, "dropout must actually perturb the output"
+    g = jax.grad(loss)(lora, rng)
+    assert all(np.isfinite(np.asarray(x)).all()
+               for x in jax.tree.leaves(g))

@@ -207,6 +207,27 @@ def test_generate_cli_end_to_end(tmp_path):
     assert rec["prompt"] == "hello world"
     assert len(rec["ids"]) <= 4 and isinstance(rec["text"], str)
 
+    # --lora_dynamic path: train nothing, just save a random adapter and
+    # serve it unmerged through the CLI
+    import jax as jax_mod
+    from mobilefinetuner_tpu.core.config import GPT2Config
+    from mobilefinetuner_tpu.lora.lora import LoRASpec, init_lora_gpt2
+    from mobilefinetuner_tpu.lora.peft_io import save_adapter
+    cfg2 = GPT2Config.from_pretrained(d)
+    spec = LoRASpec(rank=2, alpha=4.0, targets=["attn_qkv"])
+    lora = init_lora_gpt2(cfg2, spec, jax_mod.random.PRNGKey(0))
+    apath = str(tmp_path / "a.safetensors")
+    save_adapter(apath, lora, spec)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main(["--pretrained_dir", d, "--prompt", "hello world",
+                   "--max_new_tokens", "4", "--greedy", "--json",
+                   "--lora_path", apath, "--lora_dynamic"])
+    assert rc == 0
+    rec = json.loads([ln for ln in buf.getvalue().splitlines()
+                      if ln.strip()][-1])
+    assert isinstance(rec["text"], str)
+
 
 def test_zero_new_tokens_returns_empty(gpt2_params, gemma_params):
     """max_new_tokens=0 returns [B, 0] — no silent extra token from the
@@ -218,3 +239,62 @@ def test_zero_new_tokens_returns_empty(gpt2_params, gemma_params):
                          cfg).shape == (2, 0)
     assert gemma3_generate(GEMMA_CFG, gemma_params, ids, mask,
                            cfg).shape == (2, 0)
+
+
+def test_dynamic_lora_generation_matches_merged(gpt2_params, gemma_params):
+    """Dynamic (unmerged) LoRA generation must emit the same greedy tokens
+    as generating from the merged weights — every adapter site in BOTH
+    decode loops (incl. prefill) applies the identical delta."""
+    from mobilefinetuner_tpu.lora.lora import (LoRASpec, init_lora_gemma3,
+                                               init_lora_gpt2, merge_gemma3,
+                                               merge_gpt2)
+    ids, mask = left_pad([[1, 2, 3, 4, 5], [7, 8, 9]], pad_id=0)
+    ids, mask = jnp.asarray(ids), jnp.asarray(mask)
+    cfg = SampleConfig(max_new_tokens=6, greedy=True, eos_id=None)
+
+    spec = LoRASpec(rank=2, alpha=16.0,
+                    targets=["attn_qkv", "attn_proj", "mlp_fc_in",
+                             "mlp_fc_out"])
+    lora = init_lora_gpt2(GPT2_CFG, spec, jax.random.PRNGKey(9))
+    lora = jax.tree.map(
+        lambda x: x + 0.05 if x.ndim and x.shape[-1] else x, lora)
+    merged = np.asarray(gpt2_generate(
+        GPT2_CFG, merge_gpt2(gpt2_params, lora), ids, mask, cfg))
+    dynamic = np.asarray(gpt2_generate(
+        GPT2_CFG, gpt2_params, ids, mask, cfg, lora=lora))
+    base = np.asarray(gpt2_generate(GPT2_CFG, gpt2_params, ids, mask, cfg))
+    np.testing.assert_array_equal(dynamic, merged)
+    assert not np.array_equal(dynamic, base)  # the adapter engaged
+
+    gspec = LoRASpec(rank=2, alpha=16.0, targets="full")
+    glora = init_lora_gemma3(GEMMA_CFG, gspec, jax.random.PRNGKey(10))
+    glora = jax.tree.map(
+        lambda x: x + 0.05 if x.ndim and x.shape[-1] else x, glora)
+    gmerged = np.asarray(gemma3_generate(
+        GEMMA_CFG, merge_gemma3(gemma_params, glora), ids, mask, cfg))
+    gdynamic = np.asarray(gemma3_generate(
+        GEMMA_CFG, gemma_params, ids, mask, cfg, lora=glora))
+    gbase = np.asarray(gemma3_generate(GEMMA_CFG, gemma_params, ids, mask,
+                                       cfg))
+    np.testing.assert_array_equal(gdynamic, gmerged)
+    assert not np.array_equal(gdynamic, gbase)
+
+
+def test_dynamic_lora_split_qkv_generation(gpt2_params):
+    """Split-QKV adapters (column-sliced on the fused c_attn) apply in the
+    decode loop too: dynamic == merged."""
+    from mobilefinetuner_tpu.lora.lora import (LoRASpec, init_lora_gpt2,
+                                               merge_gpt2)
+    spec = LoRASpec(rank=2, alpha=16.0,
+                    targets=["attn_q", "attn_v", "attn_proj"])
+    lora = init_lora_gpt2(GPT2_CFG, spec, jax.random.PRNGKey(11))
+    lora = jax.tree.map(
+        lambda x: x + 0.05 if x.ndim and x.shape[-1] else x, lora)
+    ids, mask = left_pad([[3, 1, 4, 1, 5]], pad_id=0)
+    ids, mask = jnp.asarray(ids), jnp.asarray(mask)
+    cfg = SampleConfig(max_new_tokens=5, greedy=True, eos_id=None)
+    merged = np.asarray(gpt2_generate(
+        GPT2_CFG, merge_gpt2(gpt2_params, lora), ids, mask, cfg))
+    dynamic = np.asarray(gpt2_generate(
+        GPT2_CFG, gpt2_params, ids, mask, cfg, lora=lora))
+    np.testing.assert_array_equal(dynamic, merged)

@@ -31,7 +31,8 @@ def test_every_cli_script_is_guarded():
     """Completeness: any scripts/*/*.sh that invokes a cli module must be
     registered in CLI_OF, or it silently escapes the flag-drift guard."""
     missing = []
-    for sh in glob.glob(os.path.join(REPO, "scripts", "*", "*.sh")):
+    for sh in glob.glob(os.path.join(REPO, "scripts", "**", "*.sh"),
+                        recursive=True):
         name = os.path.basename(sh)
         if "mobilefinetuner_tpu.cli." in open(sh).read() \
                 and name not in CLI_OF:

@@ -276,3 +276,31 @@ def test_flash_ring_long_context_8k():
     )(q, k, v, pad)
     np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
                                atol=3e-5, rtol=3e-5)
+
+
+def test_gemma_model_context_parallel_flash_body():
+    """Full-size-shaped Gemma under cp_mesh with a FLASH-eligible shard
+    (head_dim 64, Sq=128): the per-layer global/local lax.cond now selects
+    between two flash-ring variants (shard_map + pallas inside cond
+    branches) — the exact composition a real Gemma sequence-parallel run
+    hits."""
+    from mobilefinetuner_tpu.core.config import Gemma3TextConfig
+    from mobilefinetuner_tpu.models import gemma3
+    from mobilefinetuner_tpu.ops.flash_attention import \
+        flash_partial_eligible
+    mesh = make_mesh(data=1, fsdp=4, devices=jax.devices()[:4])
+    cfg = Gemma3TextConfig(
+        vocab_size=512, hidden_size=128, intermediate_size=256,
+        num_hidden_layers=4, num_attention_heads=2, num_key_value_heads=1,
+        head_dim=64, max_position_embeddings=1024, sliding_window=96,
+        query_pre_attn_scalar=64.0, sliding_window_pattern=3)
+    assert flash_partial_eligible(512 // 4, cfg.head_dim)
+    params = gemma3.init_params(cfg, jax.random.PRNGKey(0))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 512), 0, 512)
+    mask = jnp.ones((2, 512))
+    ref = gemma3.forward(cfg, params, ids, attention_mask=mask)
+    out = jax.jit(lambda p, i: gemma3.forward(cfg, p, i,
+                                              attention_mask=mask,
+                                              cp_mesh=mesh))(params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)

@@ -19,6 +19,7 @@ CLI_OF = {
     "run_gpt2m_lora.sh": (["gpt2_lora_finetune"], set()),
     "run_gemma270m_lora.sh": (["train_lora_gemma", "eval_ppl"], set()),
     "run_gemma1b_lora_offload.sh": (["train_lora_gemma"], set()),
+    "run_gemma1b_lora.sh": (["train_lora_gemma"], set()),
     # --dump_dir belongs to tools/align_torch_mirror.py
     "run_alignment_gpt2.sh": (["gpt2_lora_finetune"], {"--dump_dir"}),
     "energy_benchmark.sh": (["gpt2_lora_finetune"], set()),

@@ -138,8 +138,11 @@ def parse_mmlu_text(text: str, default_subject: str,
             f2 = parse_csv_line(line)
             if len(f2) <= max(idx.values()):
                 continue
-            subject = (f2[subj_idx].strip() if subj_idx is not None
-                       else default_subject) or "unknown"
+            # an empty subject CELL falls back to the file-level default
+            # (filename-derived), not straight to "unknown"
+            subject = ((f2[subj_idx].strip() or default_subject)
+                       if subj_idx is not None else default_subject) \
+                or "unknown"
             ans = f2[idx["answer"]].strip()
             items.append(MCQItem(
                 subject=subject, question=f2[idx["question"]].strip(),

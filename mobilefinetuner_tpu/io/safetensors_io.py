@@ -18,6 +18,7 @@ reference and the fallback. MFT_NO_NATIVE_ST=1 forces Python.
 from __future__ import annotations
 
 import json
+import os
 import struct
 from typing import Dict, Optional, Tuple
 
@@ -89,6 +90,12 @@ class SafeTensorsReader:
             # (json.JSONDecodeError and UnicodeDecodeError are).
             try:
                 (header_len,) = struct.unpack("<Q", f.read(8))
+                # a corrupt length prefix can decode to e.g. 2^60 — bound it
+                # by the file size BEFORE read() attempts the allocation, so
+                # MemoryError never escapes the ValueError contract
+                if header_len > os.path.getsize(path) - 8:
+                    raise ValueError(
+                        f"header length {header_len} exceeds file size")
                 header = json.loads(f.read(header_len).decode("utf-8"))
             except (struct.error, ValueError) as e:
                 raise ValueError(

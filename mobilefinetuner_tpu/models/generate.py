@@ -128,17 +128,19 @@ def gpt2_generate(config: GPT2Config, params, input_ids, attention_mask,
     """
     B, P = input_ids.shape
     N = cfg.max_new_tokens
+    T = P + N
+    if T > config.n_positions:
+        # learned absolute positions: an out-of-range wpe gather would
+        # silently clamp to the last row and quietly degrade sampling.
+        # Validated BEFORE the N<=0 early-out so an over-long prompt
+        # errors regardless of how many tokens were requested.
+        raise ValueError(
+            f"prompt ({P}) + max_new_tokens ({N}) = {T} exceeds "
+            f"n_positions={config.n_positions}")
     if N <= 0:
         # honor max_new_tokens=0 instead of silently emitting the prefill
         # sample (the decode scan below always appends the carried token)
         return jnp.zeros((B, 0), jnp.int32)
-    T = P + N
-    if T > config.n_positions:
-        # learned absolute positions: an out-of-range wpe gather would
-        # silently clamp to the last row and quietly degrade sampling
-        raise ValueError(
-            f"prompt ({P}) + max_new_tokens ({N}) = {T} exceeds "
-            f"n_positions={config.n_positions}")
     E, H, D = config.n_embd, config.n_head, config.head_dim
     L = config.n_layer
     rng = jax.random.PRNGKey(0) if rng is None else rng

@@ -71,12 +71,15 @@ def _merge_lse(lse1, o1, lse2, o2):
 def _ring_hops(n: int, window, Sq: int) -> int:
     """How many rotations the ring actually needs. Causal-only: n−1 (every
     earlier chunk is visible). A sliding window w only reaches rows up to
-    w−1 columns back, so hop t (whose chunk sits t·Sq rows earlier) has
-    visible cells iff t·Sq − w < Sq, i.e. t ≤ (w−1)//Sq + 1 — chunks past
-    that never travel, saving both compute AND ppermute traffic."""
+    w−1 columns back. Hop t's NEAREST cell (local row 0 vs the chunk's
+    last column) is (t−1)·Sq + 1 rows back, so hop t has visible cells
+    iff (t−1)·Sq + 1 ≤ w−1, i.e. t ≤ (w−2)//Sq + 1 — chunks past that
+    never travel, saving both compute AND ppermute traffic. w=1 (self
+    only) needs 0 hops: floor division of the negative numerator handles
+    it, and the max() guards the clamp."""
     if window is None:
         return n - 1
-    return min(n - 1, (int(window) - 1) // Sq + 1)
+    return min(n - 1, max(0, (int(window) - 2) // Sq + 1))
 
 
 def _ring_shard_flash(q, k, v, pad, *, axis, scale, window):

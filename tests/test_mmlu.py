@@ -14,6 +14,22 @@ from mobilefinetuner_tpu.eval.mmlu import (MCQItem, build_prompt, evaluate,
 
 ITEM = MCQItem("toy", "What is 2 + 2 ?", "3", "4", "5", "6", "B")
 
+_PREP_COUNTER = [0]
+
+
+def _load_prep():
+    """Import tools/mmlu_prep.py under a fresh module name per call (the
+    tool mutates no global state, but tests must not share one import)."""
+    import importlib.util
+    _PREP_COUNTER[0] += 1
+    spec = importlib.util.spec_from_file_location(
+        f"mmlu_prep{_PREP_COUNTER[0]}",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "mmlu_prep.py"))
+    prep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(prep)
+    return prep
+
 
 def test_parse_csv_line_quotes():
     assert parse_csv_line('a,"b, c",d') == ["a", "b, c", "d"]
@@ -176,19 +192,11 @@ def test_mmlu_prep_synthetic_and_zip_roundtrip(tmp_path):
     """tools/mmlu_prep.py: synthetic mode covers the full 57-subject
     taxonomy in Hendrycks layout; zip normalization re-emits the same
     items (quoted fields survive)."""
+    import contextlib
     import io
     import json as json_mod
-    import subprocess
-    import sys
     import zipfile
-
-    import contextlib
-    import importlib
-    spec = importlib.util.spec_from_file_location(
-        "mmlu_prep", os.path.join(os.path.dirname(__file__), "..",
-                                  "tools", "mmlu_prep.py"))
-    prep = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(prep)
+    prep = _load_prep()
 
     out1 = str(tmp_path / "synth")
     buf = io.StringIO()
@@ -224,14 +232,9 @@ def test_mmlu_prep_zip_headered_csv_no_junk_row(tmp_path):
     detection — the header row must NOT become a dataset item (regression:
     the zip branch used to parse rows blindly)."""
     import contextlib
-    import importlib
     import io
     import zipfile
-    spec = importlib.util.spec_from_file_location(
-        "mmlu_prep2", os.path.join(os.path.dirname(__file__), "..",
-                                   "tools", "mmlu_prep.py"))
-    prep = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(prep)
+    prep = _load_prep()
     zpath = str(tmp_path / "h.zip")
     with zipfile.ZipFile(zpath, "w") as z:
         z.writestr("data/test/astronomy_test.csv",
@@ -243,3 +246,39 @@ def test_mmlu_prep_zip_headered_csv_no_junk_row(tmp_path):
     assert len(items) == 1
     assert items[0].question == "What is 2+2?"
     assert items[0].answer == "D"
+
+
+def test_mmlu_prep_headered_subject_column_survives(tmp_path):
+    """A headered CSV carrying its OWN subject column must keep those
+    labels through normalization (regression: collect_source used to
+    refile every row under the filename-derived subject). An EMPTY
+    subject cell falls back to the filename subject, and a subject cell
+    that is not a safe filename component (path separators, '..') must
+    not become a path — it is refiled under the filename subject too."""
+    import contextlib
+    import io
+    import zipfile
+    prep = _load_prep()
+    zpath = str(tmp_path / "s.zip")
+    with zipfile.ZipFile(zpath, "w") as z:
+        z.writestr(
+            "data/test/mixed_bag_test.csv",
+            "subject,question,a,b,c,d,answer\n"
+            "astronomy,What orbits Earth?,Moon,Sun,Mars,Venus,A\n"
+            "virology,What is a virion?,particle,cell,organ,spore,A\n"
+            ",Empty subject cell?,w,x,y,z,A\n"
+            "../escape,Traversal subject?,w,x,y,z,A\n"
+            "bad/slash,Separator subject?,w,x,y,z,A\n")
+    out = str(tmp_path / "out")
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert prep.main(["--source", zpath, "--out", out]) == 0
+    split = load_split(out, "test")
+    assert sorted(split) == ["astronomy", "mixed_bag", "virology"]
+    assert split["astronomy"][0].question == "What orbits Earth?"
+    assert split["virology"][0].question == "What is a virion?"
+    # empty + unsafe subjects all landed under the filename subject
+    assert sorted(i.question for i in split["mixed_bag"]) == [
+        "Empty subject cell?", "Separator subject?", "Traversal subject?"]
+    # and nothing escaped <out>/test/ ('../escape' would have written
+    # <out>/escape_test.csv)
+    assert not os.path.exists(os.path.join(out, "escape_test.csv"))

@@ -251,6 +251,9 @@ def test_malformed_files_raise_valueerror_both_backends(tmp_path):
             (8).to_bytes(8, "little") + b"not-json",
         "not_object.safetensors":
             (4).to_bytes(8, "little") + b"1234",           # JSON number
+        # corrupt prefix decoding to ~2^60: must raise ValueError, not
+        # attempt the allocation and leak MemoryError
+        "huge_len.safetensors": (1 << 60).to_bytes(8, "little") + b"{}",
     }
     paths = []
     for fname, blob in cases.items():

@@ -27,7 +27,26 @@ CASES = [
     dict(n=4, Hkv=1),                       # extreme GQA
     dict(n=2, Hkv=4, S=96, D=32),           # MHA, odd shard size 48
     dict(n=4, is_causal=False),             # bidirectional
+    dict(n=4, sliding_window=17),           # (w-1) % Sq == 0: 1 hop not 2
+    dict(n=4, sliding_window=1),            # self-only window: 0 hops
 ]
+
+
+def test_ring_hops_boundaries():
+    """Hop t's nearest cell sits (t-1)*Sq+1 rows back, so the hop count is
+    max(0, (w-2)//Sq + 1): a window of exactly Sq+1 needs ONE hop (the
+    old (w-1)//Sq+1 formula shipped a fully-masked second hop), and w=1
+    (self only) needs zero."""
+    from mobilefinetuner_tpu.parallel.ring_attention import _ring_hops
+    Sq = 16
+    assert _ring_hops(8, None, Sq) == 7
+    assert _ring_hops(8, 1, Sq) == 0
+    assert _ring_hops(8, 2, Sq) == 1
+    assert _ring_hops(8, Sq, Sq) == 1
+    assert _ring_hops(8, Sq + 1, Sq) == 1
+    assert _ring_hops(8, Sq + 2, Sq) == 2
+    assert _ring_hops(8, 2 * Sq + 1, Sq) == 2
+    assert _ring_hops(2, 10 * Sq, Sq) == 1     # clamped to n-1
 
 
 @pytest.mark.parametrize("case", CASES)

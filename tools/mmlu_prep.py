@@ -32,6 +32,7 @@ import argparse
 import io
 import json
 import os
+import re
 import sys
 import zipfile
 
@@ -42,6 +43,10 @@ from mobilefinetuner_tpu.eval.mmlu import (MCQItem, parse_mmlu_text,
 from mobilefinetuner_tpu.eval.mmlu_categories import SUBJECT_TOPICS
 
 SPLITS = ("dev", "val", "test")
+
+# subjects are written as "<subject>_<split>.csv" under --out: must be a
+# single safe filename component (no separators, no leading dot)
+_SAFE_SUBJECT = re.compile(r"[A-Za-z0-9][A-Za-z0-9 _\-]*$")
 
 
 def csv_field(s: str) -> str:
@@ -74,11 +79,17 @@ def collect_source(source: str):
     identically regardless of packaging."""
     out = {}
 
-    def add(subject, split, items):
+    def add(default_subject, split, items):
+        # The parser fills per-row subjects for headered files that carry a
+        # subject column; group by THAT instead of refiling everything under
+        # the filename — a headered CSV's own subject labels must survive
+        # normalization. The subject becomes an output filename component,
+        # so cell content that could escape --out (separators, '..',
+        # leading dots) is refiled under the filename-derived subject.
         for it in items:
-            it.subject = subject
-        if items:
-            out.setdefault((subject, split), []).extend(items)
+            if not _SAFE_SUBJECT.match(it.subject or ""):
+                it.subject = default_subject
+            out.setdefault((it.subject, split), []).append(it)
 
     if zipfile.is_zipfile(source):
         with zipfile.ZipFile(source) as z:

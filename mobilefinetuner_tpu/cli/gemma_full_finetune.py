@@ -109,6 +109,11 @@ def main(argv=None) -> int:
     params = jax.device_put(params, shardings)
     compute_dtype = common.compute_dtype_from_args(args)
 
+    # vocab-parallel CE on multi-device meshes (ops/loss.py): with the
+    # tied embed TRAINABLE, this also keeps its gradient V-sharded
+    # (reduce-scatter) instead of all-gathering table + grad per step.
+    ce_mesh = mesh if (mesh.size > 1 and cp_mesh is None) else None
+
     def loss_fn(params_t, _unused, mb):
         hidden = gemma3.hidden_states(
             config, params_t, mb["input_ids"],
@@ -117,7 +122,7 @@ def main(argv=None) -> int:
             cp_mesh=cp_mesh)
         return chunked_lm_cross_entropy_sum(
             hidden, params_t["embed"], mb["labels"],
-            num_chunks=args.loss_chunks)
+            num_chunks=args.loss_chunks, mesh=ce_mesh)
 
     def nll_fn(params_t, _unused, mb):
         hidden = gemma3.hidden_states(
@@ -126,7 +131,7 @@ def main(argv=None) -> int:
             compute_dtype=compute_dtype, cp_mesh=cp_mesh)
         return chunked_lm_cross_entropy_sum(
             hidden, params_t["embed"], mb["labels"],
-            num_chunks=args.loss_chunks)
+            num_chunks=args.loss_chunks, mesh=ce_mesh)
 
     def save_hook(step, params_t, opt_st, final):
         path = args.output_path

@@ -152,6 +152,11 @@ def main(argv=None) -> int:
             return fetch_fn(frozen), None
         return resolve_offload(frozen, offload_arg)
 
+    # vocab-parallel CE on multi-device meshes: the fsdp-sharded 262k
+    # embed must not be all-gathered per step (ops/loss.py). Not in
+    # sequence-parallel mode — there the fsdp axis carries the sequence.
+    ce_mesh = mesh if (mesh.size > 1 and cp_mesh is None) else None
+
     def loss_fn(lora_t, frozen, mb):
         p, stream = resolve(frozen)
         # per-(step, micro-batch) dropout key, threaded via the batch
@@ -164,7 +169,8 @@ def main(argv=None) -> int:
             block_stream=stream, cp_mesh=cp_mesh)
         # lm_head tied to embeddings; chunked CE avoids [B,S,262k] logits
         return chunked_lm_cross_entropy_sum(
-            hidden, p["embed"], mb["labels"], num_chunks=args.loss_chunks)
+            hidden, p["embed"], mb["labels"], num_chunks=args.loss_chunks,
+            mesh=ce_mesh)
 
     def nll_fn(lora_t, frozen, mb):
         p, stream = resolve(frozen)
@@ -174,7 +180,8 @@ def main(argv=None) -> int:
             compute_dtype=compute_dtype, block_stream=stream,
             cp_mesh=cp_mesh)
         return chunked_lm_cross_entropy_sum(
-            hidden, p["embed"], mb["labels"], num_chunks=args.loss_chunks)
+            hidden, p["embed"], mb["labels"], num_chunks=args.loss_chunks,
+            mesh=ce_mesh)
 
     if args.align_dump_dir:
         from mobilefinetuner_tpu.align.dump import run_align_dump

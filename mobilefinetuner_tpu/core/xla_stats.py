@@ -29,6 +29,18 @@ def compiled_peak_mb(compiled) -> float:
     return compiled_peak_bytes(compiled) / 2 ** 20
 
 
+def shaped_all_gathers(compiled, shape, dtypes=("f32", "bf16")) -> list:
+    """HLO lines of `compiled` where an all-gather involves a tensor of
+    exactly `shape` — the sharding-assertion primitive behind "the
+    V-sharded embed table is never all-gathered" (vocab-parallel CE,
+    ops/loss.py; asserted by tests/test_multichip.py and
+    __graft_entry__.dryrun_multichip)."""
+    table = "[" + ",".join(str(d) for d in shape) + "]"
+    needles = [f"{dt}{table}" for dt in dtypes]
+    return [ln for ln in compiled.as_text().splitlines()
+            if "all-gather" in ln and any(n in ln for n in needles)]
+
+
 def live_hbm_mb() -> float:
     """Device bytes-in-use, when the platform exposes memory_stats()
     (the tunneled TPU platform does not; CPU and direct TPU do)."""

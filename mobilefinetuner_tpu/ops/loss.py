@@ -86,9 +86,120 @@ def lm_cross_entropy_with_count(
     return nll.sum() / jnp.maximum(count, 1), count
 
 
-@partial(jax.jit, static_argnames=("ignore_index", "num_chunks"))
-def _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks):
+def _shift_and_chunk(hidden, labels, ignore_index, num_chunks):
+    """Shared shift/pad/chunk front end: [B,S,H] -> [num_chunks,B,chunk,H]
+    (positions 0..S-2 predict labels 1..S-1; the pad tail is ignored)."""
     B, S, H = hidden.shape
+    hidden_s = hidden[:, :-1, :]
+    labels_s = labels[:, 1:]
+    Sm1 = S - 1
+    pad = (-Sm1) % num_chunks
+    if pad:
+        hidden_s = jnp.pad(hidden_s, ((0, 0), (0, pad), (0, 0)))
+        labels_s = jnp.pad(labels_s, ((0, 0), (0, pad)),
+                           constant_values=ignore_index)
+    chunk = (Sm1 + pad) // num_chunks
+    hs = hidden_s.reshape(B, num_chunks, chunk, H).swapaxes(0, 1)
+    ls = labels_s.reshape(B, num_chunks, chunk).swapaxes(0, 1)
+    return hs, ls
+
+
+def _vp_chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks,
+                        mesh, batch_axis, vocab_axis):
+    """Vocab-parallel chunked CE under shard_map — the multi-device path.
+
+    The fsdp-sharded [V, H] head table must NOT be all-gathered per step:
+    without explicit structure GSPMD picks exactly that (gather the table,
+    keep B fully sharded), which for Gemma's 262k vocab re-materializes
+    the full table in HBM each step and defeats FSDP — and
+    with_sharding_constraint on the logits alone is not enough (the
+    partitioner's cost model still gathers the table at large mesh
+    sizes). shard_map makes the Megatron-style algorithm structural:
+    each device holds its [V/n, H] shard, computes its logits slice
+    [B/data, chunk, V/n], and the softmax statistics reduce over the
+    vocab axis with three tiny psums per chunk (max, sum-exp, gold
+    logit). Per-device FLOPs equal the batch-sharded layout; the only
+    resharding is a small hidden all-gather over the vocab axis.
+    Gradients flow through dot/psum/take_along_axis (pmax is wrapped in
+    stop_gradient — the lse value is invariant to the max shift, so the
+    softmax gradient is exact). tests/test_multichip.py asserts the
+    compiled HLO carries no full-table all-gather.
+    """
+    from jax.sharding import PartitionSpec as P
+    shard_map = jax.shard_map
+
+    if jnp.issubdtype(hidden.dtype, jnp.floating):
+        lm_head_w = lm_head_w.astype(hidden.dtype)
+    hs, ls = _shift_and_chunk(hidden, labels, ignore_index, num_chunks)
+
+    def local(hs, ls, w):
+        vloc = w.shape[0]
+        start = jax.lax.axis_index(vocab_axis) * vloc
+
+        def body(carry, xs):
+            total, count = carry
+            h, lab = xs
+            logits = jax.lax.dot_general(
+                h, w, (((2,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [B_loc, chunk, V/n]
+            valid = lab != ignore_index
+            # global max via all_gather (pmax has no differentiation rule
+            # even under an outer stop_gradient — tracing is inside-out);
+            # the gathered tensor is a tiny [n, B_loc, chunk]
+            m = jax.lax.stop_gradient(
+                jax.lax.all_gather(logits.max(-1), vocab_axis).max(0))
+            se = jnp.sum(jnp.exp(logits - m[..., None]), -1)
+            lse = jnp.log(jax.lax.psum(se, vocab_axis)) + m
+            loc = lab - start
+            in_shard = valid & (loc >= 0) & (loc < vloc)
+            safe = jnp.clip(loc, 0, vloc - 1)
+            gold_loc = jnp.take_along_axis(
+                logits, safe[..., None], axis=-1)[..., 0]
+            gold = jnp.where(in_shard, gold_loc, 0.0)
+            gold = jax.lax.psum(gold, vocab_axis)
+            nll = jnp.where(valid, lse - gold, 0.0)
+            return (total + nll.sum(), count + valid.sum()), None
+
+        (total, count), _ = jax.lax.scan(
+            jax.checkpoint(body), (jnp.float32(0.0), jnp.int32(0)),
+            (hs, ls))
+        # batch is sharded over batch_axis only (vocab-axis members hold
+        # identical replicas after the psums above)
+        return (jax.lax.psum(total, batch_axis),
+                jax.lax.psum(count, batch_axis))
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(None, batch_axis, None, None),
+                  P(None, batch_axis, None), P(vocab_axis, None)),
+        out_specs=(P(), P()), check_vma=False)(hs, ls, lm_head_w)
+
+
+@partial(jax.jit, static_argnames=("ignore_index", "num_chunks", "mesh",
+                                   "batch_axis", "vocab_axis"))
+def _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks,
+                     mesh=None, batch_axis="data", vocab_axis="fsdp"):
+    if mesh is not None:
+        V = lm_head_w.shape[0]
+        B = hidden.shape[0]
+        n_vocab = mesh.shape.get(vocab_axis, 1)
+        n_batch = mesh.shape.get(batch_axis, 1)
+        if n_vocab > 1 and V % n_vocab == 0 and B % n_batch == 0:
+            return _vp_chunked_nll_sum(hidden, lm_head_w, labels,
+                                       ignore_index, num_chunks, mesh,
+                                       batch_axis, vocab_axis)
+        if n_vocab > 1:
+            # the caller asked for vocab-parallel but the shapes can't
+            # shard — warn (once per trace: shapes are static) instead of
+            # silently reintroducing the full-table all-gather/OOM this
+            # path exists to prevent
+            import warnings
+            warnings.warn(
+                f"vocab-parallel CE requested but V={V} % {vocab_axis}="
+                f"{n_vocab} != 0 or B={B} % {batch_axis}={n_batch} != 0; "
+                f"falling back to the single-program chunked CE (GSPMD "
+                f"may all-gather the full [V, H] head table per step)",
+                stacklevel=2)
     # Head matmul in the COMPUTE dtype with f32 accumulation: casting both
     # operands to f32 (the old form) forces the multi-pass f32 MXU
     # lowering on the [chunk, H] x [H, 262k] projection — the dominant
@@ -100,19 +211,7 @@ def _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks):
     # --dtype float32) are bit-for-bit unchanged.
     if jnp.issubdtype(hidden.dtype, jnp.floating):
         lm_head_w = lm_head_w.astype(hidden.dtype)
-    # Shift first: positions 0..S-2 predict labels 1..S-1.
-    hidden_s = hidden[:, :-1, :]
-    labels_s = labels[:, 1:]
-    # Pad S-1 up to a multiple of num_chunks with ignored positions.
-    Sm1 = S - 1
-    pad = (-Sm1) % num_chunks
-    if pad:
-        hidden_s = jnp.pad(hidden_s, ((0, 0), (0, pad), (0, 0)))
-        labels_s = jnp.pad(labels_s, ((0, 0), (0, pad)),
-                           constant_values=ignore_index)
-    chunk = (Sm1 + pad) // num_chunks
-    hs = hidden_s.reshape(B, num_chunks, chunk, H).swapaxes(0, 1)
-    ls = labels_s.reshape(B, num_chunks, chunk).swapaxes(0, 1)
+    hs, ls = _shift_and_chunk(hidden, labels, ignore_index, num_chunks)
 
     def body(carry, xs):
         total, count = carry
@@ -131,27 +230,37 @@ def _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks):
 def chunked_lm_cross_entropy(hidden: jnp.ndarray, lm_head_w: jnp.ndarray,
                              labels: jnp.ndarray,
                              ignore_index: int = IGNORE_INDEX,
-                             num_chunks: int = 8) -> jnp.ndarray:
+                             num_chunks: int = 8, mesh=None,
+                             batch_axis: str = "data",
+                             vocab_axis: str = "fsdp") -> jnp.ndarray:
     """Mean causal-LM loss computed without materializing [B,S,V] logits.
 
     hidden: [B, S, H] final hidden states; lm_head_w: [V, H] (HF layout);
     labels: [B, S] unshifted. The projection + logsumexp runs per sequence
     chunk under lax.scan with rematerialization, so peak memory holds one
     [B, S/num_chunks, V] block. Differentiable end-to-end.
+
+    mesh: pass the ("data", "fsdp") device mesh when lm_head_w is
+    FSDP-sharded to run the CE vocab-parallel (table stays sharded; see
+    _chunked_nll_sum). Do NOT pass it in sequence-parallel mode, where the
+    fsdp axis carries the sequence, not the vocab.
     """
     total, count = _chunked_nll_sum(hidden, lm_head_w, labels,
-                                    ignore_index, num_chunks)
+                                    ignore_index, num_chunks, mesh,
+                                    batch_axis, vocab_axis)
     return total / jnp.maximum(count, 1).astype(jnp.float32)
 
 
 def chunked_lm_cross_entropy_sum(
         hidden: jnp.ndarray, lm_head_w: jnp.ndarray, labels: jnp.ndarray,
-        ignore_index: int = IGNORE_INDEX,
-        num_chunks: int = 8) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        ignore_index: int = IGNORE_INDEX, num_chunks: int = 8, mesh=None,
+        batch_axis: str = "data",
+        vocab_axis: str = "fsdp") -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(sum_nll, valid_token_count) form of the chunked loss — the
-    accumulation-friendly contract the train step uses (trainer.py)."""
+    accumulation-friendly contract the train step uses (trainer.py).
+    mesh: see chunked_lm_cross_entropy."""
     return _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index,
-                            num_chunks)
+                            num_chunks, mesh, batch_axis, vocab_axis)
 
 
 def perplexity_from_loss(loss) -> float:

@@ -405,7 +405,7 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                  mask=None, start_step: int = 0, opt_state=None,
                  save_hook: Optional[Callable] = None,
                  mesh=None, replicate_trainable: bool = True,
-                 dropout_rng=None):
+                 dropout_rng=None, step_builder=None):
     """The shared optimizer-step loop: compiled step + eval cadence + EMA +
     metrics CSV + JSONL eval records + governor throttle + periodic saves.
 
@@ -443,7 +443,10 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     eval_mesh = mesh if (mesh is not None and multiproc) else None
     eval_sp = getattr(args, "sequence_parallel", False)
 
-    step_fn = make_train_step(loss_fn, tc, mask=mask, donate=True)
+    # step_builder: alternate step factory with make_train_step's contract
+    # (the optimizer-offload path, optim/opt_offload.py, plugs in here)
+    step_fn = (step_builder or make_train_step)(loss_fn, tc, mask=mask,
+                                                donate=True)
     eval_step = make_eval_step(nll_fn)
     if opt_state is None:
         opt_state = init_optimizer(trainable, tc, mask)

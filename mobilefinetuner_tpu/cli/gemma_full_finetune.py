@@ -53,6 +53,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--eval_out", default="")
     p.add_argument("--loss_chunks", type=int, default=8,
                    help="sequence chunks for the 262k-vocab chunked CE")
+    p.add_argument("--opt_offload", action="store_true",
+                   help="stream f32 master weights + Adam m/v from pinned "
+                        "host RAM through a per-leaf scanned update; the "
+                        "device holds only the compute-dtype copy. "
+                        "Enables 1B-class full FT on one 16 GB chip "
+                        "(optim/opt_offload.py); single-chip only")
     common.add_train_flags(p, lr=2e-5, seq_len=256, batch_size=1)
     common.add_pm_flags(p)
     common.add_mesh_flags(p)
@@ -99,15 +105,47 @@ def main(argv=None) -> int:
     log.info(f"full FT: {n_params:,} trainable params, "
              f"{total_steps} steps")
 
-    opt_state, start_step = common.maybe_resume_opt_state(
-        args, params, tc, None)
-
-    # Full FT: params themselves are the trainable tree — FSDP-shard them
-    # (and thus Adam m/v) over the mesh; no host offload of trainables.
     mesh, cp_mesh = common.build_mesh(args)
-    shardings = params_shardings(params, mesh)
-    params = jax.device_put(params, shardings)
     compute_dtype = common.compute_dtype_from_args(args)
+    step_builder = None
+    plan = None
+    if args.opt_offload:
+        # master + Adam state stream from pinned host; device holds only
+        # the compute copy (optim/opt_offload.py)
+        from mobilefinetuner_tpu.optim import opt_offload as oo
+        if mesh.size > 1:
+            raise SystemExit("--opt_offload is single-chip (it streams "
+                             "state through one chip's host link); drop "
+                             "--mesh_data/--mesh_fsdp")
+        plan = oo.plan_opt_offload(params)
+        trainable, opt_state = oo.init_opt_offload(
+            params, plan, compute_dtype=compute_dtype)
+        start_step = 0
+        if args.resume_from and os.path.exists(args.resume_from + ".opt"):
+            opt_state = oo.resume_opt_sidecar(args.resume_from + ".opt",
+                                              opt_state)
+            start_step = int(opt_state["step"])
+            log.info(f"restored offloaded opt state @ step {start_step}")
+        n_streamed = sum(1 for c in jax.tree.leaves(plan) if c)
+        host_mb = sum(x.size * 4 * 3 / 2 ** 20
+                      for x, c in zip(jax.tree.leaves(params),
+                                      jax.tree.leaves(plan)) if c)
+        log.info(f"opt offload: {n_streamed} leaves "
+                 f"({host_mb:.0f} MB master+m+v) -> pinned host")
+
+        def step_builder(loss_fn, tc, mask=None, donate=True):
+            return oo.make_offload_train_step(
+                loss_fn, tc, plan, compute_dtype=compute_dtype,
+                donate=donate)
+        params = trainable
+    else:
+        opt_state, start_step = common.maybe_resume_opt_state(
+            args, params, tc, None)
+        # Full FT: params themselves are the trainable tree — FSDP-shard
+        # them (and thus Adam m/v) over the mesh; no host offload of
+        # trainables.
+        shardings = params_shardings(params, mesh)
+        params = jax.device_put(params, shardings)
 
     # vocab-parallel CE on multi-device meshes (ops/loss.py): with the
     # tied embed TRAINABLE, this also keeps its gradient V-sharded
@@ -140,9 +178,16 @@ def main(argv=None) -> int:
             path = f"{root}_step{step}{ext}"
         if os.path.dirname(path):
             os.makedirs(os.path.dirname(path), exist_ok=True)
-        save_gemma3(path, params_t)
-        adam_mod.save_state(path + ".opt", jax.device_get(opt_st),
-                            tc.adam())
+        if args.opt_offload:
+            # the f32 MASTER is the real model (params_t is the bf16
+            # compute copy); the sidecar carries step + m/v only
+            from mobilefinetuner_tpu.optim import opt_offload as oo
+            save_gemma3(path, oo.master_to_params(opt_st, plan, params_t))
+            oo.save_opt_sidecar(path + ".opt", opt_st, tc.adam())
+        else:
+            save_gemma3(path, params_t)
+            adam_mod.save_state(path + ".opt", jax.device_get(opt_st),
+                                tc.adam())
         log.info(f"saved full model -> {path}")
 
     common.run_training(
@@ -150,7 +195,7 @@ def main(argv=None) -> int:
         nll_fn=nll_fn, train_ds=train_ds, valid_ds=valid_ds,
         total_steps=total_steps, tc=tc, mask=None, start_step=start_step,
         opt_state=opt_state, save_hook=save_hook, mesh=mesh,
-        replicate_trainable=False)
+        replicate_trainable=False, step_builder=step_builder)
     return 0
 
 

@@ -1,0 +1,140 @@
+"""Optimizer-state/master-weight host offload (optim/opt_offload.py):
+the streamed per-leaf Adam update must be numerically identical to the
+resident trainer's update, and the master round trip must preserve
+shapes/values for checkpointing."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mobilefinetuner_tpu.core.config import Gemma3TextConfig
+from mobilefinetuner_tpu.models import gemma3
+from mobilefinetuner_tpu.ops.loss import chunked_lm_cross_entropy_sum
+from mobilefinetuner_tpu.optim.opt_offload import (OptOffloadSpec,
+                                                   init_opt_offload,
+                                                   make_offload_train_step,
+                                                   master_to_params,
+                                                   plan_opt_offload)
+from mobilefinetuner_tpu.train.trainer import (TrainConfig, init_optimizer,
+                                               make_train_step)
+
+CFG = Gemma3TextConfig(
+    vocab_size=512, hidden_size=32, intermediate_size=64,
+    num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+    head_dim=8, max_position_embeddings=64, sliding_window=16,
+    query_pre_attn_scalar=8.0, sliding_window_pattern=3)
+
+
+def make_problem(seed=0):
+    params = gemma3.init_params(CFG, jax.random.PRNGKey(seed))
+    rng = np.random.default_rng(seed)
+    ids = jnp.asarray(rng.integers(0, CFG.vocab_size, (4, 32)), jnp.int32)
+    batch = {"input_ids": ids, "attention_mask": jnp.ones_like(ids),
+             "labels": ids}
+    return params, batch
+
+
+def loss_fn(params_t, _unused, mb):
+    hidden = gemma3.hidden_states(CFG, params_t, mb["input_ids"],
+                                  attention_mask=mb["attention_mask"])
+    return chunked_lm_cross_entropy_sum(hidden, params_t["embed"],
+                                        mb["labels"], num_chunks=2)
+
+
+def test_plan_chunks_2d_and_stacked():
+    params, _ = make_problem()
+    spec = OptOffloadSpec(min_stream_bytes=1 << 10, chunk_bytes=1 << 12)
+    plan = plan_opt_offload(params, spec)
+    # [L, ...] stacks stream with C = L
+    assert plan["blocks"]["attn"]["q_w"] == CFG.num_hidden_layers
+    # the [512, 32] embed row-chunks: C divides 512, chunk <= ~4 KB
+    c = plan["embed"]
+    assert c > 1 and 512 % c == 0 and (512 // c) * 32 * 4 <= (1 << 12)
+    # tiny norms stay resident
+    assert plan["final_norm"] == 0
+
+
+def test_streamed_update_matches_resident_trainer():
+    """3 steps of the offloaded step vs trainer.make_train_step on an f32
+    compute copy: master weights, moments, loss, and grad_norm must agree
+    (compute_dtype f32 makes the gradients bit-comparable)."""
+    params, batch = make_problem()
+    tc = TrainConfig(total_steps=4, lr=1e-3, grad_accum_steps=2,
+                     schedule="constant", warmup_ratio=0.0,
+                     weight_decay=0.01)
+    spec = OptOffloadSpec(min_stream_bytes=1 << 10, chunk_bytes=1 << 12)
+    plan = plan_opt_offload(params, spec)
+    compute, opt = init_opt_offload(params, plan,
+                                    compute_dtype=jnp.float32)
+    step_off = make_offload_train_step(loss_fn, tc, plan,
+                                       compute_dtype=jnp.float32,
+                                       donate=False)
+
+    ref_params = jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), params)
+    ref_opt = init_optimizer(ref_params, tc, None)
+    step_ref = make_train_step(loss_fn, tc, mask=None, donate=False)
+
+    for s in range(3):
+        compute, opt, m_off = step_off(compute, None, opt, batch,
+                                       jnp.int32(s))
+        ref_params, ref_opt, m_ref = step_ref(ref_params, None, ref_opt,
+                                              batch, jnp.int32(s))
+        assert float(m_off["loss"]) == pytest.approx(
+            float(m_ref["loss"]), rel=1e-6), s
+        assert float(m_off["grad_norm"]) == pytest.approx(
+            float(m_ref["grad_norm"]), rel=1e-5), s
+
+    got = master_to_params(opt, plan, params)
+    for path, ref_leaf in jax.tree_util.tree_flatten_with_path(
+            ref_params)[0]:
+        leaf = got
+        for k in path:
+            leaf = leaf[k.key]
+        np.testing.assert_allclose(np.asarray(leaf), np.asarray(ref_leaf),
+                                   rtol=1e-5, atol=1e-6, err_msg=str(path))
+    # the device compute copy tracks the master
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(compute["embed"])),
+        np.asarray(got["embed"]), rtol=1e-5, atol=1e-6)
+    # moments really moved
+    assert float(jnp.abs(jax.device_get(
+        opt["m"]["blocks"]["attn"]["q_w"])).max()) > 0
+
+
+def test_streamed_state_lives_on_host():
+    params, _ = make_problem()
+    spec = OptOffloadSpec(min_stream_bytes=1 << 10, chunk_bytes=1 << 12)
+    plan = plan_opt_offload(params, spec)
+    compute, opt = init_opt_offload(params, plan)
+    # on the CPU test backend the host tier falls back to device memory
+    # (see _shardings); on TPU this is "pinned_host"
+    host_kind = "device" if jax.devices()[0].platform == "cpu" \
+        else "pinned_host"
+    assert opt["master"]["embed"].sharding.memory_kind == host_kind
+    assert opt["v"]["blocks"]["mlp"]["gate_w"].sharding.memory_kind == \
+        host_kind
+    assert opt["master"]["final_norm"].sharding.memory_kind == "device"
+    assert compute["embed"].dtype == jnp.bfloat16
+    assert compute["embed"].sharding.memory_kind == "device"
+
+
+def test_bf16_compute_trains_and_loss_decreases():
+    """The real configuration (bf16 compute copy): loss decreases and the
+    step count advances."""
+    params, batch = make_problem(seed=1)
+    tc = TrainConfig(total_steps=6, lr=5e-3, schedule="constant",
+                     warmup_ratio=0.0)
+    plan = plan_opt_offload(params, OptOffloadSpec(min_stream_bytes=1 << 10,
+                                                   chunk_bytes=1 << 12))
+    compute, opt = init_opt_offload(params, plan)
+    step = make_offload_train_step(loss_fn, tc, plan, donate=False)
+    losses = []
+    for s in range(5):
+        compute, opt, m = step(compute, None, opt, batch, jnp.int32(s))
+        losses.append(float(m["loss"]))
+    assert int(opt["step"]) == 5
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses

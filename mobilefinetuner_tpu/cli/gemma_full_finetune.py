@@ -136,7 +136,7 @@ def main(argv=None) -> int:
         def step_builder(loss_fn, tc, mask=None, donate=True):
             return oo.make_offload_train_step(
                 loss_fn, tc, plan, compute_dtype=compute_dtype,
-                donate=donate)
+                donate=donate, mask=mask)
         params = trainable
     else:
         opt_state, start_step = common.maybe_resume_opt_state(

@@ -174,8 +174,15 @@ def gpt2_generate(config: GPT2Config, params, input_ids, attention_mask,
             entry = None if lora_b is None else lora_b.get(name)
             return maybe_lora(y, x_in, entry, i)
 
-        def layer(x, inp):
-            bp, kc_l, vc_l, i = inp               # kc_l: [B, H, T, D]
+        def layer(inner, inp):
+            # The [L, B, H, T, D] caches ride the inner CARRY and are
+            # updated with one [1,B,H,1,D] dynamic-update-slice per layer.
+            # The previous structure scanned them as xs and restacked them
+            # as ys, which materialized a full-cache copy per decode step
+            # (~460 us/step for GPT-2s B=8, measured — the single largest
+            # decode cost). As carry leaves, the updates alias in place.
+            x, kc, vc = inner
+            bp, i = inp
             h = gpt2.layer_norm(x, bp["ln_1"]["g"], bp["ln_1"]["b"], eps)
             qkv = h @ bp["attn"]["qkv_w"] + bp["attn"]["qkv_b"]
             qkv = apply_lora(qkv, h, "attn_qkv", i)
@@ -192,16 +199,28 @@ def gpt2_generate(config: GPT2Config, params, input_ids, attention_mask,
             q, k, v = jnp.split(qkv, 3, axis=-1)
             hd = lambda z: z.reshape(B, H, D)
             q, k, v = hd(q), hd(k), hd(v)
-            kc_l = jax.lax.dynamic_update_slice(
-                kc_l, k[:, :, None, :].astype(kc_l.dtype), (0, 0, P + t, 0))
-            vc_l = jax.lax.dynamic_update_slice(
-                vc_l, v[:, :, None, :].astype(vc_l.dtype), (0, 0, P + t, 0))
-            s = jnp.einsum("bhd,bhtd->bht", q.astype(jnp.float32),
-                           kc_l.astype(jnp.float32)) / (D ** 0.5)
+            kc = jax.lax.dynamic_update_slice(
+                kc, k[None, :, :, None, :].astype(kc.dtype),
+                (i, 0, 0, P + t, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, v[None, :, :, None, :].astype(vc.dtype),
+                (i, 0, 0, P + t, 0))
+            kc_l = jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False)
+            vc_l = jax.lax.dynamic_index_in_dim(vc, i, 0, keepdims=False)
+            # keep the cache operands in their storage dtype and accumulate
+            # in f32 (preferred_element_type): an explicit .astype(f32) on
+            # the [B,H,T,D] cache slices materializes ~9 MB of converts
+            # per layer per token — measured decode cost, not a numerics
+            # win (softmax statistics stay f32 either way). (Tried and
+            # rejected: broadcasting q to 8 query rows to force the MXU —
+            # the extra consumer broke the cache DUS aliasing and brought
+            # full-cache copies back, 1.35 -> 1.62 ms/token.)
+            s = jnp.einsum("bhd,bhtd->bht", q, kc_l,
+                           preferred_element_type=jnp.float32) / (D ** 0.5)
             s = jnp.where(valid[:, None, :], s, NEG_INF)
             p = jax.nn.softmax(s, axis=-1)
-            ctx = jnp.einsum("bht,bhtd->bhd", p,
-                             vc_l.astype(jnp.float32))
+            ctx = jnp.einsum("bht,bhtd->bhd", p.astype(vc_l.dtype), vc_l,
+                             preferred_element_type=jnp.float32)
             ctx = ctx.reshape(B, E).astype(compute_dtype)
             proj = ctx @ bp["attn"]["proj_w"] + bp["attn"]["proj_b"]
             proj = apply_lora(proj, ctx, "attn_proj", i)
@@ -211,10 +230,10 @@ def gpt2_generate(config: GPT2Config, params, input_ids, attention_mask,
             fc = gpt2.gelu_new(apply_lora(fc, h2, "mlp_fc_in", i))
             out = fc @ bp["mlp"]["proj_w"] + bp["mlp"]["proj_b"]
             out = apply_lora(out, fc, "mlp_fc_out", i)
-            return x + out, (kc_l, vc_l)
+            return (x + out, kc, vc), None
 
-        x, (kc, vc) = jax.lax.scan(
-            layer, x, (wb, kc, vc, jnp.arange(L, dtype=jnp.int32)))
+        (x, kc, vc), _ = jax.lax.scan(
+            layer, (x, kc, vc), (wb, jnp.arange(L, dtype=jnp.int32)))
         x = gpt2.layer_norm(x, params["ln_f"]["g"].astype(compute_dtype),
                             params["ln_f"]["b"].astype(compute_dtype), eps)
         logits = x @ params["wte"].astype(compute_dtype).T
@@ -295,8 +314,12 @@ def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
             entry = None if lora_b is None else lora_b.get(name)
             return maybe_lora(y, x_in, entry, i)
 
-        def layer(x, inp):
-            bp, kc_l, vc_l, glob, i = inp
+        def layer(inner, inp):
+            # caches ride the inner CARRY (one [1,B,Hkv,1,D] DUS per
+            # layer); scanning them as xs/ys restacked the full cache
+            # every decode step — see the GPT-2 decode note above
+            x, kc, vc = inner
+            bp, glob, i = inp
             a = bp["attn"]
             h = gemma3.rms_norm(x, bp["input_ln"], eps)
             q = apply_lora(h @ a["q_w"], h, "q_proj", i).reshape(B, nq, D)
@@ -309,18 +332,23 @@ def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
             # apply_rope expects [..., S, D]; insert S=1
             q = apply_rope(q[:, :, None, :], cos, sin)[:, :, 0]
             k = apply_rope(k[:, :, None, :], cos, sin)[:, :, 0]
-            kc_l = jax.lax.dynamic_update_slice(
-                kc_l, k[:, :, None, :].astype(kc_l.dtype), (0, 0, P + t, 0))
-            vc_l = jax.lax.dynamic_update_slice(
-                vc_l, v[:, :, None, :].astype(vc_l.dtype), (0, 0, P + t, 0))
-            qg = q.reshape(B, nkv, G, D).astype(jnp.float32)
-            s = jnp.einsum("bkgd,bktd->bkgt", qg,
-                           kc_l.astype(jnp.float32)) * scale
+            kc = jax.lax.dynamic_update_slice(
+                kc, k[None, :, :, None, :].astype(kc.dtype),
+                (i, 0, 0, P + t, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, v[None, :, :, None, :].astype(vc.dtype),
+                (i, 0, 0, P + t, 0))
+            kc_l = jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False)
+            vc_l = jax.lax.dynamic_index_in_dim(vc, i, 0, keepdims=False)
+            qg = q.reshape(B, nkv, G, D)
+            # storage-dtype operands + f32 accumulation (see GPT-2 note)
+            s = jnp.einsum("bkgd,bktd->bkgt", qg, kc_l,
+                           preferred_element_type=jnp.float32) * scale
             ok = jnp.where(glob, valid, valid & win_ok)         # [B, T]
             s = jnp.where(ok[:, None, None, :], s, NEG_INF)
             p = jax.nn.softmax(s, axis=-1)
-            ctx = jnp.einsum("bkgt,bktd->bkgd", p,
-                             vc_l.astype(jnp.float32))
+            ctx = jnp.einsum("bkgt,bktd->bkgd", p.astype(vc_l.dtype), vc_l,
+                             preferred_element_type=jnp.float32)
             ctx = ctx.reshape(B, nq * D).astype(compute_dtype)
             attn_out = apply_lora(ctx @ a["o_w"], ctx, "o_proj", i)
             attn_out = gemma3.rms_norm(attn_out, bp["post_attn_ln"], eps)
@@ -332,11 +360,11 @@ def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
             down = apply_lora(act @ bp["mlp"]["down_w"], act,
                               "down_proj", i)
             down = gemma3.rms_norm(down, bp["post_ffn_ln"], eps)
-            return x + down, (kc_l, vc_l)
+            return (x + down, kc, vc), None
 
-        x, (kc, vc) = jax.lax.scan(
-            layer, x,
-            (wb, kc, vc, is_global,
+        (x, kc, vc), _ = jax.lax.scan(
+            layer, (x, kc, vc),
+            (wb, is_global,
              jnp.arange(c.num_hidden_layers, dtype=jnp.int32)))
         x = gemma3.rms_norm(x, params["final_norm"].astype(compute_dtype),
                             eps)

@@ -76,12 +76,18 @@ def plan_opt_offload(params, spec: OptOffloadSpec = OptOffloadSpec()):
         rows = int(np.shape(x)[0])
         row_bytes = nbytes // rows
         target_rows = max(1, spec.chunk_bytes // max(row_bytes, 1))
-        # smallest chunk count whose chunk fits the target AND divides the
-        # row count (chunks must tile evenly for the [C, rows/C, ...] view)
-        c = max(1, -(-rows // target_rows))
-        while rows % c != 0:
-            c += 1
-        return c
+        # smallest chunk count >= the ideal that divides the row count
+        # (chunks must tile evenly for the [C, rows/C, ...] view). The
+        # search is BOUNDED: an awkward row count (e.g. prime) must not
+        # explode into a per-row scan of kilobyte DMAs on the
+        # latency-bound host link — past 4x the ideal, fall back to the
+        # largest divisor UNDER the ideal (possibly 1 = one whole-leaf
+        # chunk, a transient-HBM cost instead of a pathological loop).
+        ideal = max(1, -(-rows // target_rows))
+        for c in range(ideal, min(4 * ideal, rows) + 1):
+            if rows % c == 0:
+                return c
+        return max(c for c in range(1, ideal + 1) if rows % c == 0)
     return jax.tree.map(leaf_plan, params)
 
 
@@ -115,14 +121,18 @@ def init_opt_offload(params, plan, compute_dtype=jnp.bfloat16, device=None):
     dev_sh, host_sh = _shardings(device)
 
     def place_master(x, c):
-        x = jnp.asarray(x, jnp.float32)
+        # host-numpy staging: jnp.asarray would allocate on DEVICE first
+        # and round-trip device->host — a transient HBM spike the size of
+        # the leaf (1.2 GB for the 262k embed), on top of the still-live
+        # source params, defeating the offload
+        x = np.asarray(x, np.float32)
         if c == 0:
-            return jax.device_put(x, dev_sh)
+            return jax.device_put(jnp.asarray(x), dev_sh)
         return jax.device_put(x.reshape(_streamed_shape(x, c)), host_sh)
 
     def place_zeros(x, c):
-        z = jnp.zeros(_streamed_shape(x, c) if c else np.shape(x),
-                      jnp.float32)
+        z = np.zeros(_streamed_shape(x, c) if c else np.shape(x),
+                     np.float32)
         return jax.device_put(z, host_sh if c else dev_sh)
 
     compute = jax.tree.map(
@@ -170,12 +180,19 @@ def resume_opt_sidecar(path: str, opt_state):
 
 def make_offload_train_step(loss_fn, train_cfg, plan,
                             compute_dtype=jnp.bfloat16, device=None,
-                            donate: bool = True):
+                            donate: bool = True, mask=None):
     """Offloaded analog of trainer.make_train_step — same contract:
     step_fn(compute_params, frozen, opt_state, batch, step) ->
     (compute_params, opt_state, metrics). loss_fn(compute_params, frozen,
-    micro_batch) -> (sum_loss, weight)."""
+    micro_batch) -> (sum_loss, weight). Full-FT only: a trainable-leaf
+    mask is rejected loudly (the streamed update has no frozen-leaf
+    branch — silently updating masked leaves would diverge from the
+    resident trainer)."""
     from mobilefinetuner_tpu.train.trainer import reshape_for_accum
+    if mask is not None:
+        raise NotImplementedError(
+            "make_offload_train_step supports full fine-tuning only "
+            "(mask=None); masked/frozen leaves are not streamed")
     accum = train_cfg.grad_accum_steps
     cfg: AdamConfig = train_cfg.adam()
     if cfg.amsgrad:

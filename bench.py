@@ -71,6 +71,45 @@ def transformer_flops(n_params_active, n_params_frozen, B, S, n_layer,
     return fwd + bwd + 3 * attn
 
 
+def executed_flops(n_block_mm, n_head_mm, n_active, B, S, n_layer, n_head,
+                   head_dim, full_ft, remat_blocks, remat_head,
+                   attn_factor=1.0):
+    """FLOPs the compiled step actually EXECUTES — the MFU denominator the
+    6ND-style formula above gets wrong in two ways (DESIGN.md §5): it
+    counts neither the rematerialization recompute (the checkpointed
+    chunked-CE head and, with --remat, the whole block stack run forward
+    a second time in the backward) nor the fact that the embedding table
+    GATHERS rather than multiplies (only matmul parameters do FLOPs).
+    n_block_mm: matmul params in the layer stack (ndim>=3 leaves);
+    n_head_mm: lm-head matmul params (V*H for the tied-embed head);
+    n_active: trainable matmul params (dW term; for full FT pass
+    n_block_mm + n_head_mm). attn_factor: fraction of the dense S^2
+    attention actually executed — the flash kernel's causal block
+    skipping does ~half (ops/flash_attention.py); XLA's masked dense
+    attention executes it all (1.0)."""
+    T = B * S
+    attn = int(4 * B * n_layer * n_head * S * S * head_dim * attn_factor)
+    mm = n_block_mm + n_head_mm + n_active
+    fwd = 2 * T * mm + attn
+    recompute = ((2 * T * (n_block_mm + n_active) + attn)
+                 if remat_blocks else 0) \
+        + (2 * T * n_head_mm if remat_head else 0)
+    bwd_dx = 2 * T * mm + 2 * attn
+    bwd_dw = 2 * T * (n_active if not full_ft
+                      else n_block_mm + n_head_mm + n_active)
+    return fwd + recompute + bwd_dx + bwd_dw
+
+
+def matmul_param_counts(params, head_key):
+    """(block matmul params, head matmul params): ndim>=3 leaves under
+    "blocks" are the [L, in, out] weight stacks; the tied head is the
+    [V, H] table, a real matmul in the logits projection."""
+    n_block = sum(x.size for x in jax.tree.leaves(params["blocks"])
+                  if x.ndim >= 3)
+    n_head = params[head_key].size
+    return n_block, n_head
+
+
 # Loss columns are comparable ACROSS rows of the same model: every row
 # trains on the SAME seeded token stream (prefix-stable across batch
 # shapes) for the same number of TOKENS (not steps), then the loss is
@@ -219,6 +258,16 @@ def bench_gpt2_lora(B, S, dtype, accum=1, offload=False, impl="auto",
     r["flops"] = transformer_flops(n_active, n_frozen, B * accum, S,
                                    config.n_layer, config.n_head,
                                    config.head_dim, full_ft=False)
+    n_block, n_head = matmul_param_counts(params, "wte")
+    from mobilefinetuner_tpu.ops.attention import resolve_impl
+    uses_flash = (impl == "flash"
+                  or (impl == "auto"
+                      and resolve_impl(S, config.head_dim) == "flash"))
+    r["flops_exec"] = executed_flops(
+        n_block, n_head, n_active, B * accum, S, config.n_layer,
+        config.n_head, config.head_dim, full_ft=False,
+        remat_blocks=remat or offload, remat_head=False,
+        attn_factor=0.5 if uses_flash else 1.0)
     r["tokens"] = B * accum * S
     return r
 
@@ -243,6 +292,11 @@ def bench_gpt2_full(B, S, dtype, steps=40):
     r["flops"] = transformer_flops(n, 0, B, S, config.n_layer,
                                    config.n_head, config.head_dim,
                                    full_ft=True)
+    n_block, n_head = matmul_param_counts(params, "wte")
+    r["flops_exec"] = executed_flops(
+        n_block, n_head, 0, B, S, config.n_layer, config.n_head,
+        config.head_dim, full_ft=True, remat_blocks=False,
+        remat_head=False)
     r["tokens"] = B * S
     return r
 
@@ -282,6 +336,13 @@ def bench_gemma_lora(B, S, dtype, accum=1, offload=False, steps=20,
     r["flops"] = transformer_flops(
         n_active, n_frozen, B * accum, S, config.num_hidden_layers,
         config.num_attention_heads, config.head_dim, full_ft=False)
+    n_block, n_head = matmul_param_counts(params, "embed")
+    r["flops_exec"] = executed_flops(
+        n_block, n_head, n_active, B * accum, S,
+        config.num_hidden_layers, config.num_attention_heads,
+        config.head_dim, full_ft=False,
+        remat_blocks=remat or offload,   # streaming forces body remat
+        remat_head=True)                 # chunked CE is checkpointed
     r["tokens"] = B * accum * S
     return r
 
@@ -319,6 +380,11 @@ def bench_gemma_full_offload(B, S, dtype, steps=10, loss_chunks=8):
     r["flops"] = transformer_flops(
         n, 0, B, S, config.num_hidden_layers,
         config.num_attention_heads, config.head_dim, full_ft=True)
+    n_block, n_head = matmul_param_counts(compute, "embed")
+    r["flops_exec"] = executed_flops(
+        n_block, n_head, 0, B, S, config.num_hidden_layers,
+        config.num_attention_heads, config.head_dim, full_ft=True,
+        remat_blocks=True, remat_head=True)
     r["tokens"] = B * S
     return r
 
@@ -368,6 +434,12 @@ def finish(name, r, dtype, steps) -> dict:
         "tokens_per_sec_per_chip": round(toks_per_sec, 1),
         "vs_baseline": round(toks_per_sec / BASELINE_TOKENS_PER_SEC, 2),
         "mfu": round(r["flops"] * steps / r["dt"] / PEAK_FLOPS[dtype], 4),
+        # mfu from XLA's executed-FLOP count (remat recompute included,
+        # embedding gathers excluded); mfu above is the standard 6ND-style
+        # formula — both published so neither misleads alone
+        "mfu_executed": (round(r["flops_exec"] * steps / r["dt"]
+                               / PEAK_FLOPS[dtype], 4)
+                         if r.get("flops_exec") else None),
         "peak_hbm_mb": round(r["peak_bytes"] / 2 ** 20, 1),
         # held-out loss after >= LOSS_MARK_TOKENS training tokens on the
         # shared stream — comparable across rows of the same model

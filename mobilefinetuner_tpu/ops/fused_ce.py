@@ -1,0 +1,307 @@
+"""Pallas fused cross-entropy head: logits never touch HBM.
+
+The chunked CE (ops/loss.py) bounds peak memory by materializing one
+[B, chunk, V] logits block per scan step — but at Gemma's 262k vocab even
+one chunk's logits are hundreds of MB of f32 that XLA writes, re-reads for
+the two logsumexp passes, and (inside jax.checkpoint) writes and reads
+AGAIN in the backward: the measured ~6 ms/step of bandwidth-bound softmax
+the round-3 verdict flagged (reference standard: the one-pass analytic CE
+backward in core/lm_loss.cpp:19-103, which also never re-materializes).
+
+This kernel streams the vocabulary in VMEM-resident tiles instead:
+
+  forward  — grid over V tiles (sequential); each step computes one
+             [R, BV] logits tile on the MXU, folds it into running
+             online-softmax statistics (m, s) and picks up the gold
+             logit by iota-compare, all in VMEM scratch. HBM traffic is
+             ONE read of W per chunk; logits never leave the chip.
+             Returns (lse, gold) per row — exactly what the NLL needs.
+  backward — split in two kernels so dead-code elimination can drop the
+             dW pass when the head is FROZEN (LoRA: W's cotangent is
+             never consumed, so only the dh kernel survives):
+      dh:  same V-tile loop, recomputes each logits tile, forms
+           coef = dlse*p + dgold*onehot, accumulates coef @ W_tile into
+           a [R, H] VMEM scratch.
+      dW:  grid over V tiles, each program writes its [BV, H] tile of
+           dW = coef^T @ h.
+
+The custom_vjp saves only (h, W, labels, lse) — O(R) beyond the inputs.
+Numerics match ops/loss.py's _token_nll form (f32 max-shifted logsumexp)
+up to tile-order rounding; tests/test_fused_ce.py pins both the forward
+and the gradients to the XLA oracle.
+
+Dispatch outcome (measured, v5e round 4): the kernel is numerically
+exact but ~6% SLOWER than the XLA path at Gemma-270M train shapes and at
+parity at Gemma-1B — XLA's consumer fusions already reduce the chunk
+logits against the matmul output well enough that there is no HBM
+traffic left to win, and the kernel pays per-tile loop overhead
+(DESIGN.md §5a has the numbers). chunked_lm_cross_entropy's "auto"
+therefore resolves to XLA; pass use_fused_kernel=True to force this
+kernel (tests do, in interpret mode; re-measure if the compiler or the
+shapes change).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+_VMEM_BUDGET = 12 * 2 ** 20   # leave headroom under the 16 MB scoped limit
+
+
+def pick_block_v(V: int, R: int = 512, H: int = 1152,
+                 itemsize: int = 2) -> Optional[int]:
+    """Largest lane-aligned vocab tile dividing V that fits the VMEM
+    budget (None = ineligible). Resident per grid step: the [R, H] hidden
+    block and the double-buffered [BV, H] weight tile in the STORAGE
+    dtype (`itemsize` — 2 for bf16, 4 for f32), the [R, BV] f32 logits
+    tile, and the [R, H] f32 accumulator scratch of the dh kernel (the
+    largest of the three kernels)."""
+    fixed = R * H * itemsize + R * H * 4 + 6 * R
+    for bv in (2048, 1024, 512, 256, 128):
+        if V % bv == 0 and \
+                fixed + 2 * bv * H * itemsize + R * bv * 4 <= _VMEM_BUDGET:
+            return bv
+    return None
+
+
+def fused_ce_eligible(R: int, V: int, H: int = 1152,
+                      itemsize: int = 2) -> bool:
+    """Rows must be sublane-aligned; V must tile lane-aligned within the
+    VMEM budget for this (R, H, storage itemsize)."""
+    return R % 8 == 0 and pick_block_v(V, R, H, itemsize) is not None
+
+
+# --------------------------------- forward ----------------------------------
+
+def _fwd_kernel(h_ref, w_ref, lab_ref, lse_ref, gold_ref, m_sc, s_sc,
+                g_sc, *, block_v, n_tiles):
+    vi = pl.program_id(0)
+    col0 = vi * block_v
+
+    @pl.when(vi == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, -jnp.inf)
+        s_sc[:] = jnp.zeros_like(s_sc)
+        g_sc[:] = jnp.zeros_like(g_sc)
+
+    h = h_ref[:]                                   # [R, H] storage dtype
+    w = w_ref[:]                                   # [BV, H]
+    logits = jax.lax.dot_general(
+        h, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)        # [R, BV] f32
+    R, BV = logits.shape
+    cols = jax.lax.broadcasted_iota(jnp.int32, (R, BV), 1) + col0
+    hit = cols == lab_ref[:]                       # [R, BV] (lab [R, 1])
+    m = m_sc[:]
+    m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+    s_sc[:] = s_sc[:] * jnp.exp(m - m_new) \
+        + jnp.sum(jnp.exp(logits - m_new), axis=-1, keepdims=True)
+    m_sc[:] = m_new
+    g_sc[:] = g_sc[:] + jnp.sum(jnp.where(hit, logits, 0.0), axis=-1,
+                                keepdims=True)
+
+    @pl.when(vi == n_tiles - 1)
+    def _fin():
+        lse_ref[:] = m_sc[:] + jnp.log(s_sc[:])
+        gold_ref[:] = g_sc[:]
+
+
+def _fwd(h2, w, labels2):
+    R, H = h2.shape
+    V = w.shape[0]
+    bv = pick_block_v(V, R, H, h2.dtype.itemsize)
+    n = V // bv
+    kernel = functools.partial(_fwd_kernel, block_v=bv, n_tiles=n)
+    lse, gold = pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((R, H), lambda vi: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bv, H), lambda vi: (vi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), lambda vi: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((R, 1), lambda vi: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), lambda vi: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=_interpret(),
+    )(h2, w, labels2)
+    return lse[:, 0], gold[:, 0]
+
+
+# --------------------------------- backward ---------------------------------
+
+def _dh_kernel(h_ref, w_ref, lab_ref, lse_ref, dlse_ref, dgold_ref,
+               dh_ref, acc_sc, *, block_v, n_tiles):
+    vi = pl.program_id(0)
+    col0 = vi * block_v
+
+    @pl.when(vi == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    h = h_ref[:]
+    w = w_ref[:]                                    # [BV, H]
+    logits = jax.lax.dot_general(
+        h, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)         # [R, BV]
+    R, BV = logits.shape
+    p = jnp.exp(logits - lse_ref[:])                # [R, BV]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (R, BV), 1) + col0
+    hit = cols == lab_ref[:]
+    coef = dlse_ref[:] * p + jnp.where(hit, dgold_ref[:], 0.0)
+    acc_sc[:] = acc_sc[:] + jax.lax.dot_general(
+        coef.astype(w.dtype), w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)         # [R, H]
+
+    @pl.when(vi == n_tiles - 1)
+    def _fin():
+        dh_ref[:] = acc_sc[:]
+
+
+def _dw_kernel(h_ref, w_ref, lab_ref, lse_ref, dlse_ref, dgold_ref,
+               dw_ref, *, block_v):
+    vi = pl.program_id(0)
+    col0 = vi * block_v
+    h = h_ref[:]
+    w = w_ref[:]
+    logits = jax.lax.dot_general(
+        h, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    R, BV = logits.shape
+    p = jnp.exp(logits - lse_ref[:])
+    cols = jax.lax.broadcasted_iota(jnp.int32, (R, BV), 1) + col0
+    hit = cols == lab_ref[:]
+    coef = dlse_ref[:] * p + jnp.where(hit, dgold_ref[:], 0.0)
+    dw_ref[:] = jax.lax.dot_general(
+        coef.astype(h.dtype), h, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)         # [BV, H]
+
+
+def _bwd_dh(h2, w, labels2, lse2, dlse2, dgold2):
+    R, H = h2.shape
+    V = w.shape[0]
+    bv = pick_block_v(V, R, H, h2.dtype.itemsize)
+    n = V // bv
+    kernel = functools.partial(_dh_kernel, block_v=bv, n_tiles=n)
+    row = lambda vi: (0, 0)
+    return pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((R, H), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((bv, H), lambda vi: (vi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((R, H), row, memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, H), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((R, H), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=_interpret(),
+    )(h2, w, labels2, lse2, dlse2, dgold2)
+
+
+def _bwd_dw(h2, w, labels2, lse2, dlse2, dgold2):
+    R, H = h2.shape
+    V = w.shape[0]
+    bv = pick_block_v(V, R, H, h2.dtype.itemsize)
+    n = V // bv
+    kernel = functools.partial(_dw_kernel, block_v=bv)
+    row = lambda vi: (0, 0)
+    return pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((R, H), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((bv, H), lambda vi: (vi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bv, H), lambda vi: (vi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((V, H), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=_interpret(),
+    )(h2, w, labels2, lse2, dlse2, dgold2)
+
+
+# ------------------------------ public entry --------------------------------
+
+@jax.custom_vjp
+def fused_ce_rows(hidden2d, w, labels) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(lse [R], gold_logit [R]) for rows of hidden states against the
+    [V, H] head table; labels must be IN-RANGE (caller substitutes 0 for
+    ignore_index positions and masks the NLL outside). Differentiable in
+    hidden2d and w (the dW pass is DCE'd when w's cotangent is unused)."""
+    lse, gold = _fwd(hidden2d, w, labels.reshape(-1, 1))
+    return lse, gold
+
+
+def _vjp_fwd(hidden2d, w, labels):
+    labels2 = labels.reshape(-1, 1)
+    lse, gold = _fwd(hidden2d, w, labels2)
+    return (lse, gold), (hidden2d, w, labels2, lse)
+
+
+def _vjp_bwd(res, cts):
+    hidden2d, w, labels2, lse = res
+    dlse, dgold = cts
+    lse2 = lse.reshape(-1, 1)
+    dlse2 = dlse.reshape(-1, 1).astype(jnp.float32)
+    dgold2 = dgold.reshape(-1, 1).astype(jnp.float32)
+    dh = _bwd_dh(hidden2d, w, labels2, lse2, dlse2, dgold2)
+    dw = _bwd_dw(hidden2d, w, labels2, lse2, dlse2, dgold2)
+    return (dh.astype(hidden2d.dtype), dw.astype(w.dtype), None)
+
+
+fused_ce_rows.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def fused_ce_nll_sum(hidden, lm_head_w, labels,
+                     ignore_index: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(sum_nll, valid_count) over ONE already-shifted chunk
+    [B, chunk, H] / [B, chunk] — the scan-body form ops/loss.py uses."""
+    B, C, H = hidden.shape
+    R = B * C
+    lab = labels.reshape(R)
+    valid = lab != ignore_index
+    safe = jnp.where(valid, lab, 0)
+    lse, gold = fused_ce_rows(hidden.reshape(R, H), lm_head_w, safe)
+    nll = jnp.where(valid, lse - gold, 0.0)
+    return nll.sum(), valid.sum()

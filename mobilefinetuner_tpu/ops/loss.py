@@ -175,16 +175,46 @@ def _vp_chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks,
         out_specs=(P(), P()), check_vma=False)(hs, ls, lm_head_w)
 
 
+def _use_fused_ce(use_fused_kernel, R, V, H, itemsize=2) -> bool:
+    """Resolve the fused-head-kernel dispatch. "auto" currently resolves
+    to the XLA path on every shape: measured on v5e (r4), the Pallas
+    fused head (ops/fused_ce.py) is ~6% SLOWER than XLA's consumer-fused
+    matmul+logsumexp at Gemma-270M shapes and exactly at parity at
+    Gemma-1B — XLA already keeps the chunk logits out of HBM well enough
+    that the kernel's per-tile overhead has nothing to win back
+    (DESIGN.md §5a). True forces the kernel (tests; future re-measure
+    when the compiler or shapes change)."""
+    from mobilefinetuner_tpu.ops.fused_ce import fused_ce_eligible
+    if use_fused_kernel == "auto":
+        return False
+    if not use_fused_kernel:
+        return False
+    if not fused_ce_eligible(R, V, H, itemsize):
+        # forcing must be loud: a silent XLA fallback would let a future
+        # re-measure record XLA numbers as kernel numbers
+        raise ValueError(
+            f"use_fused_kernel=True but the fused CE kernel cannot run "
+            f"R={R}, V={V}, H={H} (alignment or VMEM budget — "
+            f"fused_ce.pick_block_v); use 'auto' for dispatch")
+    return True
+
+
 @partial(jax.jit, static_argnames=("ignore_index", "num_chunks", "mesh",
-                                   "batch_axis", "vocab_axis"))
+                                   "batch_axis", "vocab_axis",
+                                   "use_fused_kernel"))
 def _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks,
-                     mesh=None, batch_axis="data", vocab_axis="fsdp"):
+                     mesh=None, batch_axis="data", vocab_axis="fsdp",
+                     use_fused_kernel="auto"):
     if mesh is not None:
         V = lm_head_w.shape[0]
         B = hidden.shape[0]
         n_vocab = mesh.shape.get(vocab_axis, 1)
         n_batch = mesh.shape.get(batch_axis, 1)
         if n_vocab > 1 and V % n_vocab == 0 and B % n_batch == 0:
+            if use_fused_kernel is True:
+                raise ValueError(
+                    "use_fused_kernel=True is not available under the "
+                    "vocab-parallel mesh path (shard_map CE)")
             return _vp_chunked_nll_sum(hidden, lm_head_w, labels,
                                        ignore_index, num_chunks, mesh,
                                        batch_axis, vocab_axis)
@@ -212,15 +242,28 @@ def _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks,
     if jnp.issubdtype(hidden.dtype, jnp.floating):
         lm_head_w = lm_head_w.astype(hidden.dtype)
     hs, ls = _shift_and_chunk(hidden, labels, ignore_index, num_chunks)
+    nc, B, chunk, H = hs.shape
+    if _use_fused_ce(use_fused_kernel, B * chunk, lm_head_w.shape[0], H,
+                     lm_head_w.dtype.itemsize):
+        # Pallas fused head (ops/fused_ce.py): the [B, chunk, V] logits
+        # block stays in VMEM tiles instead of being written + twice-read
+        # in HBM per chunk (and again in the checkpointed backward)
+        from mobilefinetuner_tpu.ops.fused_ce import fused_ce_nll_sum
 
-    def body(carry, xs):
-        total, count = carry
-        h, lab = xs
-        logits = jax.lax.dot_general(
-            h, lm_head_w, (((2,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [B, chunk, V] f32
-        nll, valid = _token_nll(logits, lab, ignore_index)
-        return (total + nll.sum(), count + valid.sum()), None
+        def body(carry, xs):
+            total, count = carry
+            h, lab = xs
+            s, c = fused_ce_nll_sum(h, lm_head_w, lab, ignore_index)
+            return (total + s, count + c), None
+    else:
+        def body(carry, xs):
+            total, count = carry
+            h, lab = xs
+            logits = jax.lax.dot_general(
+                h, lm_head_w, (((2,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)    # [B, chunk, V] f32
+            nll, valid = _token_nll(logits, lab, ignore_index)
+            return (total + nll.sum(), count + valid.sum()), None
 
     (total, count), _ = jax.lax.scan(
         jax.checkpoint(body), (jnp.float32(0.0), jnp.int32(0)), (hs, ls))
@@ -232,7 +275,8 @@ def chunked_lm_cross_entropy(hidden: jnp.ndarray, lm_head_w: jnp.ndarray,
                              ignore_index: int = IGNORE_INDEX,
                              num_chunks: int = 8, mesh=None,
                              batch_axis: str = "data",
-                             vocab_axis: str = "fsdp") -> jnp.ndarray:
+                             vocab_axis: str = "fsdp",
+                             use_fused_kernel="auto") -> jnp.ndarray:
     """Mean causal-LM loss computed without materializing [B,S,V] logits.
 
     hidden: [B, S, H] final hidden states; lm_head_w: [V, H] (HF layout);
@@ -247,20 +291,22 @@ def chunked_lm_cross_entropy(hidden: jnp.ndarray, lm_head_w: jnp.ndarray,
     """
     total, count = _chunked_nll_sum(hidden, lm_head_w, labels,
                                     ignore_index, num_chunks, mesh,
-                                    batch_axis, vocab_axis)
+                                    batch_axis, vocab_axis,
+                                    use_fused_kernel)
     return total / jnp.maximum(count, 1).astype(jnp.float32)
 
 
 def chunked_lm_cross_entropy_sum(
         hidden: jnp.ndarray, lm_head_w: jnp.ndarray, labels: jnp.ndarray,
         ignore_index: int = IGNORE_INDEX, num_chunks: int = 8, mesh=None,
-        batch_axis: str = "data",
-        vocab_axis: str = "fsdp") -> Tuple[jnp.ndarray, jnp.ndarray]:
+        batch_axis: str = "data", vocab_axis: str = "fsdp",
+        use_fused_kernel="auto") -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(sum_nll, valid_token_count) form of the chunked loss — the
     accumulation-friendly contract the train step uses (trainer.py).
     mesh: see chunked_lm_cross_entropy."""
     return _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index,
-                            num_chunks, mesh, batch_axis, vocab_axis)
+                            num_chunks, mesh, batch_axis, vocab_axis,
+                            use_fused_kernel)
 
 
 def perplexity_from_loss(loss) -> float:

@@ -193,7 +193,8 @@ def hidden_states(config: Gemma3TextConfig, params, input_ids,
                   lora_dropout: float = 0.0, dropout_rng=None,
                   offload=None, block_stream=None,
                   collect_layers: bool = False, collect_kv: bool = False,
-                  cp_mesh=None, cp_axis: str = "fsdp"):
+                  cp_mesh=None, cp_axis: str = "fsdp",
+                  scan_unroll: int = 1):
     """offload: optional (plan, shardings) pair matching `params`; offloaded
     block weights stream host->HBM per layer inside the scan (forces remat
     of the block body) — see parallel/offload.py. block_stream: pre-resolved
@@ -244,7 +245,12 @@ def hidden_states(config: Gemma3TextConfig, params, input_ids,
         return x2, (kv if collect_kv else (x2 if collect_layers else None))
     if remat or stream is not None:
         body = jax.checkpoint(body)
-    x, extras = jax.lax.scan(body, x, jnp.arange(c.num_hidden_layers))
+    # scan_unroll > 1 issues several layers' host->HBM fetches per loop
+    # iteration on the streaming path — the host link is LATENCY-bound
+    # (~2 GiB/s single stream vs ~8 concurrent), so overlapping fetches
+    # raises effective bandwidth (bench offload-frontier rows)
+    x, extras = jax.lax.scan(body, x, jnp.arange(c.num_hidden_layers),
+                             unroll=scan_unroll)
     x = rms_norm(x, params["final_norm"].astype(compute_dtype),
                  c.rms_norm_eps)
     if collect_kv:

@@ -56,18 +56,22 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-_VMEM_BUDGET = 12 * 2 ** 20   # leave headroom under the 16 MB scoped limit
+_VMEM_BUDGET = 14 * 2 ** 20   # leave headroom under the 16 MB scoped limit
 
 
 def pick_block_v(V: int, R: int = 512, H: int = 1152,
                  itemsize: int = 2) -> Optional[int]:
     """Largest lane-aligned vocab tile dividing V that fits the VMEM
-    budget (None = ineligible). Resident per grid step: the [R, H] hidden
-    block and the double-buffered [BV, H] weight tile in the STORAGE
-    dtype (`itemsize` — 2 for bf16, 4 for f32), the [R, BV] f32 logits
-    tile, and the [R, H] f32 accumulator scratch of the dh kernel (the
-    largest of the three kernels)."""
-    fixed = R * H * itemsize + R * H * 4 + 6 * R
+    budget (None = ineligible). Resident per grid step of the dh kernel
+    (the largest of the three): the [R, H] hidden block in the STORAGE
+    dtype (`itemsize` — 2 for bf16, 4 for f32), the double-buffered
+    [BV, H] weight tile, the [R, BV] f32 logits tile (the coef temp
+    aliases it after consumption), and the dh kernel's [R, H] f32
+    accumulator scratch AND output block. Budget calibrated on v5e:
+    (R=1024, H=640, bv=1024) ~13.3 MB compiles and runs; bv=2048 at the
+    same shape (~14.9 MB counted, 16.8 MB actual) fails scoped
+    allocation."""
+    fixed = R * H * itemsize + 2 * R * H * 4 + 6 * R
     for bv in (2048, 1024, 512, 256, 128):
         if V % bv == 0 and \
                 fixed + 2 * bv * H * itemsize + R * bv * 4 <= _VMEM_BUDGET:
@@ -121,6 +125,11 @@ def _fwd(h2, w, labels2):
     R, H = h2.shape
     V = w.shape[0]
     bv = pick_block_v(V, R, H, h2.dtype.itemsize)
+    if bv is None:
+        raise ValueError(
+            f"fused CE kernel ineligible for R={R}, V={V}, H={H}, "
+            f"itemsize={h2.dtype.itemsize} (check fused_ce_eligible "
+            f"before calling)")
     n = V // bv
     kernel = functools.partial(_fwd_kernel, block_v=bv, n_tiles=n)
     lse, gold = pl.pallas_call(
@@ -209,6 +218,11 @@ def _bwd_dh(h2, w, labels2, lse2, dlse2, dgold2):
     R, H = h2.shape
     V = w.shape[0]
     bv = pick_block_v(V, R, H, h2.dtype.itemsize)
+    if bv is None:
+        raise ValueError(
+            f"fused CE kernel ineligible for R={R}, V={V}, H={H}, "
+            f"itemsize={h2.dtype.itemsize} (check fused_ce_eligible "
+            f"before calling)")
     n = V // bv
     kernel = functools.partial(_dh_kernel, block_v=bv, n_tiles=n)
     row = lambda vi: (0, 0)
@@ -237,6 +251,11 @@ def _bwd_dw(h2, w, labels2, lse2, dlse2, dgold2):
     R, H = h2.shape
     V = w.shape[0]
     bv = pick_block_v(V, R, H, h2.dtype.itemsize)
+    if bv is None:
+        raise ValueError(
+            f"fused CE kernel ineligible for R={R}, V={V}, H={H}, "
+            f"itemsize={h2.dtype.itemsize} (check fused_ce_eligible "
+            f"before calling)")
     n = V // bv
     kernel = functools.partial(_dw_kernel, block_v=bv)
     row = lambda vi: (0, 0)

@@ -85,9 +85,7 @@ def executed_flops(n_block_mm, n_head_mm, n_active, B, S, n_layer, n_head,
     n_active: EXTRA trainable matmul params beyond the base stacks (the
     LoRA A/B factors; pass 0 for full FT — the full_ft branch already
     counts dW over n_block_mm + n_head_mm). attn_factor: fraction of the dense S^2
-    attention actually executed — the flash kernel's causal block
-    skipping does ~half (ops/flash_attention.py); XLA's masked dense
-    attention executes it all (1.0)."""
+    attention actually executed (_attn_factor; 1.0 for the XLA path)."""
     T = B * S
     attn = int(4 * B * n_layer * n_head * S * S * head_dim * attn_factor)
     mm = n_block_mm + n_head_mm + n_active
@@ -99,6 +97,22 @@ def executed_flops(n_block_mm, n_head_mm, n_active, B, S, n_layer, n_head,
     bwd_dw = 2 * T * (n_active if not full_ft
                       else n_block_mm + n_head_mm + n_active)
     return fwd + recompute + bwd_dx + bwd_dw
+
+
+def _attn_factor(S, head_dim, impl="auto"):
+    """Fraction of the dense S^2 attention the step actually executes.
+    The flash kernel visits only causally-reachable 512-row blocks: with
+    nb = S/512 blocks it runs (nb+1)/(2*nb) of the dense work (1.0 at
+    S=512 — a single block skips nothing; 0.75 at S=1024; -> 0.5 as nb
+    grows). XLA's masked dense attention always executes everything."""
+    from mobilefinetuner_tpu.ops.attention import resolve_impl
+    use_flash = impl == "flash" or (impl == "auto"
+                                    and resolve_impl(S, head_dim)
+                                    == "flash")
+    if not use_flash:
+        return 1.0
+    nb = max(S // 512, 1)
+    return (nb + 1) / (2 * nb)
 
 
 def matmul_param_counts(params, head_key):
@@ -260,15 +274,11 @@ def bench_gpt2_lora(B, S, dtype, accum=1, offload=False, impl="auto",
                                    config.n_layer, config.n_head,
                                    config.head_dim, full_ft=False)
     n_block, n_head = matmul_param_counts(params, "wte")
-    from mobilefinetuner_tpu.ops.attention import resolve_impl
-    uses_flash = (impl == "flash"
-                  or (impl == "auto"
-                      and resolve_impl(S, config.head_dim) == "flash"))
     r["flops_exec"] = executed_flops(
         n_block, n_head, n_active, B * accum, S, config.n_layer,
         config.n_head, config.head_dim, full_ft=False,
         remat_blocks=remat or offload, remat_head=False,
-        attn_factor=0.5 if uses_flash else 1.0)
+        attn_factor=_attn_factor(S, config.head_dim, impl))
     r["tokens"] = B * accum * S
     return r
 
@@ -338,15 +348,13 @@ def bench_gemma_lora(B, S, dtype, accum=1, offload=False, steps=20,
         n_active, n_frozen, B * accum, S, config.num_hidden_layers,
         config.num_attention_heads, config.head_dim, full_ft=False)
     n_block, n_head = matmul_param_counts(params, "embed")
-    from mobilefinetuner_tpu.ops.attention import resolve_impl
     r["flops_exec"] = executed_flops(
         n_block, n_head, n_active, B * accum, S,
         config.num_hidden_layers, config.num_attention_heads,
         config.head_dim, full_ft=False,
         remat_blocks=remat or offload,   # streaming forces body remat
         remat_head=True,                 # chunked CE is checkpointed
-        attn_factor=(0.5 if resolve_impl(S, config.head_dim) == "flash"
-                     else 1.0))
+        attn_factor=_attn_factor(S, config.head_dim))
     r["tokens"] = B * accum * S
     return r
 
@@ -385,13 +393,11 @@ def bench_gemma_full_offload(B, S, dtype, steps=10, loss_chunks=8):
         n, 0, B, S, config.num_hidden_layers,
         config.num_attention_heads, config.head_dim, full_ft=True)
     n_block, n_head = matmul_param_counts(compute, "embed")
-    from mobilefinetuner_tpu.ops.attention import resolve_impl
     r["flops_exec"] = executed_flops(
         n_block, n_head, 0, B, S, config.num_hidden_layers,
         config.num_attention_heads, config.head_dim, full_ft=True,
         remat_blocks=True, remat_head=True,
-        attn_factor=(0.5 if resolve_impl(S, config.head_dim) == "flash"
-                     else 1.0))
+        attn_factor=_attn_factor(S, config.head_dim))
     r["tokens"] = B * S
     return r
 
@@ -561,6 +567,13 @@ def main():
             B=4, S=1024, impl="flash")
         run("gpt2s_lora_bf16_S1024_xla", bench_gpt2_lora, bf16, steps,
             B=4, S=1024, impl="xla")
+        # the r4 crossover retune: flash wins from S=512 at D=64 (e2e
+        # +20%; the dispatch-floor-limited microbench said parity —
+        # resolve_impl docstring has the measurement story)
+        run("gpt2s_lora_bf16_S512_flash", bench_gpt2_lora, bf16, steps,
+            B=16, S=512, impl="flash")
+        run("gpt2s_lora_bf16_S512_xla", bench_gpt2_lora, bf16, steps,
+            B=16, S=512, impl="xla")
         # end-to-end generate throughput (prefill + sequential decode;
         # tokens/sec counts generated tokens only).
         # finish() is training-shaped, so pass run() a custom finisher.

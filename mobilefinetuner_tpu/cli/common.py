@@ -84,10 +84,11 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
                    help="gradient checkpointing over the layer scan")
     g.add_argument("--attention_impl", choices=["auto", "xla", "flash"],
                    default="auto",
-                   help="'auto' picks per shape (flash for S >= 1024, "
-                        "measured on v5e, tools/bench_attention.py); "
-                        "'flash' = Pallas block-sparse kernel; 'xla' = "
-                        "plain fused attention")
+                   help="'auto' picks per shape (flash from S >= 512 at "
+                        "D <= 128, S >= 2048 at D = 256; measured e2e on "
+                        "v5e, ops/attention.resolve_impl); 'flash' = "
+                        "Pallas block-sparse kernel; 'xla' = plain fused "
+                        "attention")
     g.add_argument("--no_model_dropout", action="store_true",
                    help="zero the checkpoint's embd/resid/attn pdrop "
                         "(HF GPT-2 configs carry 0.1; dropout changes "

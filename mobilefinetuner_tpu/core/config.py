@@ -41,8 +41,9 @@ class GPT2Config:
     resid_pdrop: float = 0.0
     attn_pdrop: float = 0.0
     tie_word_embeddings: bool = True
-    # Attention impl: "auto" (flash for S >= 1024, measured on v5e — see
-    # ops/attention.py), "flash" (Pallas kernel), "xla" (jnp reference).
+    # Attention impl: "auto" (flash from S >= 512 at D <= 128, S >= 2048
+    # at D = 256; measured e2e on v5e — ops/attention.resolve_impl),
+    # "flash" (Pallas kernel), "xla" (jnp reference).
     attention_impl: str = "auto"
 
     @property

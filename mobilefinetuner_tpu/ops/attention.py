@@ -98,20 +98,23 @@ def dot_product_attention(
 
 
 def resolve_impl(S: int, D: int) -> str:
-    """The 'auto' dispatch rule, from TPU v5e measurements
-    (tools/bench_attention_v5e.json, re-measured round 3): the flash
-    kernel wins 1.7-2.8× (fwd and fwd+bwd) from S >= 1024 at small head
-    dim (GPT-2, D=64) and from S >= 2048 at large head dim (Gemma-270M/1B
-    GQA layout, D=256 — re-benched at S=1024: 0.92-0.98×, XLA keeps the
-    edge, so the threshold stays), thanks to causal/sliding-window block
-    skipping. With train-mode attention dropout the gap explodes (4.6× at
-    S=1024, 6.6× at S=2048): the XLA path materializes + RNGs a
-    [B, H, S, S] probs mask while the kernel hashes its keep bits
-    in-register (flash_attention.py _keep_mask). Shared by attention()
-    and the model blocks that branch on the impl themselves
-    (models/gemma3.py) — retune in ONE place.
+    """The 'auto' dispatch rule, from TPU v5e measurements: the flash
+    kernel wins from S >= 512 at small head dim (GPT-2, D=64) and from
+    S >= 2048 at large head dim (Gemma GQA layout, D=256), thanks to
+    causal/sliding-window block skipping. Round-4 retune, measured
+    END-TO-END on the train step (the serial-chain microbench hits a
+    ~0.7 ms dispatch floor on the tunneled platform and cannot resolve
+    ops this small): GPT-2s S=512 flash 119.8k vs xla 99.7k tok/s
+    (+20%), S=256 flash 121.3k vs xla 136.6k (-11%, XLA keeps it);
+    Gemma-270M S=512 flash 44.2k vs xla 47.1k (-6%, threshold stays
+    2048; S=1024 was 0.92-0.98x in round 3). With train-mode attention
+    dropout the gap explodes (4.6x at S=1024, 6.6x at S=2048): the XLA
+    path materializes + RNGs a [B, H, S, S] probs mask while the kernel
+    hashes its keep bits in-register (flash_attention.py _keep_mask).
+    Shared by attention() and the model blocks that branch on the impl
+    themselves (models/gemma3.py) — retune in ONE place.
     """
-    return "flash" if S >= (1024 if D <= 128 else 2048) else "xla"
+    return "flash" if S >= (512 if D <= 128 else 2048) else "xla"
 
 
 def attention(q, k, v, *, impl: str = "auto", **kwargs):

@@ -606,11 +606,13 @@ def flash_attention(q, k, v, *,
     not per-mask — exactly like the reference's RNG vs ours. Dropout=0 or
     rng=None compiles the dropout-free kernels (p_drop is static).
 
-    Default blocks are 512×512 (clamped to S): measured on TPU v5e
-    (tools/bench_attention.py), large blocks amortize the k-loop and win
-    1.6-2.9× over the XLA path for S >= 1024 on both GPT-2 (H=12, D=64)
-    and Gemma-270M (GQA 4/1, D=256) layouts, fwd AND fwd+bwd; at S <= 512
-    XLA's fused attention keeps a slight edge (see attention() 'auto').
+    Default blocks are 512×512 (clamped to S): measured on TPU v5e,
+    large blocks amortize the k-loop — every smaller block combination
+    swept at S <= 512 (r4: 256x512 down to 64x128) only added
+    per-program overhead. The kernel wins end-to-end from S >= 512 at
+    D=64 (+20% on the GPT-2s train step) and from S >= 2048 at D=256;
+    below that XLA's fused attention keeps the edge (thresholds in
+    attention() 'auto' / resolve_impl).
     """
     from mobilefinetuner_tpu.ops.attention import dot_product_attention
     B, Hq, S, D = q.shape

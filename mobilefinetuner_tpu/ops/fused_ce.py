@@ -68,9 +68,9 @@ def pick_block_v(V: int, R: int = 512, H: int = 1152,
     [BV, H] weight tile, the [R, BV] f32 logits tile (the coef temp
     aliases it after consumption), and the dh kernel's [R, H] f32
     accumulator scratch AND output block. Budget calibrated on v5e:
-    (R=1024, H=640, bv=1024) ~13.3 MB compiles and runs; bv=2048 at the
-    same shape (~14.9 MB counted, 16.8 MB actual) fails scoped
-    allocation."""
+    (R=1024, H=640, bv=1024) counts 13.4 MB here, compiles and runs;
+    bv=2048 at the same shape counts 20.2 MB (actual scoped allocation
+    failed at 16.8 MB) and is rejected."""
     fixed = R * H * itemsize + 2 * R * H * 4 + 6 * R
     for bv in (2048, 1024, 512, 256, 128):
         if V % bv == 0 and \
@@ -84,6 +84,16 @@ def fused_ce_eligible(R: int, V: int, H: int = 1152,
     """Rows must be sublane-aligned; V must tile lane-aligned within the
     VMEM budget for this (R, H, storage itemsize)."""
     return R % 8 == 0 and pick_block_v(V, R, H, itemsize) is not None
+
+
+def _pick_block_v_or_raise(V, R, H, itemsize) -> int:
+    bv = pick_block_v(V, R, H, itemsize)
+    if bv is None:
+        raise ValueError(
+            f"fused CE kernel ineligible for R={R}, V={V}, H={H}, "
+            f"itemsize={itemsize} (check fused_ce_eligible before "
+            f"calling)")
+    return bv
 
 
 # --------------------------------- forward ----------------------------------
@@ -124,12 +134,7 @@ def _fwd_kernel(h_ref, w_ref, lab_ref, lse_ref, gold_ref, m_sc, s_sc,
 def _fwd(h2, w, labels2):
     R, H = h2.shape
     V = w.shape[0]
-    bv = pick_block_v(V, R, H, h2.dtype.itemsize)
-    if bv is None:
-        raise ValueError(
-            f"fused CE kernel ineligible for R={R}, V={V}, H={H}, "
-            f"itemsize={h2.dtype.itemsize} (check fused_ce_eligible "
-            f"before calling)")
+    bv = _pick_block_v_or_raise(V, R, H, h2.dtype.itemsize)
     n = V // bv
     kernel = functools.partial(_fwd_kernel, block_v=bv, n_tiles=n)
     lse, gold = pl.pallas_call(
@@ -217,12 +222,7 @@ def _dw_kernel(h_ref, w_ref, lab_ref, lse_ref, dlse_ref, dgold_ref,
 def _bwd_dh(h2, w, labels2, lse2, dlse2, dgold2):
     R, H = h2.shape
     V = w.shape[0]
-    bv = pick_block_v(V, R, H, h2.dtype.itemsize)
-    if bv is None:
-        raise ValueError(
-            f"fused CE kernel ineligible for R={R}, V={V}, H={H}, "
-            f"itemsize={h2.dtype.itemsize} (check fused_ce_eligible "
-            f"before calling)")
+    bv = _pick_block_v_or_raise(V, R, H, h2.dtype.itemsize)
     n = V // bv
     kernel = functools.partial(_dh_kernel, block_v=bv, n_tiles=n)
     row = lambda vi: (0, 0)
@@ -250,12 +250,7 @@ def _bwd_dh(h2, w, labels2, lse2, dlse2, dgold2):
 def _bwd_dw(h2, w, labels2, lse2, dlse2, dgold2):
     R, H = h2.shape
     V = w.shape[0]
-    bv = pick_block_v(V, R, H, h2.dtype.itemsize)
-    if bv is None:
-        raise ValueError(
-            f"fused CE kernel ineligible for R={R}, V={V}, H={H}, "
-            f"itemsize={h2.dtype.itemsize} (check fused_ce_eligible "
-            f"before calling)")
+    bv = _pick_block_v_or_raise(V, R, H, h2.dtype.itemsize)
     n = V // bv
     kernel = functools.partial(_dw_kernel, block_v=bv)
     row = lambda vi: (0, 0)

@@ -51,7 +51,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="one prompt per line (adds to --prompt)")
     p.add_argument("--lora_path", default="",
                    help="adapter safetensors; merged into the base weights "
-                        "by default")
+                        "by default. Repeat-free multi-adapter form: a "
+                        "comma list serves SEVERAL adapters in one batch "
+                        "(implies --lora_dynamic; route prompts with "
+                        "--adapter_ids)")
+    p.add_argument("--adapter_ids", default="",
+                   help="comma list, one 0-based adapter index per "
+                        "prompt (default: prompt i -> adapter "
+                        "i %% n_adapters)")
     p.add_argument("--lora_dynamic", action="store_true",
                    help="apply the adapter dynamically at every site "
                         "instead of merging — no merged weight copy, so "
@@ -84,8 +91,40 @@ def main(argv=None) -> int:
     b = load_family(args.pretrained_dir, args.model)
     gen = gpt2_generate if b.family == "gpt2" else gemma3_generate
     tok, encode = b.tok, b.tok.encode  # Gemma: add_bos default (HF parity)
-    lora = apply_adapter(b, args.lora_path,
-                         lora_merge=not args.lora_dynamic)
+    lora_paths = [p for p in args.lora_path.split(",") if p]
+    if len(lora_paths) > 1:
+        # multi-adapter batch serving: stack the adapters and route each
+        # prompt to its adapter (lora/lora.py stack_adapters semantics)
+        from mobilefinetuner_tpu.lora import peft_io
+        from mobilefinetuner_tpu.lora.lora import (assign_adapters,
+                                                   stack_adapters)
+        adapters = [peft_io.load_adapter(p)[0] for p in lora_paths]
+        if args.adapter_ids:
+            try:
+                ids = [int(x) for x in args.adapter_ids.split(",") if x]
+            except ValueError:
+                raise SystemExit(
+                    f"--adapter_ids must be a comma list of integers, "
+                    f"got {args.adapter_ids!r}")
+            if len(ids) != len(prompts):
+                raise SystemExit(
+                    f"--adapter_ids has {len(ids)} entries for "
+                    f"{len(prompts)} prompts")
+            bad = [i for i in ids if not 0 <= i < len(adapters)]
+            if bad:
+                raise SystemExit(f"adapter ids out of range: {bad}")
+        else:
+            ids = [i % len(adapters) for i in range(len(prompts))]
+        lora = assign_adapters(stack_adapters(adapters), ids)
+        log.info(f"multi-adapter serving: {len(adapters)} adapters, "
+                 f"prompt routing {ids}")
+    else:
+        if args.adapter_ids:
+            raise SystemExit(
+                "--adapter_ids requires at least two --lora_path entries "
+                "(comma list) to route between")
+        lora = apply_adapter(b, args.lora_path,
+                             lora_merge=not args.lora_dynamic)
     config, params = b.config, b.params
 
     encoded = [encode(p) for p in prompts]

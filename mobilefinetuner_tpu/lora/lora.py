@@ -145,12 +145,52 @@ def init_lora_gemma3(config, spec: LoRASpec, key: jax.Array,
     return init_lora(dims, config.num_hidden_layers, spec, key, dtype)
 
 
+def stack_adapters(loras) -> dict:
+    """Stack N same-shaped adapter trees along a new leading ADAPTER axis
+    (multi-adapter batched serving, models/lora_apply.py). All adapters
+    must share rank and target set; scale stacks to [N] so per-adapter
+    alpha/r survives."""
+    if not loras:
+        raise ValueError("stack_adapters needs at least one adapter")
+    ref = jax.tree.structure(loras[0])
+    ref_shapes = [x.shape for x in jax.tree.leaves(loras[0])]
+    for i, t in enumerate(loras[1:], 1):
+        if jax.tree.structure(t) != ref:
+            raise ValueError(
+                f"adapter {i} has different targets/structure than "
+                f"adapter 0 (multi-adapter serving needs identical "
+                f"rank + target sets)")
+        shapes = [x.shape for x in jax.tree.leaves(t)]
+        if shapes != ref_shapes:
+            diff = next((a, b) for a, b in zip(ref_shapes, shapes)
+                        if a != b)
+            raise ValueError(
+                f"adapter {i} has different leaf shapes than adapter 0 "
+                f"(e.g. {diff[0]} vs {diff[1]} — rank mismatch?)")
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *loras)
+
+
+def assign_adapters(stacked: dict, adapter_ids) -> dict:
+    """Route batch rows to adapters: insert the per-row index array into
+    every site entry of a stack_adapters tree. SERVING/EVAL only: the
+    returned tree drops into the models' `lora=` argument for forwards
+    and generation, but it is not a trainable tree (the int32 "ids" leaf
+    cannot be differentiated, and routing indices are not parameters —
+    trainable_mask excludes them)."""
+    ids = jnp.asarray(adapter_ids, jnp.int32)
+    out = dict(stacked)
+    out["blocks"] = {name: dict(entry, ids=ids)
+                     for name, entry in stacked["blocks"].items()}
+    return out
+
+
 def trainable_mask(lora_tree) -> dict:
-    """Pytree of bools: True for trainable leaves (A/B), False for scale.
-    Feed to the optimizer so scale is never updated/decayed."""
+    """Pytree of bools: True for trainable leaves (A/B), False for scale
+    and for multi-adapter routing ids. Feed to the optimizer so those are
+    never updated/decayed."""
     return jax.tree.map_with_path(
         lambda path, _: not (path and getattr(path[-1], "key", None)
-                             == "scale"),
+                             in ("scale", "ids")),
         lora_tree)
 
 

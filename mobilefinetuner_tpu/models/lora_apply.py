@@ -5,6 +5,13 @@ applied to the LoRA branch's input only, never the base path
 (reference: nn/lora_linear.cpp:47-106 forward; dropout field in
 LoraSpec, lora_injector.h:29-71). "scale" is stop-gradiented — it is a
 hyperparameter leaf living in the pytree, not a trainable.
+
+Multi-adapter batched serving: an entry carrying an "ids" leaf ([B]
+int32, one adapter index per batch row) has its A/B/scale leaves stacked
+along a LEADING adapter axis (lora.stack_adapters + assign_adapters);
+each row's delta uses its own adapter's factors via a per-row gather —
+N adapters serve one batch without materializing merged weight copies,
+and the models stay unchanged (the entry itself carries the routing).
 """
 
 from __future__ import annotations
@@ -15,6 +22,24 @@ import jax
 import jax.numpy as jnp
 
 
+def _multi_lora(y, x, entry, layer_idx, dropout, rng):
+    """Per-row adapter routing: A [N,(L,)in,r], B [N,(L,)r,out],
+    scale [N], ids [B] -> row b's delta uses adapter ids[b]."""
+    from mobilefinetuner_tpu.ops.dropout import inverted_dropout
+    ids = entry["ids"]
+    A, B = entry["A"], entry["B"]
+    if layer_idx is not None and A.ndim == 4:
+        A, B = A[:, layer_idx], B[:, layer_idx]
+    A_rows = A[ids].astype(x.dtype)                  # [B, in, r]
+    B_rows = B[ids].astype(x.dtype)                  # [B, r, out]
+    xb = inverted_dropout(x, dropout, rng)
+    delta = jnp.einsum("b...i,bir->b...r", xb, A_rows)
+    delta = jnp.einsum("b...r,bro->b...o", delta, B_rows)
+    scale = jax.lax.stop_gradient(
+        jnp.asarray(entry["scale"]).astype(y.dtype))[ids]   # [B]
+    return y + scale.reshape((-1,) + (1,) * (y.ndim - 1)) * delta
+
+
 def maybe_lora(y, x, lora_entry, layer_idx=None, dropout: float = 0.0,
                rng: Optional[jax.Array] = None):
     """Add the LoRA delta to y if an entry exists.
@@ -22,10 +47,13 @@ def maybe_lora(y, x, lora_entry, layer_idx=None, dropout: float = 0.0,
     lora_entry: {"A": [in,r] or [L,in,r], "B": [r,out] or [L,r,out],
     "scale": scalar}; stacked leaves are indexed by layer_idx (a traced
     scalar under lax.scan). dropout>0 with rng!=None enables train-mode
-    inverted dropout on the branch input.
+    inverted dropout on the branch input. An entry with an "ids" leaf is
+    a MULTI-adapter stack routed per batch row (see module docstring).
     """
     if lora_entry is None:
         return y
+    if "ids" in lora_entry:
+        return _multi_lora(y, x, lora_entry, layer_idx, dropout, rng)
     A, B = lora_entry["A"], lora_entry["B"]
     if layer_idx is not None and A.ndim == 3:
         A, B = A[layer_idx], B[layer_idx]

@@ -1,0 +1,151 @@
+"""Multi-adapter batched serving (lora.stack_adapters + assign_adapters,
+models/lora_apply.py "ids" routing): each batch row must produce EXACTLY
+the output it would get from a single-adapter run with its own adapter —
+greedy generation is row-independent, so the oracle is row-wise equality."""
+
+import dataclasses
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from mobilefinetuner_tpu.core.config import GPT2Config, Gemma3TextConfig
+from mobilefinetuner_tpu.lora.lora import (LoRASpec, assign_adapters,
+                                           init_lora_gemma3, init_lora_gpt2,
+                                           stack_adapters)
+from mobilefinetuner_tpu.models import gemma3, gpt2
+
+
+def randomize(lora, seed):
+    """B leaves init to zero (delta == 0 would make the test vacuous)."""
+    leaves, treedef = jax.tree.flatten(lora)
+    keys = jax.random.split(jax.random.PRNGKey(seed), len(leaves))
+    return jax.tree.unflatten(treedef, [
+        l if l.ndim == 0 else 0.05 * jax.random.normal(k, l.shape)
+        for l, k in zip(leaves, keys)])
+
+
+GPT2_CFG = GPT2Config.tiny(vocab_size=211)
+GEMMA_CFG = Gemma3TextConfig.tiny(vocab_size=199)
+
+
+def make_adapters(init_fn, config, n=3, targets=None):
+    spec = LoRASpec(rank=4, alpha=8.0, targets=targets)
+    return [randomize(init_fn(config, spec, jax.random.PRNGKey(i)), 100 + i)
+            for i in range(n)]
+
+
+def test_stack_adapters_validates_structure():
+    a = make_adapters(init_lora_gpt2, GPT2_CFG, n=2)
+    stacked = stack_adapters(a)
+    entry = stacked["blocks"]["attn_qkv"]
+    assert entry["A"].shape[0] == 2 and entry["scale"].shape == (2,)
+    with pytest.raises(ValueError):
+        stack_adapters([])
+    other = init_lora_gpt2(GPT2_CFG, LoRASpec(rank=4, alpha=8.0,
+                                              targets=["attn_proj"]),
+                           jax.random.PRNGKey(9))
+    with pytest.raises(ValueError):
+        stack_adapters([a[0], other])
+
+
+@pytest.mark.parametrize("family", ["gpt2", "gemma"])
+def test_multi_adapter_forward_matches_per_row(family):
+    if family == "gpt2":
+        config, init_fn, model = GPT2_CFG, init_lora_gpt2, gpt2
+    else:
+        config, init_fn, model = GEMMA_CFG, init_lora_gemma3, gemma3
+    vocab = config.vocab_size if family == "gpt2" else config.vocab_size
+    params = model.init_params(config, jax.random.PRNGKey(0))
+    adapters = make_adapters(init_fn, config, n=3)
+    rng = np.random.default_rng(0)
+    B, S = 5, 16
+    ids_tok = jnp.asarray(rng.integers(0, vocab, (B, S)), jnp.int32)
+    amask = jnp.ones_like(ids_tok)
+    route = [0, 2, 1, 2, 0]
+    multi = assign_adapters(stack_adapters(adapters), route)
+    out_multi = model.forward(config, params, ids_tok,
+                              attention_mask=amask, lora=multi)
+    for b, a_idx in enumerate(route):
+        out_single = model.forward(config, params, ids_tok[b:b + 1],
+                                   attention_mask=amask[b:b + 1],
+                                   lora=adapters[a_idx])
+        np.testing.assert_allclose(np.asarray(out_multi[b]),
+                                   np.asarray(out_single[0]),
+                                   rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("family", ["gpt2", "gemma"])
+def test_multi_adapter_generate_matches_per_row(family):
+    from mobilefinetuner_tpu.models.generate import (SampleConfig,
+                                                     gemma3_generate,
+                                                     gpt2_generate)
+    if family == "gpt2":
+        config, init_fn, gen = GPT2_CFG, init_lora_gpt2, gpt2_generate
+        params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    else:
+        config, init_fn, gen = GEMMA_CFG, init_lora_gemma3, gemma3_generate
+        params = gemma3.init_params(config, jax.random.PRNGKey(0))
+    adapters = make_adapters(init_fn, config, n=2)
+    rng = np.random.default_rng(1)
+    B, P, N = 4, 8, 6
+    prompts = jnp.asarray(rng.integers(1, config.vocab_size, (B, P)),
+                          jnp.int32)
+    amask = jnp.ones_like(prompts)
+    cfg = SampleConfig(max_new_tokens=N, greedy=True, eos_id=None)
+    route = [1, 0, 0, 1]
+    multi = assign_adapters(stack_adapters(adapters), route)
+    out_multi = np.asarray(gen(config, params, prompts, amask, cfg,
+                               lora=multi))
+    for b, a_idx in enumerate(route):
+        out_single = np.asarray(gen(config, params, prompts[b:b + 1],
+                                    amask[b:b + 1], cfg,
+                                    lora=adapters[a_idx]))
+        np.testing.assert_array_equal(out_multi[b], out_single[0],
+                                      err_msg=f"row {b} adapter {a_idx}")
+
+
+def test_multi_adapter_cli(tmp_path):
+    """generate CLI end-to-end: two adapters served in one batch; routed
+    rows must equal the single-adapter runs."""
+    import json
+    from fixtures import write_tiny_gpt2_dir, write_wikitext_dir
+    from mobilefinetuner_tpu.cli.generate import main as gen_main
+    from mobilefinetuner_tpu.cli.gpt2_lora_finetune import main as train
+    import contextlib, io
+    gpt2_dir = str(tmp_path / "gpt2")
+    write_tiny_gpt2_dir(gpt2_dir)
+    wiki = write_wikitext_dir(str(tmp_path / "wiki"))
+    paths = []
+    for seed in (1, 2):
+        out = str(tmp_path / f"a{seed}.safetensors")
+        rc = train(["--pretrained_dir", gpt2_dir, "--data_dir", wiki,
+                    "--steps", "2", "--batch_size", "2", "--seq_len",
+                    "32", "--seed", str(seed), "--lora_out", out])
+        assert rc == 0
+        paths.append(out)
+
+    def run(argv):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert gen_main(argv) == 0
+        return [json.loads(ln) for ln in buf.getvalue().splitlines()
+                if ln.startswith("{")]
+
+    base = ["--pretrained_dir", gpt2_dir, "--greedy", "--no_eos_stop",
+            "--max_new_tokens", "6", "--json",
+            "--prompt", "hello there", "--prompt", "general kenobi"]
+    multi = run(base + ["--lora_path", ",".join(paths),
+                        "--adapter_ids", "1,0"])
+    single1 = run(base[:-2] + ["--lora_path", paths[1], "--lora_dynamic"])
+    single0 = run(["--pretrained_dir", gpt2_dir, "--greedy",
+                   "--no_eos_stop", "--max_new_tokens", "6", "--json",
+                   "--prompt", "general kenobi",
+                   "--lora_path", paths[0], "--lora_dynamic"])
+    assert multi[0]["ids"] == single1[0]["ids"]
+    assert multi[1]["ids"] == single0[0]["ids"]

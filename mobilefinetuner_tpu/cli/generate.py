@@ -64,6 +64,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "instead of merging — no merged weight copy, so "
                         "many adapters can be served off one base")
     p.add_argument("--max_new_tokens", type=int, default=64)
+    p.add_argument("--prefill_chunk", type=int, default=0,
+                   help="Gemma long-prompt mode: prefill in W-token "
+                        "windows against the growing KV cache (prefill "
+                        "score memory O(W*P) instead of O(P^2) blocks); "
+                        "0 = whole-prompt forward. GPT-2's 1024 learned "
+                        "positions cap prompts before memory does, so "
+                        "the flag is Gemma-only")
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top_k", type=int, default=0)
     p.add_argument("--top_p", type=float, default=1.0)
@@ -89,7 +96,16 @@ def main(argv=None) -> int:
     compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" \
         else jnp.float32
     b = load_family(args.pretrained_dir, args.model)
-    gen = gpt2_generate if b.family == "gpt2" else gemma3_generate
+    if args.prefill_chunk and b.family == "gpt2":
+        raise SystemExit("--prefill_chunk is Gemma-only (GPT-2's learned "
+                         "positions cap prompts at n_positions)")
+    if b.family == "gpt2":
+        gen = gpt2_generate
+    else:
+        import functools
+        gen = functools.partial(
+            gemma3_generate,
+            prefill_chunk=args.prefill_chunk or None)
     tok, encode = b.tok, b.tok.encode  # Gemma: add_bos default (HF parity)
     lora_paths = [p for p in args.lora_path.split(",") if p]
     if len(lora_paths) > 1:

@@ -257,34 +257,177 @@ def gpt2_generate(config: GPT2Config, params, input_ids, attention_mask,
 
 # ---------------------------------------------------------- Gemma-3 ---------
 
+def _gemma_chunked_prefill(c, params, wb, input_ids, attention_mask,
+                           lora_b, T, compute_dtype, W, apply_rope_fn):
+    """Windowed prefill for LONG prompts: process the prompt in W-token
+    windows, each window's attention reading the K/V cache of everything
+    before it plus itself — peak score memory is O(W·P) instead of the
+    whole-forward's O(P^2) blocks, and windows compile per static prefix
+    length (the window loop is a Python loop over static offsets).
+    Returns (last_hidden [B, E], kc, vc [L, B, Hkv, T, D]).
+
+    The math per window is the training block's (sandwich norms, GQA,
+    q/k RMSNorm, dual-theta RoPE, sliding window over POSITION ids)
+    vectorized the decode way: scores against the cache with explicit
+    validity masks, so left padding and window boundaries cannot shift
+    phases. Gemma-only: GPT-2's 1024 learned positions make long prompts
+    impossible before memory does.
+
+    This is deliberately the THIRD spelling of the Gemma block (after
+    gemma3._block and decode_step's layer) rather than a shared
+    windowed-layer function: the decode copy's buffer structure is
+    perf-fragile (an extra consumer of the cache broke its in-place DUS
+    aliasing once already — DESIGN.md §10), and each copy is pinned by
+    an exact-parity CI oracle (training ≡ HF, decode ≡ no-cache rollout,
+    chunked ≡ whole-prompt), so a site change that misses one copy fails
+    tests instead of shipping."""
+    B, P = input_ids.shape
+    nq, nkv, D = c.num_attention_heads, c.num_key_value_heads, c.head_dim
+    G = nq // nkv
+    eps = c.rms_norm_eps
+    scale = c.query_pre_attn_scalar ** -0.5
+    L = c.num_hidden_layers
+    is_global = jnp.asarray([c.is_global_layer(i) for i in range(L)])
+    normalizer = jnp.asarray(c.hidden_size ** 0.5, compute_dtype)
+    col_pos = _col_positions(attention_mask, P, T)              # [B, T]
+    prompt_ok = attention_mask.astype(bool)                     # [B, P]
+
+    kc = jnp.zeros((L, B, nkv, T, D), compute_dtype)
+    vc = jnp.zeros((L, B, nkv, T, D), compute_dtype)
+
+    def apply_lora(y, x_in, name, i):
+        entry = None if lora_b is None else lora_b.get(name)
+        return maybe_lora(y, x_in, entry, i)
+
+    x_last = None
+    for w0 in range(0, P, W):
+        ids_w = input_ids[:, w0:w0 + W]
+        pos_w = col_pos[:, w0:w0 + W]                           # [B, W]
+        x = params["embed"][ids_w].astype(compute_dtype) * normalizer
+        cos_g, sin_g = rope_cos_sin(pos_w, D, c.rope_theta)
+        cos_l, sin_l = rope_cos_sin(pos_w, D, c.rope_local_base_freq)
+        hi = w0 + W                          # static prefix length
+        # [B, W, hi]: prompt-mask valid AND causal vs the global column
+        cols = jnp.arange(hi)
+        causal = cols[None, None, :] <= (w0 + jnp.arange(W))[None, :, None]
+        valid = prompt_ok[:, None, :hi] & causal
+        win = (pos_w[:, :, None] - col_pos[:, None, :hi]) < c.sliding_window
+
+        def layer(inner, inp):
+            x, kc, vc = inner
+            bp, glob, i = inp
+            a = bp["attn"]
+            h = gemma3.rms_norm(x, bp["input_ln"], eps)
+            q = apply_lora(h @ a["q_w"], h, "q_proj", i) \
+                .reshape(B, W, nq, D)
+            k = apply_lora(h @ a["k_w"], h, "k_proj", i) \
+                .reshape(B, W, nkv, D)
+            v = apply_lora(h @ a["v_w"], h, "v_proj", i) \
+                .reshape(B, W, nkv, D)
+            q = gemma3.rms_norm(q, a["q_norm"], eps)
+            k = gemma3.rms_norm(k, a["k_norm"], eps)
+            cos = jnp.where(glob, cos_g, cos_l)
+            sin = jnp.where(glob, sin_g, sin_l)
+            # apply_rope expects [B, H, S, D]; v joins the cache layout
+            q = apply_rope_fn(q.transpose(0, 2, 1, 3), cos, sin)
+            k = apply_rope_fn(k.transpose(0, 2, 1, 3), cos, sin)
+            v = v.transpose(0, 2, 1, 3)              # [B, nkv, W, D]
+            kc = jax.lax.dynamic_update_slice(
+                kc, k[None].astype(kc.dtype), (i, 0, 0, w0, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, v[None].astype(vc.dtype), (i, 0, 0, w0, 0))
+            kc_l = jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False)
+            vc_l = jax.lax.dynamic_index_in_dim(vc, i, 0, keepdims=False)
+            k_pre = kc_l[:, :, :hi]          # static slice: grown prefix
+            v_pre = vc_l[:, :, :hi]
+            qg = q.reshape(B, nkv, G, W, D)
+            s = jnp.einsum("bkgwd,bktd->bkgwt", qg, k_pre,
+                           preferred_element_type=jnp.float32) * scale
+            ok = jnp.where(glob, valid, valid & win)            # [B,W,hi]
+            s = jnp.where(ok[:, None, None], s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bkgwt,bktd->bkgwd", p.astype(v_pre.dtype),
+                             v_pre, preferred_element_type=jnp.float32)
+            ctx = ctx.reshape(B, nq, W, D).transpose(0, 2, 1, 3) \
+                .reshape(B, W, nq * D).astype(compute_dtype)
+            attn_out = apply_lora(ctx @ a["o_w"], ctx, "o_proj", i)
+            attn_out = gemma3.rms_norm(attn_out, bp["post_attn_ln"], eps)
+            x = x + attn_out
+            h2 = gemma3.rms_norm(x, bp["pre_ffn_ln"], eps)
+            act = gemma3.gelu_tanh(
+                apply_lora(h2 @ bp["mlp"]["gate_w"], h2, "gate_proj", i)) \
+                * apply_lora(h2 @ bp["mlp"]["up_w"], h2, "up_proj", i)
+            down = apply_lora(act @ bp["mlp"]["down_w"], act,
+                              "down_proj", i)
+            down = gemma3.rms_norm(down, bp["post_ffn_ln"], eps)
+            return (x + down, kc, vc), None
+
+        (x, kc, vc), _ = jax.lax.scan(
+            layer, (x, kc, vc),
+            (wb, is_global, jnp.arange(L, dtype=jnp.int32)))
+        x_last = x
+    x_last = gemma3.rms_norm(
+        x_last, params["final_norm"].astype(compute_dtype), eps)
+    return x_last[:, -1], kc, vc
+
+
 def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
                     attention_mask, cfg: SampleConfig,
                     rng: Optional[jax.Array] = None,
-                    compute_dtype=jnp.float32, lora=None):
+                    compute_dtype=jnp.float32, lora=None,
+                    prefill_chunk: Optional[int] = None):
     """Gemma-3 generation: GQA cache [L, B, Hkv, T, D], per-layer
     global/local RoPE + sliding-window validity over POSITION ids.
     lora: optional adapter pytree applied dynamically (see
-    gpt2_generate)."""
+    gpt2_generate). prefill_chunk: process prompts longer than this in
+    W-sized windows against the growing cache (_gemma_chunked_prefill)
+    instead of one whole-prompt forward — bounds prefill score memory
+    for long prompts."""
     c = config
     B, P = input_ids.shape
     N = cfg.max_new_tokens
     if N <= 0:
         # honor max_new_tokens=0 (see gpt2_generate)
         return jnp.zeros((B, 0), jnp.int32)
-    T = P + N
     nq, nkv, D = c.num_attention_heads, c.num_key_value_heads, c.head_dim
     G = nq // nkv
     rng = jax.random.PRNGKey(0) if rng is None else rng
     params = jax.tree.map(jnp.asarray, params)
-
-    x, (pk, pv) = gemma3.hidden_states(
-        c, params, input_ids, attention_mask, lora=lora,
-        compute_dtype=compute_dtype, collect_kv=True)
-    logits0 = x[:, -1] @ params["embed"].astype(compute_dtype).T
     lora_b = None if lora is None else lora.get("blocks")
 
-    pad_kv = lambda t: jnp.pad(t, ((0, 0), (0, 0), (0, 0), (0, N), (0, 0)))
-    kc, vc = pad_kv(pk), pad_kv(pv)
+    if prefill_chunk is not None and prefill_chunk < 1:
+        raise ValueError(
+            f"prefill_chunk must be >= 1, got {prefill_chunk}")
+    chunked = prefill_chunk is not None and P > prefill_chunk
+    if chunked:
+        # pad the prompt on the LEFT to a window multiple (extra pads are
+        # masked out; positions are mask-derived, so phases don't move)
+        W = int(prefill_chunk)
+        pad_n = (-P) % W
+        if pad_n:
+            input_ids = jnp.pad(input_ids, ((0, 0), (pad_n, 0)),
+                                constant_values=cfg.pad_id)
+            attention_mask = jnp.pad(attention_mask, ((0, 0), (pad_n, 0)))
+            P += pad_n
+    T = P + N
+
+    cast = lambda t: (t.astype(compute_dtype)
+                      if jnp.issubdtype(t.dtype, jnp.floating) else t)
+    wb_pre = jax.tree.map(cast, params["blocks"])
+
+    if chunked:
+        x_last, kc, vc = _gemma_chunked_prefill(
+            c, params, wb_pre, input_ids, attention_mask, lora_b, T,
+            compute_dtype, W, apply_rope)
+        logits0 = x_last @ params["embed"].astype(compute_dtype).T
+    else:
+        x, (pk, pv) = gemma3.hidden_states(
+            c, params, input_ids, attention_mask, lora=lora,
+            compute_dtype=compute_dtype, collect_kv=True)
+        logits0 = x[:, -1] @ params["embed"].astype(compute_dtype).T
+        pad_kv = lambda t: jnp.pad(
+            t, ((0, 0), (0, 0), (0, 0), (0, N), (0, 0)))
+        kc, vc = pad_kv(pk), pad_kv(pv)
 
     n_real = attention_mask.sum(-1).astype(jnp.int32)
     col_pos = _col_positions(attention_mask, P, T)              # [B, T]
@@ -292,10 +435,7 @@ def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
                              for i in range(c.num_hidden_layers)])
     eps = c.rms_norm_eps
     scale = c.query_pre_attn_scalar ** -0.5
-    wb = params["blocks"]
-    cast = lambda t: (t.astype(compute_dtype)
-                      if jnp.issubdtype(t.dtype, jnp.floating) else t)
-    wb = jax.tree.map(cast, wb)
+    wb = wb_pre
     normalizer = jnp.asarray(c.hidden_size ** 0.5, compute_dtype)
 
     def decode_step(carry, step_rng_t):

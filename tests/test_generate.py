@@ -298,3 +298,76 @@ def test_dynamic_lora_split_qkv_generation(gpt2_params):
     dynamic = np.asarray(gpt2_generate(
         GPT2_CFG, gpt2_params, ids, mask, cfg, lora=lora))
     np.testing.assert_array_equal(dynamic, merged)
+
+
+def test_gemma3_chunked_prefill_matches_whole(gemma_params):
+    """Windowed prefill (prefill_chunk) must be token-identical to the
+    whole-prompt forward — including ragged left-padded prompts, a
+    window size that does NOT divide the prompt (internal re-pad), and
+    sliding-window layers whose span crosses window boundaries."""
+    rng = np.random.default_rng(7)
+    prompts = [list(rng.integers(3, 190, n)) for n in (19, 11, 23)]
+    ids, mask = left_pad(prompts, pad_id=0)         # P = 23
+    cfg = SampleConfig(max_new_tokens=7, greedy=True, eos_id=None)
+    want = np.asarray(gemma3_generate(
+        GEMMA_CFG, gemma_params, jnp.asarray(ids), jnp.asarray(mask), cfg))
+    for W in (8, 5, 16):                            # 23 % W != 0 for all
+        got = np.asarray(gemma3_generate(
+            GEMMA_CFG, gemma_params, jnp.asarray(ids), jnp.asarray(mask),
+            cfg, prefill_chunk=W))
+        np.testing.assert_array_equal(got, want, err_msg=f"W={W}")
+    # a chunk larger than P falls back to the whole-prompt path
+    got = np.asarray(gemma3_generate(
+        GEMMA_CFG, gemma_params, jnp.asarray(ids), jnp.asarray(mask), cfg,
+        prefill_chunk=64))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_gemma3_chunked_prefill_with_dynamic_lora(gemma_params):
+    """The windowed prefill applies dynamic LoRA at every site, same as
+    the whole-prompt path — including MULTI-adapter trees, whose per-row
+    routing must survive the [B, W, in] window activations."""
+    from mobilefinetuner_tpu.lora.lora import (LoRASpec, assign_adapters,
+                                               init_lora_gemma3,
+                                               stack_adapters)
+
+    def rand_lora(seed):
+        lora = init_lora_gemma3(GEMMA_CFG, LoRASpec(rank=3, alpha=6.0),
+                                jax.random.PRNGKey(seed))
+        leaves, treedef = jax.tree.flatten(lora)
+        keys = jax.random.split(jax.random.PRNGKey(seed + 50), len(leaves))
+        return jax.tree.unflatten(treedef, [
+            l if l.ndim == 0 else 0.05 * jax.random.normal(k, l.shape)
+            for l, k in zip(leaves, keys)])
+
+    lora = rand_lora(5)
+    rng = np.random.default_rng(8)
+    ids = jnp.asarray(rng.integers(3, 190, (2, 12)), jnp.int32)
+    mask = jnp.ones_like(ids)
+    cfg = SampleConfig(max_new_tokens=5, greedy=True, eos_id=None)
+    want = np.asarray(gemma3_generate(GEMMA_CFG, gemma_params, ids, mask,
+                                      cfg, lora=lora))
+    got = np.asarray(gemma3_generate(GEMMA_CFG, gemma_params, ids, mask,
+                                     cfg, lora=lora, prefill_chunk=4))
+    np.testing.assert_array_equal(got, want)
+    # multi-adapter x chunked prefill: routed rows == single-adapter runs
+    multi = assign_adapters(stack_adapters([lora, rand_lora(9)]), [1, 0])
+    got_m = np.asarray(gemma3_generate(GEMMA_CFG, gemma_params, ids, mask,
+                                       cfg, lora=multi, prefill_chunk=4))
+    want_a1 = np.asarray(gemma3_generate(
+        GEMMA_CFG, gemma_params, ids[:1], mask[:1], cfg,
+        lora=rand_lora(9), prefill_chunk=4))
+    np.testing.assert_array_equal(got_m[0], want_a1[0])
+    np.testing.assert_array_equal(got_m[1], want[1])
+
+
+def test_gemma3_prefill_chunk_validation(gemma_params):
+    ids = jnp.ones((1, 8), jnp.int32)
+    mask = jnp.ones_like(ids)
+    cfg = SampleConfig(max_new_tokens=2, greedy=True)
+    with pytest.raises(ValueError):
+        gemma3_generate(GEMMA_CFG, gemma_params, ids, mask, cfg,
+                        prefill_chunk=-8)
+    with pytest.raises(ValueError):
+        gemma3_generate(GEMMA_CFG, gemma_params, ids, mask, cfg,
+                        prefill_chunk=0)

@@ -37,6 +37,22 @@ def make_problem(seed=0):
     return params, batch
 
 
+def assert_tree_matches(got, want, exact=False):
+    """Leaf-by-leaf comparison keyed by want's tree paths (got may be a
+    plain nested dict from master_to_params)."""
+    for path, w in jax.tree_util.tree_flatten_with_path(want)[0]:
+        leaf = got
+        for k in path:
+            leaf = leaf[k.key]
+        if exact:
+            np.testing.assert_array_equal(np.asarray(leaf), np.asarray(w),
+                                          err_msg=str(path))
+        else:
+            np.testing.assert_allclose(np.asarray(leaf), np.asarray(w),
+                                       rtol=1e-5, atol=1e-6,
+                                       err_msg=str(path))
+
+
 def loss_fn(params_t, _unused, mb):
     hidden = gemma3.hidden_states(CFG, params_t, mb["input_ids"],
                                   attention_mask=mb["attention_mask"])
@@ -88,13 +104,7 @@ def test_streamed_update_matches_resident_trainer():
             float(m_ref["grad_norm"]), rel=1e-5), s
 
     got = master_to_params(opt, plan, params)
-    for path, ref_leaf in jax.tree_util.tree_flatten_with_path(
-            ref_params)[0]:
-        leaf = got
-        for k in path:
-            leaf = leaf[k.key]
-        np.testing.assert_allclose(np.asarray(leaf), np.asarray(ref_leaf),
-                                   rtol=1e-5, atol=1e-6, err_msg=str(path))
+    assert_tree_matches(got, ref_params)
     # the device compute copy tracks the master
     np.testing.assert_allclose(
         np.asarray(jax.device_get(compute["embed"])),
@@ -138,3 +148,44 @@ def test_bf16_compute_trains_and_loss_decreases():
     assert int(opt["step"]) == 5
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+def test_resume_equals_uninterrupted():
+    """Sidecar round trip: steps 0-1, save (master + {step, m, v}), reload
+    into a FRESH state, steps 2-3 — the final master must match an
+    uninterrupted 4-step run bit-for-bit (same batches, f32 compute so
+    no nondeterministic rounding enters)."""
+    from mobilefinetuner_tpu.optim.opt_offload import (resume_opt_sidecar,
+                                                       save_opt_sidecar)
+    import tempfile, os
+    params, batch = make_problem(seed=2)
+    tc = TrainConfig(total_steps=4, lr=1e-3, schedule="cosine",
+                     warmup_ratio=0.25)
+    spec = OptOffloadSpec(min_stream_bytes=1 << 10, chunk_bytes=1 << 12)
+    plan = plan_opt_offload(params, spec)
+    step = make_offload_train_step(loss_fn, tc, plan,
+                                   compute_dtype=jnp.float32, donate=False)
+
+    # uninterrupted
+    compute, opt = init_opt_offload(params, plan, compute_dtype=jnp.float32)
+    for s in range(4):
+        compute, opt, _ = step(compute, None, opt, batch, jnp.int32(s))
+    want = master_to_params(opt, plan, params)
+
+    # interrupted at step 2: persist sidecar + master, rebuild, resume
+    compute2, opt2 = init_opt_offload(params, plan,
+                                      compute_dtype=jnp.float32)
+    for s in range(2):
+        compute2, opt2, _ = step(compute2, None, opt2, batch, jnp.int32(s))
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "ck.opt")
+        save_opt_sidecar(path, opt2, tc.adam())
+        master_mid = master_to_params(opt2, plan, params)
+        compute3, opt3 = init_opt_offload(master_mid, plan,
+                                          compute_dtype=jnp.float32)
+        opt3 = resume_opt_sidecar(path, opt3)
+    assert int(opt3["step"]) == 2
+    for s in range(2, 4):
+        compute3, opt3, _ = step(compute3, None, opt3, batch, jnp.int32(s))
+    got = master_to_params(opt3, plan, params)
+    assert_tree_matches(got, want, exact=True)

@@ -162,9 +162,11 @@ def measure(step_fn, trainable, frozen, opt, batches, eval_batch,
     # comparable-loss probe: the step's loss metric is evaluated at the
     # CURRENT weights before its update, so feeding the shared eval batch
     # reads held-out loss after exactly `mark * tokens_per_step`
-    # (== LOSS_MARK_TOKENS for every current row) training tokens (the
-    # probe's own update lands on eval data once — harmless for a
-    # synthetic throughput suite). The float() syncs the host.
+    # (== LOSS_MARK_TOKENS for every current row) training tokens. The
+    # probe's outputs MUST become the live state (tr/op are donated, so
+    # the inputs are dead after the call); that lands one eval-batch
+    # update in the weights used for the timed window — accepted: the
+    # loss column is read pre-update and throughput is schedule-identical.
     tr, op, m = compiled(tr, frozen, op, eval_batch, jnp.int32(mark))
     loss = float(m["loss"])
     # rows whose mark is short still get WARMUP_STEPS executions before

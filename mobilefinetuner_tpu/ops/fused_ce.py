@@ -71,7 +71,7 @@ def pick_block_v(V: int, R: int = 512, H: int = 1152,
     (R=1024, H=640, bv=1024) counts 13.4 MB here, compiles and runs;
     bv=2048 at the same shape counts 20.2 MB (actual scoped allocation
     failed at 16.8 MB) and is rejected."""
-    fixed = R * H * itemsize + 2 * R * H * 4 + 6 * R
+    fixed = R * H * itemsize + 2 * R * H * 4 + 6 * R * 4
     for bv in (2048, 1024, 512, 256, 128):
         if V % bv == 0 and \
                 fixed + 2 * bv * H * itemsize + R * bv * 4 <= _VMEM_BUDGET:

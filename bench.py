@@ -404,7 +404,8 @@ def bench_gemma_full_offload(B, S, dtype, steps=10, loss_chunks=8):
     return r
 
 
-def bench_generate(B=8, P=128, N=64, dtype=jnp.bfloat16, pipeline=8):
+def bench_generate(B=8, P=128, N=64, dtype=jnp.bfloat16, pipeline=8,
+                   model="gpt2", adapters=0):
     """Generate throughput (models/generate.py): B prompts of length P, N
     greedy tokens each; tokens/sec counts only the B*N GENERATED tokens.
 
@@ -414,27 +415,63 @@ def bench_generate(B=8, P=128, N=64, dtype=jnp.bfloat16, pipeline=8):
     directly-attached chip would not pay), and the primary tokens/sec is
     SUSTAINED serving throughput: `pipeline` calls dispatched
     back-to-back with one sync at the end, so the dispatch latency
-    overlaps device work the way a serving loop overlaps requests."""
-    from mobilefinetuner_tpu.models.generate import SampleConfig, \
-        gpt2_generate
-    config = GPT2Config.gpt2_small()
-    params = gpt2.init_params(config, jax.random.PRNGKey(0))
+    overlaps device work the way a serving loop overlaps requests.
+
+    The B=8 marginal decode cost is byte-floor-bound (weights+cache reads
+    per token-step, DESIGN.md §10a), so batch is the serving-throughput
+    lever — hence the B=32 rows alongside the historical B=8 row.
+
+    adapters=k serves k distinct stacked LoRA adapters routed round-robin
+    over the batch rows through the dynamic per-layer LoRA path
+    (lora.stack_adapters + assign_adapters; correctness oracle:
+    tests/test_multi_adapter.py row-exact equality)."""
+    from mobilefinetuner_tpu.models.generate import (SampleConfig,
+                                                     gemma3_generate,
+                                                     gpt2_generate)
+    if model == "gemma":
+        config = Gemma3TextConfig.gemma3_270m()
+        params = gemma3.init_params(config, jax.random.PRNGKey(0))
+        gen = gemma3_generate
+    else:
+        config = GPT2Config.gpt2_small()
+        params = gpt2.init_params(config, jax.random.PRNGKey(0))
+        gen = gpt2_generate
+    lora = None
+    if adapters:
+        from mobilefinetuner_tpu.lora.lora import (LoRASpec,
+                                                   assign_adapters,
+                                                   init_lora_gemma3,
+                                                   init_lora_gpt2,
+                                                   stack_adapters)
+        init_fn = init_lora_gemma3 if model == "gemma" else init_lora_gpt2
+        spec = LoRASpec(rank=8, alpha=16.0)
+        adv = [init_fn(config, spec, jax.random.PRNGKey(i))
+               for i in range(adapters)]
+        # randomize B so the adapter deltas are real work, not zeros
+        adv = [jax.tree.map(
+            lambda l, k=i: l if l.ndim == 0 else
+            0.02 * jax.random.normal(jax.random.PRNGKey(k + 77), l.shape),
+            a) for i, a in enumerate(adv)]
+        lora = assign_adapters(stack_adapters(adv),
+                               [b % adapters for b in range(B)])
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, config.vocab_size, (B, P)), jnp.int32)
     mask = jnp.ones_like(ids)
     cfg = SampleConfig(max_new_tokens=N, greedy=True, eos_id=None)
-    # params as a jit ARGUMENT (a closure would bake 124M weights into the
-    # HLO as constants — oversized programs for the compile service)
-    fn = jax.jit(lambda p, i, m: gpt2_generate(config, p, i, m, cfg,
-                                               compute_dtype=dtype))
-    out = fn(params, ids, mask)
+    # params AND lora as jit ARGUMENTS (a closure would bake the weights
+    # and adapter stacks into the HLO as constants — oversized programs
+    # for the compile service, and a serving loop swaps adapters without
+    # recompiling)
+    fn = jax.jit(lambda p, lo, i, m: gen(config, p, i, m, cfg,
+                                         compute_dtype=dtype, lora=lo))
+    out = fn(params, lora, ids, mask)
     np.asarray(out)  # compile + run
     t0 = time.perf_counter()
-    out = fn(params, ids, mask)
+    out = fn(params, lora, ids, mask)
     np.asarray(out)  # host sync
     latency = time.perf_counter() - t0
     t0 = time.perf_counter()
-    outs = [fn(params, ids, mask) for _ in range(pipeline)]
+    outs = [fn(params, lora, ids, mask) for _ in range(pipeline)]
     np.asarray(outs[-1])
     dt = time.perf_counter() - t0
     return {"dt": dt, "tokens": pipeline * B * N, "loss": 0.0,
@@ -579,14 +616,37 @@ def main():
         # end-to-end generate throughput (prefill + sequential decode;
         # tokens/sec counts generated tokens only).
         # finish() is training-shaped, so pass run() a custom finisher.
+        gen_finish = lambda name, r, dtype, n: {
+            "config": name,
+            "tokens_per_sec_per_chip": round(r["tokens"] / r["dt"], 1),
+            "single_call_latency_ms": r["latency_ms"],
+            "vs_baseline": None, "mfu": None, "peak_hbm_mb": None,
+            "loss": None}
         run("gpt2s_generate_e2e_B8_P128_N64",
             lambda dtype, steps: bench_generate(dtype=dtype), bf16, 1,
-            finisher=lambda name, r, dtype, n: {
-                "config": name,
-                "tokens_per_sec_per_chip": round(r["tokens"] / r["dt"], 1),
-                "single_call_latency_ms": r["latency_ms"],
-                "vs_baseline": None, "mfu": None, "peak_hbm_mb": None,
-                "loss": None})
+            finisher=gen_finish)
+        # serving regime: the B=8 marginal decode cost is pinned at the
+        # weights+cache byte floor (DESIGN.md §10a), so batch is the
+        # throughput lever — B=32 amortizes the dominant weight stream
+        # over 4x the rows
+        run("gpt2s_generate_e2e_B32_P128_N64",
+            lambda dtype, steps: bench_generate(B=32, dtype=dtype), bf16,
+            1, finisher=gen_finish)
+        run("gemma270m_generate_e2e_B8_P128_N64",
+            lambda dtype, steps: bench_generate(model="gemma",
+                                                dtype=dtype), bf16, 1,
+            finisher=gen_finish)
+        run("gemma270m_generate_e2e_B32_P128_N64",
+            lambda dtype, steps: bench_generate(B=32, model="gemma",
+                                                dtype=dtype), bf16, 1,
+            finisher=gen_finish)
+        # multi-adapter batched serving (4 adapters round-robin over the
+        # rows, dynamic per-layer LoRA path): priced against the B=32
+        # merged-weights row above (r4 verdict #6)
+        run("gpt2s_generate_multi_adapter4_B32_P128_N64",
+            lambda dtype, steps: bench_generate(B=32, adapters=4,
+                                                dtype=dtype), bf16, 1,
+            finisher=gen_finish)
 
     with open("BENCH_SUITE.json", "w") as f:
         json.dump({"suite": suite,

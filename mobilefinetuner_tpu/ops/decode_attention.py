@@ -1,0 +1,152 @@
+"""Pallas TPU decode attention: fused M=1 score+softmax+context kernel.
+
+Single-token decode attention is bandwidth-bound: per layer it reads the
+whole [B, H, T, D] K/V cache to produce one context row per head. The
+XLA path (models/generate.py decode_step) lowers the two M=1 einsums +
+softmax to VPU kLoop fusions that read the cache at ~245 GB/s on v5e
+(~30% of the ~819 GB/s peak — a layout/emitter limit at M=1 shapes,
+DESIGN.md §10); two XLA-level attempts to reach the MXU broke the cache's
+dynamic-update-slice aliasing and regressed. This kernel attacks the same
+floor from below: one pallas_call per layer streams each (batch,
+kv-head-block)'s K and V cache slices through VMEM exactly once as whole
+contiguous DMAs, computes scores + masked softmax + context in VMEM, and
+writes the [G, D] context rows. The cache slices stay in their storage
+dtype end to end (f32 accumulation via preferred_element_type, like the
+XLA path), so the kernel moves the same bytes — just at DMA rate instead
+of kLoop rate.
+
+Shapes (GQA-general; GPT-2 is the G=1 case):
+  q        [B, KV, G, D]   current-token queries, grouped by kv head
+  k_cache  [B, KV, T, D]   T = P + N cache columns (whole-T VMEM blocks)
+  v_cache  [B, KV, T, D]
+  ok       [B, T]          attendable columns (validity AND sliding
+                           window — caller composes, so Gemma's per-layer
+                           global/local choice stays outside)
+  -> ctx   [B, KV, G, D]   float32
+
+Design notes:
+  - whole-T blocks, no inner k-loop: decode caches are small (T·D ≤ ~1M
+    elements at the supported sizes), so online softmax is unnecessary —
+    the full [G, T] score row lives in registers/VMEM;
+  - KVB kv-heads per program (largest divisor of KV fitting the VMEM
+    budget): fewer, larger grid steps amortize per-program overhead when
+    KV is large (GPT-2: 12 heads of [T, 64]) and keep DMAs big;
+  - masked-out columns get NEG_INF scores; exp(NEG_INF - m) underflows to
+    exactly 0, so no second mask pass is needed. A fully-masked row
+    cannot occur (the current token's own column is always attendable);
+  - no backward: generation is inference-only (the training path uses
+    ops/flash_attention.py, which IS differentiable).
+
+The XLA einsum path remains the oracle and the fallback for ineligible
+shapes (T not sublane-aligned, VMEM overflow) and non-TPU backends
+(interpret mode covers CPU tests).
+
+Reference provenance: the reference framework's only KV-cache decode sits
+in its excluded legacy tree (legacy/transformer/kv_cache.cpp, SURVEY.md
+§2.10); this kernel is the TPU-native mechanical upgrade of that
+capability (round-5 verdict item 1).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+_VMEM_BUDGET = 12 * 2 ** 20
+
+
+def _interpret() -> bool:
+    """Pallas interpret mode off-TPU (CPU test mesh, SURVEY.md §4.6)."""
+    return jax.default_backend() != "tpu"
+
+
+def xla_reference(q, k_cache, v_cache, ok, scale):
+    """The models/generate.py decode_step attention, verbatim semantics —
+    the oracle the kernel is tested against and the comparison the
+    microbench tool prices. ONE shared copy so the tests and the tool
+    cannot drift from each other (generate.py keeps its own inline copy
+    because its buffer structure is perf-fragile — DESIGN.md §10)."""
+    s = jnp.einsum("bkgd,bktd->bkgt", q, k_cache,
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(ok[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bkgt,bktd->bkgd", p.astype(v_cache.dtype), v_cache,
+                      preferred_element_type=jnp.float32)
+
+
+def pick_kvb(KV: int, T: int, D: int, itemsize: int):
+    """Largest divisor of KV whose double-buffered K+V whole-T blocks fit
+    the VMEM budget, or None (caller falls back to XLA). Resident per grid
+    step: 2 (K, V) x 2 (double buffer) x [KVB, T, D] storage-dtype blocks;
+    q/ctx/score temps are O(G·T) f32 — charged as one extra T·D·4 term."""
+    for kvb in range(KV, 0, -1):
+        if KV % kvb:
+            continue
+        if 4 * kvb * T * D * itemsize + T * D * 4 <= _VMEM_BUDGET:
+            return kvb
+    return None
+
+
+def decode_eligible(KV: int, T: int, D: int, itemsize: int) -> bool:
+    """T must be sublane-aligned (whole-T blocks are statically indexed,
+    but the [T, D] tile still wants 8-row alignment); VMEM must fit."""
+    return T % 8 == 0 and pick_kvb(KV, T, D, itemsize) is not None
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, ok_ref, o_ref, *, scale, kvb):
+    ok = ok_ref[0] > 0                                    # [1, T] (lanes)
+    for j in range(kvb):                                  # static unroll
+        k = k_ref[0, j]                                   # [T, D] storage
+        v = v_ref[0, j]
+        q = q_ref[0, j].astype(k.dtype)                   # [G, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [G, T]
+        s = jnp.where(ok, s, NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)                                # masked -> 0
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        o_ref[0, j] = jax.lax.dot_general(
+            (p / l).astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [G, D] f32
+
+
+def decode_attention(q, k_cache, v_cache, ok, scale):
+    """Fused decode attention over a whole KV cache (shapes above).
+    Caller must have checked decode_eligible for these shapes."""
+    B, KV, G, D = q.shape
+    T = k_cache.shape[2]
+    kvb = pick_kvb(KV, T, D, k_cache.dtype.itemsize)
+    if kvb is None or T % 8 != 0:
+        raise ValueError(
+            f"decode_attention ineligible for KV={KV}, T={T}, D={D}, "
+            f"itemsize={k_cache.dtype.itemsize} (check decode_eligible "
+            f"before calling)")
+    kernel = functools.partial(_decode_kernel, scale=scale, kvb=kvb)
+    ok2 = ok.astype(jnp.int32).reshape(B, 1, T)
+    return pl.pallas_call(
+        kernel,
+        grid=(B, KV // kvb),
+        in_specs=[
+            pl.BlockSpec((1, kvb, G, D), lambda b, k: (b, k, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, kvb, T, D), lambda b, k: (b, k, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, kvb, T, D), lambda b, k: (b, k, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, T), lambda b, k: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, kvb, G, D), lambda b, k: (b, k, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, D), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=_interpret(),
+    )(q, k_cache, v_cache, ok2)

@@ -1,0 +1,85 @@
+"""ops/decode_attention.py vs the XLA decode einsum path (the oracle).
+
+The kernel is benched OUT of models/generate.py on the current platform
+(a no-op pallas_call costs ~43 us there, so L per-layer calls exceed the
+whole XLA attention cost — DESIGN.md §10a), but it is kept as tested
+infrastructure to re-measure against future runtimes, like ops/fused_ce.
+These tests pin its numerics to the exact einsum semantics generate.py
+uses (storage-dtype operands, f32 accumulation, NEG_INF masking,
+softmax-then-cast context weights)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mobilefinetuner_tpu.ops.decode_attention import (decode_attention,
+                                                      decode_eligible,
+                                                      pick_kvb,
+                                                      xla_reference)
+
+
+def make(B, KV, G, T, D, dtype, seed=0):
+    kq, kk, kv, km = jax.random.split(jax.random.PRNGKey(seed), 4)
+    q = jax.random.normal(kq, (B, KV, G, D), dtype)
+    kc = jax.random.normal(kk, (B, KV, T, D), dtype)
+    vc = jax.random.normal(kv, (B, KV, T, D), dtype)
+    # left-padding-style mask plus scattered invalid columns, but the
+    # last column (the current token) always attendable — generate.py's
+    # invariant that makes fully-masked rows impossible
+    ok = jax.random.bernoulli(km, 0.7, (B, T)).at[:, -1].set(True)
+    return q, kc, vc, ok
+
+
+@pytest.mark.parametrize("shape,dtype", [
+    ((2, 12, 1, 64, 64), jnp.float32),    # GPT-2 head layout
+    ((2, 12, 1, 64, 64), jnp.bfloat16),
+    ((2, 1, 4, 48, 256), jnp.float32),    # Gemma GQA layout
+    ((3, 2, 2, 40, 32), jnp.bfloat16),    # multi-kv-head GQA
+])
+def test_matches_xla_reference(shape, dtype):
+    B, KV, G, T, D = shape
+    q, kc, vc, ok = make(B, KV, G, T, D, dtype)
+    scale = D ** -0.5
+    assert decode_eligible(KV, T, D, jnp.dtype(dtype).itemsize)
+    got = decode_attention(q, kc, vc, ok, scale)
+    want = xla_reference(q, kc, vc, ok, scale)
+    assert got.dtype == jnp.float32
+    tol = 2e-2 if dtype == jnp.bfloat16 else 1e-5
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=tol, rtol=tol)
+
+
+def test_left_padding_mask_respected():
+    """Masked-out columns contribute nothing: shuffling their K/V rows
+    must not change the output."""
+    B, KV, G, T, D = 2, 4, 1, 32, 64
+    q, kc, vc, ok = make(B, KV, G, T, D, jnp.float32)
+    ok = jnp.broadcast_to(jnp.arange(T)[None, :] >= 8, (B, T))
+    base = decode_attention(q, kc, vc, ok, D ** -0.5)
+    poisoned_k = kc.at[:, :, :8].set(1e6)
+    poisoned_v = vc.at[:, :, :8].set(-1e6)
+    got = decode_attention(q, poisoned_k, poisoned_v, ok, D ** -0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(base),
+                               atol=1e-6)
+
+
+def test_jittable_and_grad_free():
+    B, KV, G, T, D = 2, 2, 2, 16, 32
+    q, kc, vc, ok = make(B, KV, G, T, D, jnp.float32)
+    f = jax.jit(lambda *a: decode_attention(*a, D ** -0.5))
+    out = f(q, kc, vc, ok)
+    assert out.shape == (B, KV, G, D)
+
+
+def test_eligibility_gates():
+    # sublane-misaligned T
+    assert not decode_eligible(12, 190, 64, 2)
+    # VMEM overflow: KV=1 cannot be subdivided further
+    assert not decode_eligible(1, 32768, 256, 4)
+    assert pick_kvb(1, 32768, 256, 4) is None
+    # GPT-2 bench shape picks the whole-KV block (one fat DMA per batch)
+    assert pick_kvb(12, 192, 64, 2) == 12
+    # a long-cache shape falls back to fewer kv heads per program
+    kvb = pick_kvb(12, 8192, 64, 4)
+    assert kvb is not None and kvb < 12 and 12 % kvb == 0

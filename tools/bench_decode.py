@@ -1,0 +1,147 @@
+"""Decode-cost ground truth: e2e marginal ms/token + serving B-sweep.
+
+Microbenches of the isolated decode attention are polluted on this
+platform by per-op and per-call overheads (and a ~105 ms dispatch RTT),
+so this tool measures what DESIGN.md §10 calls the pipelined-call delta:
+jit the full generate program at two values of N, dispatch `pipeline`
+calls back-to-back with one sync, and divide the wall-clock difference by
+the extra decode steps. That isolates the device-side marginal cost of
+one token-step (all layers, cache reads, head matmul, sampling) with
+prefill and RTT subtracted structurally.
+
+Also prints the serving regime: sustained generated-tokens/sec at each
+batch size (weights are read once per token-STEP, so batch amortizes the
+dominant weight stream; the B=8 marginal cost is byte-floor-bound,
+DESIGN.md §10a).
+
+Usage:
+  python tools/bench_decode.py                 # GPT-2 small
+  python tools/bench_decode.py --gemma         # Gemma-3 270M
+  python tools/bench_decode.py --kernel        # + pallas kernel microbench
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def marginal_ms(fn_n, params, ids, mask, n_lo, n_hi, pipeline=8):
+    """Marginal device ms/token-step from pipelined deltas between two N."""
+    out = {}
+    for n in (n_lo, n_hi):
+        f = fn_n(n)
+        np.asarray(f(params, ids, mask))            # compile
+        t0 = time.perf_counter()
+        outs = [f(params, ids, mask) for _ in range(pipeline)]
+        np.asarray(outs[-1])
+        out[n] = (time.perf_counter() - t0) / pipeline
+    return (out[n_hi] - out[n_lo]) * 1000 / (n_hi - n_lo), out
+
+
+def bench_model(gemma: bool, B: int, P: int, dtype, pipeline: int):
+    from mobilefinetuner_tpu.models import gemma3, gpt2
+    from mobilefinetuner_tpu.models.generate import (SampleConfig,
+                                                     gemma3_generate,
+                                                     gpt2_generate)
+    if gemma:
+        from mobilefinetuner_tpu.core.config import Gemma3TextConfig
+        config = Gemma3TextConfig.gemma3_270m()
+        params = gemma3.init_params(config, jax.random.PRNGKey(0))
+        gen = gemma3_generate
+        vocab = config.vocab_size
+    else:
+        from mobilefinetuner_tpu.core.config import GPT2Config
+        config = GPT2Config.gpt2_small()
+        params = gpt2.init_params(config, jax.random.PRNGKey(0))
+        gen = gpt2_generate
+        vocab = config.vocab_size
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, vocab, (B, P)), jnp.int32)
+    mask = jnp.ones_like(ids)
+
+    def fn_n(n):
+        cfg = SampleConfig(max_new_tokens=n, greedy=True, eos_id=None)
+        return jax.jit(lambda p, i, m: gen(config, p, i, m, cfg,
+                                           compute_dtype=dtype))
+
+    ms, walls = marginal_ms(fn_n, params, ids, mask, 16, 64,
+                            pipeline=pipeline)
+    name = "gemma270m" if gemma else "gpt2s"
+    print(f"{name} B={B} P={P}: marginal {ms / 1:.3f} ms/token-step "
+          f"({B / ms * 1000:.0f} tok/s asymptotic)  "
+          f"[wall N=16 {walls[16]*1e3:.1f} ms, N=64 {walls[64]*1e3:.1f}]")
+    # sustained serving number at N=64 (same definition as bench.py)
+    sustained = B * 64 / walls[64]
+    print(f"  sustained e2e (pipeline={pipeline}, N=64): "
+          f"{sustained:,.0f} tok/s")
+    return ms, sustained
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gemma", action="store_true")
+    ap.add_argument("--P", type=int, default=128)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--pipeline", type=int, default=8)
+    ap.add_argument("--B", type=int, nargs="*", default=[8, 32])
+    ap.add_argument("--kernel", action="store_true",
+                    help="also run the pallas decode_attention microbench")
+    args = ap.parse_args()
+    dtype = jnp.dtype(args.dtype)
+    for b in args.B:
+        bench_model(args.gemma, b, args.P, dtype, args.pipeline)
+    if args.kernel:
+        kernel_microbench(args.gemma)
+
+
+def kernel_microbench(gemma: bool):
+    """ops/decode_attention.py vs the XLA einsum path, on-device loop
+    (documents the per-call launch floor that benches the kernel out —
+    DESIGN.md §10a)."""
+    from mobilefinetuner_tpu.ops.decode_attention import (decode_attention,
+                                                          decode_eligible,
+                                                          xla_reference)
+    B, T, L = 8, 192, 12
+    KV, G, D = (1, 4, 256) if gemma else (12, 1, 64)
+    dt = jnp.bfloat16
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (B, KV, G, D), dt)
+    kc = jax.random.normal(kk, (B, KV, T, D), dt)
+    vc = jax.random.normal(kv, (B, KV, T, D), dt)
+    ok = jnp.broadcast_to(jnp.arange(T)[None, :] < T - 16, (B, T))
+    scale = D ** -0.5
+
+    def run(name, fn):
+        def step(qq, _):
+            out = qq
+            for _ in range(L):
+                out = qq + fn(out, kc, vc, ok, scale).astype(qq.dtype) \
+                    * 1e-6
+            return out, None
+        j = jax.jit(lambda qq: jax.lax.scan(step, qq, None, length=200)[0])
+        np.asarray(j(q))
+        t0 = time.perf_counter()
+        np.asarray(j(q))
+        dtp = (time.perf_counter() - t0) / 200
+        bw = L * 2 * kc.size * kc.dtype.itemsize / dtp / 1e9
+        print(f"  {name:8s}: {dtp*1e6:7.1f} us/{L}-layer step  "
+              f"cache BW {bw:6.1f} GB/s")
+        return fn(q, kc, vc, ok, scale)
+
+    print(f"kernel microbench B={B} KV={KV} G={G} T={T} D={D} "
+          f"eligible={decode_eligible(KV, T, D, 2)}")
+    r1 = run("xla", xla_reference)
+    r2 = run("pallas", decode_attention)
+    print("  max|diff| =", float(jnp.max(jnp.abs(r1 - r2))))
+
+
+if __name__ == "__main__":
+    main()

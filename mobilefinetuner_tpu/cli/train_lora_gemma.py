@@ -153,9 +153,12 @@ def main(argv=None) -> int:
         return resolve_offload(frozen, offload_arg)
 
     # vocab-parallel CE on multi-device meshes: the fsdp-sharded 262k
-    # embed must not be all-gathered per step (ops/loss.py). Not in
-    # sequence-parallel mode — there the fsdp axis carries the sequence.
-    ce_mesh = mesh if (mesh.size > 1 and cp_mesh is None) else None
+    # embed must not be all-gathered per step (ops/loss.py). In
+    # sequence-parallel mode the fsdp axis carries the sequence, so the
+    # CE runs the seq-sharded composition (chunk-wise hidden gather +
+    # vocab-parallel softmax — ops/loss.py seq_shard).
+    ce_mesh = mesh if mesh.size > 1 else None
+    ce_sp = cp_mesh is not None
 
     def loss_fn(lora_t, frozen, mb):
         p, stream = resolve(frozen)
@@ -170,7 +173,7 @@ def main(argv=None) -> int:
         # lm_head tied to embeddings; chunked CE avoids [B,S,262k] logits
         return chunked_lm_cross_entropy_sum(
             hidden, p["embed"], mb["labels"], num_chunks=args.loss_chunks,
-            mesh=ce_mesh)
+            mesh=ce_mesh, sequence_parallel=ce_sp)
 
     def nll_fn(lora_t, frozen, mb):
         p, stream = resolve(frozen)
@@ -181,7 +184,7 @@ def main(argv=None) -> int:
             cp_mesh=cp_mesh)
         return chunked_lm_cross_entropy_sum(
             hidden, p["embed"], mb["labels"], num_chunks=args.loss_chunks,
-            mesh=ce_mesh)
+            mesh=ce_mesh, sequence_parallel=ce_sp)
 
     if args.align_dump_dir:
         from mobilefinetuner_tpu.align.dump import run_align_dump

@@ -61,9 +61,7 @@ NEG_INF = -1e30
 _VMEM_BUDGET = 12 * 2 ** 20
 
 
-def _interpret() -> bool:
-    """Pallas interpret mode off-TPU (CPU test mesh, SURVEY.md §4.6)."""
-    return jax.default_backend() != "tpu"
+from mobilefinetuner_tpu.ops.pallas_util import interpret_mode as _interpret
 
 
 def xla_reference(q, k_cache, v_cache, ok, scale):
@@ -122,6 +120,13 @@ def decode_attention(q, k_cache, v_cache, ok, scale):
     Caller must have checked decode_eligible for these shapes."""
     B, KV, G, D = q.shape
     T = k_cache.shape[2]
+    if q.dtype != k_cache.dtype:
+        # the kernel casts q to the cache dtype before the score dot
+        # (generate.py always has them equal); a silent downcast of f32
+        # queries against a bf16 cache would diverge from xla_reference
+        raise ValueError(
+            f"decode_attention requires q.dtype == cache dtype "
+            f"(got {q.dtype} vs {k_cache.dtype})")
     kvb = pick_kvb(KV, T, D, k_cache.dtype.itemsize)
     if kvb is None or T % 8 != 0:
         raise ValueError(

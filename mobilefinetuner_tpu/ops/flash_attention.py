@@ -51,12 +51,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from mobilefinetuner_tpu.ops.pallas_util import interpret_mode as _interpret
+
 NEG_INF = -1e30
-
-
-def _interpret() -> bool:
-    """Pallas interpret mode off-TPU (CPU test mesh, SURVEY.md §4.6)."""
-    return jax.default_backend() != "tpu"
 
 
 def _valid_blocks(S: int, block_q: int,

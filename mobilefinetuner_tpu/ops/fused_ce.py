@@ -52,9 +52,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
+from mobilefinetuner_tpu.ops.pallas_util import interpret_mode as _interpret
 
 _VMEM_BUDGET = 14 * 2 ** 20   # leave headroom under the 16 MB scoped limit
 

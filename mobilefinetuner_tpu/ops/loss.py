@@ -86,26 +86,33 @@ def lm_cross_entropy_with_count(
     return nll.sum() / jnp.maximum(count, 1), count
 
 
+def chunk_len(S: int, num_chunks: int) -> int:
+    """Per-chunk length _shift_and_chunk produces for a [B, S, H] input —
+    THE one copy of the pad arithmetic (the SP eligibility gate and the
+    dryrun's phase guard both depend on it staying in lockstep)."""
+    Sm1 = S - 1
+    return (Sm1 + ((-Sm1) % num_chunks)) // num_chunks
+
+
 def _shift_and_chunk(hidden, labels, ignore_index, num_chunks):
     """Shared shift/pad/chunk front end: [B,S,H] -> [num_chunks,B,chunk,H]
     (positions 0..S-2 predict labels 1..S-1; the pad tail is ignored)."""
     B, S, H = hidden.shape
     hidden_s = hidden[:, :-1, :]
     labels_s = labels[:, 1:]
-    Sm1 = S - 1
-    pad = (-Sm1) % num_chunks
+    pad = num_chunks * chunk_len(S, num_chunks) - (S - 1)
     if pad:
         hidden_s = jnp.pad(hidden_s, ((0, 0), (0, pad), (0, 0)))
         labels_s = jnp.pad(labels_s, ((0, 0), (0, pad)),
                            constant_values=ignore_index)
-    chunk = (Sm1 + pad) // num_chunks
+    chunk = chunk_len(S, num_chunks)
     hs = hidden_s.reshape(B, num_chunks, chunk, H).swapaxes(0, 1)
     ls = labels_s.reshape(B, num_chunks, chunk).swapaxes(0, 1)
     return hs, ls
 
 
 def _vp_chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks,
-                        mesh, batch_axis, vocab_axis):
+                        mesh, batch_axis, vocab_axis, seq_shard=False):
     """Vocab-parallel chunked CE under shard_map — the multi-device path.
 
     The fsdp-sharded [V, H] head table must NOT be all-gathered per step:
@@ -124,6 +131,16 @@ def _vp_chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks,
     stop_gradient — the lse value is invariant to the max shift, so the
     softmax gradient is exact). tests/test_multichip.py asserts the
     compiled HLO carries no full-table all-gather.
+
+    seq_shard=True is the SEQUENCE-PARALLEL composition (round-5 verdict
+    item 2): under ring attention the vocab axis ("fsdp") carries the
+    sequence, so the incoming chunk dim arrives sharded over that same
+    axis. Each scan step then all-gathers its [B/data, chunk/n, H] hidden
+    slice over the axis (tiny — hidden bytes, the Megatron gather-at-head
+    move) and proceeds exactly as above: the table stays [V/n, H]-sharded
+    and the per-device logits block stays [B/data, chunk, V/n]. The
+    gather's transpose is a reduce-scatter of dH back to each device's
+    own sequence slice, so the backward keeps the sequence sharded too.
     """
     from jax.sharding import PartitionSpec as P
     shard_map = jax.shard_map
@@ -139,6 +156,10 @@ def _vp_chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks,
         def body(carry, xs):
             total, count = carry
             h, lab = xs
+            if seq_shard:
+                # reassemble the full chunk from the sequence shards; lab
+                # enters unsharded on this axis (tiny int array)
+                h = jax.lax.all_gather(h, vocab_axis, axis=1, tiled=True)
             logits = jax.lax.dot_general(
                 h, w, (((2,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)  # [B_loc, chunk, V/n]
@@ -168,9 +189,10 @@ def _vp_chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks,
         return (jax.lax.psum(total, batch_axis),
                 jax.lax.psum(count, batch_axis))
 
+    chunk_spec = vocab_axis if seq_shard else None
     return shard_map(
         local, mesh=mesh,
-        in_specs=(P(None, batch_axis, None, None),
+        in_specs=(P(None, batch_axis, chunk_spec, None),
                   P(None, batch_axis, None), P(vocab_axis, None)),
         out_specs=(P(), P()), check_vma=False)(hs, ls, lm_head_w)
 
@@ -201,23 +223,29 @@ def _use_fused_ce(use_fused_kernel, R, V, H, itemsize=2) -> bool:
 
 @partial(jax.jit, static_argnames=("ignore_index", "num_chunks", "mesh",
                                    "batch_axis", "vocab_axis",
-                                   "use_fused_kernel"))
+                                   "use_fused_kernel", "sequence_parallel"))
 def _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks,
                      mesh=None, batch_axis="data", vocab_axis="fsdp",
-                     use_fused_kernel="auto"):
+                     use_fused_kernel="auto", sequence_parallel=False):
     if mesh is not None:
         V = lm_head_w.shape[0]
-        B = hidden.shape[0]
+        B, S = hidden.shape[0], hidden.shape[1]
         n_vocab = mesh.shape.get(vocab_axis, 1)
         n_batch = mesh.shape.get(batch_axis, 1)
-        if n_vocab > 1 and V % n_vocab == 0 and B % n_batch == 0:
+        # sequence-parallel composition: the chunk dim arrives sharded
+        # over the vocab axis, so each scan chunk must split evenly
+        # across it (see _vp_chunked_nll_sum seq_shard)
+        chunk = chunk_len(S, num_chunks)
+        sp_ok = (not sequence_parallel) or chunk % n_vocab == 0
+        if n_vocab > 1 and V % n_vocab == 0 and B % n_batch == 0 and sp_ok:
             if use_fused_kernel is True:
                 raise ValueError(
                     "use_fused_kernel=True is not available under the "
                     "vocab-parallel mesh path (shard_map CE)")
             return _vp_chunked_nll_sum(hidden, lm_head_w, labels,
                                        ignore_index, num_chunks, mesh,
-                                       batch_axis, vocab_axis)
+                                       batch_axis, vocab_axis,
+                                       seq_shard=sequence_parallel)
         if n_vocab > 1:
             # the caller asked for vocab-parallel but the shapes can't
             # shard — warn (once per trace: shapes are static) instead of
@@ -226,8 +254,10 @@ def _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks,
             import warnings
             warnings.warn(
                 f"vocab-parallel CE requested but V={V} % {vocab_axis}="
-                f"{n_vocab} != 0 or B={B} % {batch_axis}={n_batch} != 0; "
-                f"falling back to the single-program chunked CE (GSPMD "
+                f"{n_vocab} != 0 or B={B} % {batch_axis}={n_batch} != 0"
+                + ("" if sp_ok else
+                   f" or sequence-parallel chunk={chunk} % {n_vocab} != 0")
+                + "; falling back to the single-program chunked CE (GSPMD "
                 f"may all-gather the full [V, H] head table per step)",
                 stacklevel=2)
     # Head matmul in the COMPUTE dtype with f32 accumulation: casting both
@@ -276,7 +306,8 @@ def chunked_lm_cross_entropy(hidden: jnp.ndarray, lm_head_w: jnp.ndarray,
                              num_chunks: int = 8, mesh=None,
                              batch_axis: str = "data",
                              vocab_axis: str = "fsdp",
-                             use_fused_kernel="auto") -> jnp.ndarray:
+                             use_fused_kernel="auto",
+                             sequence_parallel: bool = False) -> jnp.ndarray:
     """Mean causal-LM loss computed without materializing [B,S,V] logits.
 
     hidden: [B, S, H] final hidden states; lm_head_w: [V, H] (HF layout);
@@ -286,13 +317,16 @@ def chunked_lm_cross_entropy(hidden: jnp.ndarray, lm_head_w: jnp.ndarray,
 
     mesh: pass the ("data", "fsdp") device mesh when lm_head_w is
     FSDP-sharded to run the CE vocab-parallel (table stays sharded; see
-    _chunked_nll_sum). Do NOT pass it in sequence-parallel mode, where the
-    fsdp axis carries the sequence, not the vocab.
+    _chunked_nll_sum). In sequence-parallel mode (ring attention, the
+    fsdp axis carrying S) ALSO pass sequence_parallel=True: the CE then
+    gathers each hidden chunk over that axis before the vocab-parallel
+    softmax, so the long-context configuration keeps the no-table-gather
+    guarantee (round-5 verdict item 2).
     """
     total, count = _chunked_nll_sum(hidden, lm_head_w, labels,
                                     ignore_index, num_chunks, mesh,
                                     batch_axis, vocab_axis,
-                                    use_fused_kernel)
+                                    use_fused_kernel, sequence_parallel)
     return total / jnp.maximum(count, 1).astype(jnp.float32)
 
 
@@ -300,13 +334,14 @@ def chunked_lm_cross_entropy_sum(
         hidden: jnp.ndarray, lm_head_w: jnp.ndarray, labels: jnp.ndarray,
         ignore_index: int = IGNORE_INDEX, num_chunks: int = 8, mesh=None,
         batch_axis: str = "data", vocab_axis: str = "fsdp",
-        use_fused_kernel="auto") -> Tuple[jnp.ndarray, jnp.ndarray]:
+        use_fused_kernel="auto",
+        sequence_parallel: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(sum_nll, valid_token_count) form of the chunked loss — the
     accumulation-friendly contract the train step uses (trainer.py).
-    mesh: see chunked_lm_cross_entropy."""
+    mesh/sequence_parallel: see chunked_lm_cross_entropy."""
     return _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index,
                             num_chunks, mesh, batch_axis, vocab_axis,
-                            use_fused_kernel)
+                            use_fused_kernel, sequence_parallel)
 
 
 def perplexity_from_loss(loss) -> float:

@@ -1,0 +1,12 @@
+"""Shared plumbing for the Pallas kernels (flash attention, fused CE,
+decode attention)."""
+
+from __future__ import annotations
+
+import jax
+
+
+def interpret_mode() -> bool:
+    """Pallas interpret mode off-TPU (CPU test mesh, SURVEY.md §4.6) —
+    the ONE copy of the policy every kernel consults."""
+    return jax.default_backend() != "tpu"

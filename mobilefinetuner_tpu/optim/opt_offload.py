@@ -58,9 +58,29 @@ class OptOffloadSpec:
     """What streams: leaves >= min_stream_bytes with a chunkable leading
     structure. chunk_bytes targets the per-iteration slice size for
     row-chunked 2-D leaves (bigger slices amortize DMA latency; the host
-    link is latency-bound ~2 GiB/s single-stream)."""
+    link is latency-bound ~2 GiB/s single-stream).
+
+    The 16-BIT HOST TIER (round-5 verdict item 3; the analog of the
+    reference's fp16 slow-tier quantization, parameter_sharder.cpp:215-232,
+    applied to the tree the reference never sharded):
+      state_dtype: storage dtype for streamed Adam m AND v ("float32"
+        default, "bfloat16"/"float16" halve their stream). 16-bit v is
+        stored as sqrt(v): the square root halves the exponent range
+        (f16-safe down to grad ~2e-4 instead of underflowing at grad^2)
+        and puts the 16-bit relative error directly on the sqrt(v)
+        denominator the update actually uses.
+      master_dtype: storage dtype for streamed f32 master weights
+        ("float32" default; "bfloat16" halves the master stream and
+        quantizes the update write-back with STOCHASTIC ROUNDING so the
+        tiny lr*update increments survive in expectation instead of
+        vanishing below the bf16 ulp).
+    Resident (small) leaves always stay f32. Both knobs change stored
+    bits, so a sidecar written with one spec must be resumed with the
+    same spec (shape/dtype mismatch fails loudly in load_state)."""
     min_stream_bytes: int = 1 << 22          # 4 MB
     chunk_bytes: int = 96 << 20              # ~96 MB target slice
+    state_dtype: str = "float32"
+    master_dtype: str = "float32"
 
 
 def plan_opt_offload(params, spec: OptOffloadSpec = OptOffloadSpec()):
@@ -111,14 +131,18 @@ def _shardings(device=None):
             SingleDeviceSharding(device, memory_kind=host_kind))
 
 
-def init_opt_offload(params, plan, compute_dtype=jnp.bfloat16, device=None):
+def init_opt_offload(params, plan, compute_dtype=jnp.bfloat16, device=None,
+                     spec: OptOffloadSpec = OptOffloadSpec()):
     """Place a full-FT problem: returns (compute_params, opt_state).
 
     compute_params: compute-dtype copy on device, ORIGINAL shapes — this
     is the tree the loss differentiates. opt_state: {"step", "master",
-    "m", "v"} with streamed leaves as [C, ...] f32 pinned-host arrays and
-    resident leaves as device f32."""
+    "m", "v"} with streamed leaves as [C, ...] pinned-host arrays in the
+    spec's storage dtypes (v sqrt-encoded when 16-bit — see
+    OptOffloadSpec) and resident leaves as device f32."""
     dev_sh, host_sh = _shardings(device)
+    m_dt = jnp.dtype(spec.master_dtype)
+    s_dt = jnp.dtype(spec.state_dtype)
 
     def place_master(x, c):
         # host-numpy staging: jnp.asarray would allocate on DEVICE first
@@ -128,11 +152,17 @@ def init_opt_offload(params, plan, compute_dtype=jnp.bfloat16, device=None):
         x = np.asarray(x, np.float32)
         if c == 0:
             return jax.device_put(jnp.asarray(x), dev_sh)
-        return jax.device_put(x.reshape(_streamed_shape(x, c)), host_sh)
+        arr = x.reshape(_streamed_shape(x, c))
+        if m_dt != jnp.float32:
+            # plain round-to-nearest at INIT (the checkpoint's own
+            # precision); stochastic rounding guards the per-step
+            # update write-back, not the initial cast
+            arr = arr.astype(m_dt)
+        return jax.device_put(arr, host_sh)
 
     def place_zeros(x, c):
         z = np.zeros(_streamed_shape(x, c) if c else np.shape(x),
-                     np.float32)
+                     np.float32 if not c else s_dt)
         return jax.device_put(z, host_sh if c else dev_sh)
 
     compute = jax.tree.map(
@@ -178,16 +208,44 @@ def resume_opt_sidecar(path: str, opt_state):
     return dict(opt_state, **placed)
 
 
+def _sr_bfloat16(x, salt):
+    """Stochastic-rounding f32 -> bf16: add a counter-based uniform u16
+    to the low mantissa bits, then truncate. Each element's random draw
+    is a pure function of (its index, salt) — salt folds in (step, leaf,
+    chunk), so the quantization is deterministic given the step counter
+    and interrupted == uninterrupted training stays bit-for-bit (the
+    resume contract, tests/test_opt_offload.py). Same lowbias32-style
+    integer mix as the flash kernel's dropout (ops/flash_attention.py
+    _keep_mask), for the same reason: no [shape]-sized key tensors, and
+    hardware/interpret agree exactly."""
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    idx = jnp.arange(x.size, dtype=jnp.uint32).reshape(x.shape)
+    z = idx * jnp.uint32(0x9E3779B9) ^ salt.astype(jnp.uint32)
+    z = z ^ (z >> 16)
+    z = z * jnp.uint32(0x7FEB352D)
+    z = z ^ (z >> 15)
+    z = z * jnp.uint32(0x846CA68B)
+    z = z ^ (z >> 16)
+    q = bits + (z & jnp.uint32(0xFFFF))
+    out = jax.lax.bitcast_convert_type(
+        (q >> 16).astype(jnp.uint16), jnp.bfloat16)
+    # non-finite inputs would carry into the exponent; master weights are
+    # finite, but keep the guard exact rather than assumed
+    return jnp.where(jnp.isfinite(x), out, x.astype(jnp.bfloat16))
+
+
 def make_offload_train_step(loss_fn, train_cfg, plan,
                             compute_dtype=jnp.bfloat16, device=None,
-                            donate: bool = True, mask=None):
+                            donate: bool = True, mask=None,
+                            spec: OptOffloadSpec = OptOffloadSpec()):
     """Offloaded analog of trainer.make_train_step — same contract:
     step_fn(compute_params, frozen, opt_state, batch, step) ->
     (compute_params, opt_state, metrics). loss_fn(compute_params, frozen,
     micro_batch) -> (sum_loss, weight). Full-FT only: a trainable-leaf
     mask is rejected loudly (the streamed update has no frozen-leaf
     branch — silently updating masked leaves would diverge from the
-    resident trainer)."""
+    resident trainer). spec's state_dtype/master_dtype select the 16-bit
+    host tier (OptOffloadSpec) and must match init_opt_offload's."""
     from mobilefinetuner_tpu.train.trainer import reshape_for_accum
     if mask is not None:
         raise NotImplementedError(
@@ -202,6 +260,12 @@ def make_offload_train_step(loss_fn, train_cfg, plan,
             "amsgrad is not supported with optimizer-state offload")
     dev_sh, host_sh = _shardings(device)
     b1, b2 = cfg.beta1, cfg.beta2
+    m_dt = jnp.dtype(spec.master_dtype)
+    s_dt = jnp.dtype(spec.state_dtype)
+    if m_dt not in (jnp.float32, jnp.bfloat16):
+        raise ValueError(
+            f"master_dtype must be float32 or bfloat16 (stochastic "
+            f"rounding targets bf16), got {spec.master_dtype}")
 
     def adam_math(w, g, m, v, lr, bc1, bc2):
         g = g.astype(jnp.float32)
@@ -214,22 +278,36 @@ def make_offload_train_step(loss_fn, train_cfg, plan,
             upd = upd + cfg.weight_decay * w
         return w - lr * upd, m2, v2
 
-    def stream_leaf(g, w_h, m_h, v_h, lr, bc1, bc2):
-        """Per-leaf scanned update with the host state as the carry."""
+    def stream_leaf(g, w_h, m_h, v_h, lr, bc1, bc2, salt0):
+        """Per-leaf scanned update with the host state as the carry.
+        Chunks move host->HBM in their STORAGE dtypes (the whole point of
+        the 16-bit tier: fewer bytes on the latency-bound host link) and
+        dequantize on-chip; the refreshed state quantizes on-chip before
+        the write-back."""
         C = w_h.shape[0]
         g_st = g.reshape(w_h.shape)
+        sqrt_v = s_dt != jnp.float32      # v stored as sqrt(v) in 16-bit
 
         def body(carry, i):
             w_c, m_c, v_c = carry
             sl = lambda t: jax.lax.dynamic_index_in_dim(t, i, 0,
                                                         keepdims=False)
-            w = jax.device_put(sl(w_c), dev_sh)
-            m = jax.device_put(sl(m_c), dev_sh)
-            v = jax.device_put(sl(v_c), dev_sh)
+            w = jax.device_put(sl(w_c), dev_sh).astype(jnp.float32)
+            m = jax.device_put(sl(m_c), dev_sh).astype(jnp.float32)
+            v = jax.device_put(sl(v_c), dev_sh).astype(jnp.float32)
+            if sqrt_v:
+                v = v * v
             w2, m2, v2 = adam_math(w, sl(g_st), m, v, lr, bc1, bc2)
+            if m_dt == jnp.bfloat16:
+                w2 = _sr_bfloat16(w2, salt0 + i)
+            v_store = jnp.sqrt(v2) if sqrt_v else v2
             up = lambda t, x: jax.lax.dynamic_update_index_in_dim(
-                t, jax.device_put(x, host_sh), i, 0)
-            return ((up(w_c, w2), up(m_c, m2), up(v_c, v2)),
+                t, jax.device_put(x.astype(t.dtype), host_sh), i, 0)
+            # the emitted compute copy derives from the QUANTIZED master
+            # (w2 above is already bf16 when master_dtype is), so a
+            # resumed run — whose compute copy is re-derived from the
+            # stored master — is bit-identical to the uninterrupted one
+            return ((up(w_c, w2), up(m_c, m2), up(v_c, v_store)),
                     w2.astype(compute_dtype))
 
         (w_h, m_h, v_h), bf = jax.lax.scan(body, (w_h, m_h, v_h),
@@ -274,10 +352,16 @@ def make_offload_train_step(loss_fn, train_cfg, plan,
         leaves_v = treedef.flatten_up_to(opt_state["v"])
         leaves_c = treedef.flatten_up_to(plan)
         out_w, out_m, out_v, out_bf = [], [], [], []
-        for g, w, m, v, c in zip(leaves_g, leaves_w, leaves_m, leaves_v,
-                                 leaves_c):
+        for li, (g, w, m, v, c) in enumerate(zip(leaves_g, leaves_w,
+                                                 leaves_m, leaves_v,
+                                                 leaves_c)):
             if c:
-                w2, m2, v2, bf = stream_leaf(g, w, m, v, lr, bc1, bc2)
+                # SR salt: unique per (step, leaf, chunk) — chunk is
+                # added inside stream_leaf; 1009 (prime) * max chunks
+                # keeps leaf ranges disjoint for any realistic C
+                salt0 = step_no * jnp.int32(2 ** 20) + jnp.int32(li * 1009)
+                w2, m2, v2, bf = stream_leaf(g, w, m, v, lr, bc1, bc2,
+                                             salt0)
             else:
                 w2, m2, v2 = adam_math(w, g, m, v, lr, bc1, bc2)
                 bf = w2.astype(compute_dtype)

@@ -235,6 +235,99 @@ def test_gemma_lora_mesh_train_step_vocab_parallel(mesh):
     assert losses[-1] < losses[0], losses
 
 
+def test_gemma_sp_vocab_parallel_ce_compose(mesh):
+    """Sequence parallelism + vocab-parallel CE COMPOSE (round-5 verdict
+    item 2): ring attention shards S over "fsdp" while the chunked CE
+    gathers each hidden chunk over that same axis and keeps the V-sharded
+    tied table un-gathered. Asserts (a) NO full-table all-gather in the
+    compiled HLO, (b) the SP step's loss equals the batch-parallel mesh
+    step AND the unsharded oracle, (c) it trains."""
+    import functools
+    from mobilefinetuner_tpu.lora.lora import init_lora_gemma3
+    from mobilefinetuner_tpu.models import gemma3
+    from mobilefinetuner_tpu.ops.loss import chunked_lm_cross_entropy_sum
+    cfg = _gemma_cfg()
+    fsdp = mesh.shape["fsdp"]
+    params_h = gemma3.init_params(cfg, jax.random.PRNGKey(0))
+    lora_h = init_lora_gemma3(cfg, LoRASpec(rank=4, alpha=8.0, init="peft"),
+                              jax.random.PRNGKey(1))
+    mask = trainable_mask(lora_h)
+    rng = np.random.default_rng(11)
+    S = 32
+    assert S % fsdp == 0  # ring attention shards S over fsdp
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, S)), jnp.int32)
+    batch_h = {"input_ids": ids, "attention_mask": jnp.ones_like(ids),
+               "labels": ids}
+    sh = params_shardings(params_h, mesh, min_size=2 ** 10)
+    assert sh["embed"].spec == P("fsdp", None)
+    params = jax.device_put(params_h, sh)
+    repl = replicated_sharding(mesh)
+    lora = jax.device_put(lora_h, jax.tree.map(lambda _: repl, lora_h))
+    tc = TrainConfig(total_steps=4, lr=1e-2, schedule="constant",
+                     warmup_ratio=0.0)
+    opt = jax.device_put(init_optimizer(lora_h, tc, mask),
+                         jax.tree.map(lambda _: repl,
+                                      init_optimizer(lora_h, tc, mask)))
+
+    def loss_fn(lora_t, p, mb, ce_mesh, cp_mesh, sp):
+        hidden = gemma3.hidden_states(
+            cfg, p, mb["input_ids"], attention_mask=mb["attention_mask"],
+            lora=lora_t, cp_mesh=cp_mesh)
+        return chunked_lm_cross_entropy_sum(
+            hidden, p["embed"], mb["labels"], num_chunks=4, mesh=ce_mesh,
+            sequence_parallel=sp)
+
+    sp_batch = shard_batch(batch_h, mesh, sequence_parallel=True)
+    sp_step = make_train_step(
+        functools.partial(loss_fn, ce_mesh=mesh, cp_mesh=mesh, sp=True),
+        tc, mask=mask, donate=False)
+    with mesh:
+        compiled = sp_step.lower(lora, params, opt, sp_batch,
+                                 jnp.int32(0)).compile()
+        # (a) the V-sharded table is never all-gathered, even with the
+        # sequence riding the same axis
+        from mobilefinetuner_tpu.core.xla_stats import shaped_all_gathers
+        bad = shaped_all_gathers(compiled, (cfg.vocab_size, cfg.hidden_size))
+        assert not bad, "\n".join(bad[:3])
+        losses = []
+        l2, o2 = lora, opt
+        for s in range(3):
+            l2, o2, m = sp_step(l2, params, o2, sp_batch, jnp.int32(s))
+            losses.append(float(m["loss"]))
+        # (b) batch-parallel mesh step on the same data agrees
+        bp_step = make_train_step(
+            functools.partial(loss_fn, ce_mesh=mesh, cp_mesh=None,
+                              sp=False), tc, mask=mask, donate=False)
+        _, _, bp_m = bp_step(lora, params, opt, shard_batch(batch_h, mesh),
+                             jnp.int32(0))
+    # unsharded oracle (sum/count contract)
+    s_ref, c_ref = jax.jit(lambda l, p, mb: loss_fn(
+        l, p, mb, ce_mesh=None, cp_mesh=None, sp=False))(
+        lora_h, params_h, batch_h)
+    oracle = float(s_ref) / float(c_ref)
+    assert losses[0] == pytest.approx(oracle, rel=1e-4)
+    assert losses[0] == pytest.approx(float(bp_m["loss"]), rel=1e-4)
+    # (c) trains
+    assert losses[-1] < losses[0], losses
+
+
+def test_gemma_sp_chunk_misalignment_falls_back_loudly(mesh):
+    """When the scan chunk cannot split over the sequence axis the CE
+    must warn and fall back, not silently misshard (ops/loss.py)."""
+    from mobilefinetuner_tpu.models import gemma3
+    from mobilefinetuner_tpu.ops.loss import chunked_lm_cross_entropy_sum
+    cfg = _gemma_cfg()
+    params = gemma3.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(12)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 32)), jnp.int32)
+    hidden = jnp.zeros((8, 32, cfg.hidden_size), jnp.float32)
+    with pytest.warns(UserWarning, match="sequence-parallel chunk"):
+        # num_chunks=31 -> chunk=1, not divisible by fsdp=4
+        chunked_lm_cross_entropy_sum(hidden, params["embed"], ids,
+                                     num_chunks=31, mesh=mesh,
+                                     sequence_parallel=True)
+
+
 def test_gemma_full_ft_mesh_adam_state_sharded(mesh):
     """Gemma full FT under the mesh: the TRAINABLE tied embed keeps its
     V-sharding through the step, Adam m/v inherit it (ZeRO), and the

@@ -361,19 +361,29 @@ def bench_gemma_lora(B, S, dtype, accum=1, offload=False, steps=20,
     return r
 
 
-def bench_gemma_full_offload(B, S, dtype, steps=10, loss_chunks=8):
+def bench_gemma_full_offload(B, S, dtype, steps=10, loss_chunks=8,
+                             tier16: bool = False):
     """Gemma-1B FULL fine-tune on one chip: f32 master weights + Adam m/v
     live in pinned host RAM and stream through the scanned update
     (optim/opt_offload.py); the device holds only the bf16 compute copy.
     Resident full FT would need ~16 GB of optimizer state alone — the
-    reference cannot do this at any scale."""
+    reference cannot do this at any scale.
+
+    tier16 stores the streamed master (stochastic-rounded) and m/v
+    (sqrt-encoded v) in bf16 on the host — 12 GB/step of DMA instead of
+    24 (OptOffloadSpec; the analog of the reference's fp16 slow tier,
+    parameter_sharder.cpp:215-232)."""
     from mobilefinetuner_tpu.optim.opt_offload import (
-        init_opt_offload, make_offload_train_step, plan_opt_offload)
+        OptOffloadSpec, init_opt_offload, make_offload_train_step,
+        plan_opt_offload)
+    spec = OptOffloadSpec(state_dtype="bfloat16", master_dtype="bfloat16") \
+        if tier16 else OptOffloadSpec()
     config = Gemma3TextConfig.gemma3_1b()
     params = gemma3.init_params(config, jax.random.PRNGKey(0))
     n = sum(x.size for x in jax.tree.leaves(params))
-    plan = plan_opt_offload(params)
-    compute, opt = init_opt_offload(params, plan, compute_dtype=dtype)
+    plan = plan_opt_offload(params, spec)
+    compute, opt = init_opt_offload(params, plan, compute_dtype=dtype,
+                                    spec=spec)
     del params
     tc = TrainConfig(total_steps=1000, lr=2e-5, schedule="constant",
                      warmup_ratio=0.0)
@@ -388,7 +398,8 @@ def bench_gemma_full_offload(B, S, dtype, steps=10, loss_chunks=8):
                                             num_chunks=loss_chunks)
 
     step_fn = make_offload_train_step(loss_fn, tc, plan,
-                                      compute_dtype=dtype, donate=True)
+                                      compute_dtype=dtype, donate=True,
+                                      spec=spec)
     batches, eval_batch = row_batches(config.vocab_size, B, S, steps)
     r = measure(step_fn, compute, None, opt, batches, eval_batch, steps)
     r["flops"] = transformer_flops(
@@ -601,6 +612,12 @@ def main():
         # optimizer stream is a fixed cost, so batch amortizes it)
         run("gemma1b_full_bf16_opt_offload_B96", bench_gemma_full_offload,
             bf16, max(gsteps // 2, 2), B=96, S=GS)
+        # the 16-bit host tier halves the dominant optimizer DMA
+        # (24 -> 12 GB/step): bf16 master (stochastic-rounded write-back)
+        # + bf16 m + sqrt-encoded bf16 v, dequantized on-chip
+        run("gemma1b_full_bf16_opt_offload16_B96",
+            bench_gemma_full_offload, bf16, max(gsteps // 2, 2), B=96,
+            S=GS, tier16=True)
         # flash vs xla at the long-context shape ('auto' resolves flash)
         run("gpt2s_lora_bf16_S1024_flash", bench_gpt2_lora, bf16, steps,
             B=4, S=1024, impl="flash")

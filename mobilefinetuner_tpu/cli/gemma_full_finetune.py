@@ -59,6 +59,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "device holds only the compute-dtype copy. "
                         "Enables 1B-class full FT on one 16 GB chip "
                         "(optim/opt_offload.py); single-chip only")
+    p.add_argument("--opt_offload_state_dtype", default="float32",
+                   choices=["float32", "bfloat16", "float16"],
+                   help="storage dtype for the streamed Adam m/v host "
+                        "tier (16-bit halves their stream; v is "
+                        "sqrt-encoded — OptOffloadSpec). The sidecar "
+                        "must be resumed with the same dtype.")
+    p.add_argument("--opt_offload_master_dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="storage dtype for the streamed master weights "
+                        "(bfloat16 quantizes the update write-back with "
+                        "stochastic rounding — OptOffloadSpec)")
     common.add_train_flags(p, lr=2e-5, seq_len=256, batch_size=1)
     common.add_pm_flags(p)
     common.add_mesh_flags(p)
@@ -117,9 +128,12 @@ def main(argv=None) -> int:
             raise SystemExit("--opt_offload is single-chip (it streams "
                              "state through one chip's host link); drop "
                              "--mesh_data/--mesh_fsdp")
-        plan = oo.plan_opt_offload(params)
+        oo_spec = oo.OptOffloadSpec(
+            state_dtype=args.opt_offload_state_dtype,
+            master_dtype=args.opt_offload_master_dtype)
+        plan = oo.plan_opt_offload(params, oo_spec)
         trainable, opt_state = oo.init_opt_offload(
-            params, plan, compute_dtype=compute_dtype)
+            params, plan, compute_dtype=compute_dtype, spec=oo_spec)
         start_step = 0
         if args.resume_from and os.path.exists(args.resume_from + ".opt"):
             opt_state = oo.resume_opt_sidecar(args.resume_from + ".opt",
@@ -127,16 +141,21 @@ def main(argv=None) -> int:
             start_step = int(opt_state["step"])
             log.info(f"restored offloaded opt state @ step {start_step}")
         n_streamed = sum(1 for c in jax.tree.leaves(plan) if c)
-        host_mb = sum(x.size * 4 * 3 / 2 ** 20
+        import jax.numpy as jnp
+        per_param = (jnp.dtype(oo_spec.master_dtype).itemsize
+                     + 2 * jnp.dtype(oo_spec.state_dtype).itemsize)
+        host_mb = sum(x.size * per_param / 2 ** 20
                       for x, c in zip(jax.tree.leaves(params),
                                       jax.tree.leaves(plan)) if c)
         log.info(f"opt offload: {n_streamed} leaves "
-                 f"({host_mb:.0f} MB master+m+v) -> pinned host")
+                 f"({host_mb:.0f} MB master+m+v, "
+                 f"master={oo_spec.master_dtype} "
+                 f"state={oo_spec.state_dtype}) -> pinned host")
 
         def step_builder(loss_fn, tc, mask=None, donate=True):
             return oo.make_offload_train_step(
                 loss_fn, tc, plan, compute_dtype=compute_dtype,
-                donate=donate, mask=mask)
+                donate=donate, mask=mask, spec=oo_spec)
         params = trainable
     else:
         opt_state, start_step = common.maybe_resume_opt_state(

@@ -76,7 +76,8 @@ class OptOffloadSpec:
         vanishing below the bf16 ulp).
     Resident (small) leaves always stay f32. Both knobs change stored
     bits, so a sidecar written with one spec must be resumed with the
-    same spec (shape/dtype mismatch fails loudly in load_state)."""
+    same spec (resume_opt_sidecar checks stored-vs-template dtypes and
+    fails loudly on mismatch)."""
     min_stream_bytes: int = 1 << 22          # 4 MB
     chunk_bytes: int = 96 << 20              # ~96 MB target slice
     state_dtype: str = "float32"
@@ -198,10 +199,31 @@ def save_opt_sidecar(path: str, opt_state, adam_cfg):
 def resume_opt_sidecar(path: str, opt_state):
     """Load a sidecar written by save_opt_sidecar into a freshly
     init_opt_offload'ed state (master comes from the resumed model file),
-    re-placing every leaf onto its template sharding (host tiers)."""
+    re-placing every leaf onto its template sharding (host tiers).
+
+    The STORED dtypes must match the template's: the streamed shapes are
+    spec-independent and load_state casts silently, so without this check
+    a sidecar written under one OptOffloadSpec and resumed under another
+    would reinterpret raw-f32 v as sqrt-encoded bf16 (or vice versa) and
+    silently corrupt every Adam denominator. Resume with the same
+    --opt_offload_{state,master}_dtype flags the run was saved with."""
+    from mobilefinetuner_tpu.io.safetensors_io import SafeTensorsReader
     from mobilefinetuner_tpu.optim.adam import load_state
     sub = {"step": opt_state["step"], "m": opt_state["m"],
            "v": opt_state["v"]}
+    reader = SafeTensorsReader(path)
+    st_dtypes = {"F32": jnp.float32, "BF16": jnp.bfloat16,
+                 "F16": jnp.float16, "I32": jnp.int32}
+    for path_keys, leaf in jax.tree_util.tree_flatten_with_path(sub)[0]:
+        key = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                       for k in path_keys)
+        stored = reader.shape_dtype(key)[1]
+        if st_dtypes.get(stored, None) != leaf.dtype:
+            raise ValueError(
+                f"opt sidecar dtype mismatch at {key}: stored {stored}, "
+                f"expected {leaf.dtype} — resume with the same "
+                f"--opt_offload_state_dtype/--opt_offload_master_dtype "
+                f"the sidecar was saved with")
     loaded, _ = load_state(path, sub)
     placed = jax.tree.map(lambda x, t: jax.device_put(x, t.sharding),
                           loaded, sub)

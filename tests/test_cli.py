@@ -261,6 +261,35 @@ def test_gemma_full_finetune_opt_offload(gemma_dir, wiki_dir, tmp_path):
               "--output_path", str(tmp_path / "x.safetensors")])
 
 
+def test_gemma_full_finetune_opt_offload_16bit(gemma_dir, wiki_dir,
+                                               tmp_path):
+    """The 16-bit host tier through the CLI: bf16 master (stochastic-
+    rounded) + bf16 m / sqrt-v stream, f32 master still saved, sidecar
+    resume with the SAME dtype flags works."""
+    import numpy as np
+    from mobilefinetuner_tpu.cli.gemma_full_finetune import main
+    out = str(tmp_path / "g16.safetensors")
+    flags = ["--model_dir", gemma_dir, "--data_dir", wiki_dir,
+             "--batch_size", "2", "--seq_len", "32", "--loss_chunks", "2",
+             "--opt_offload", "--opt_offload_state_dtype", "bfloat16",
+             "--opt_offload_master_dtype", "bfloat16"]
+    rc = main(flags + ["--steps", "2", "--output_path", out])
+    assert rc == 0
+    from mobilefinetuner_tpu.io.safetensors_io import SafeTensorsReader
+    r = SafeTensorsReader(out)
+    # the checkpoint contract is unchanged: master saved as F32 (the
+    # stored bf16 master upcasts losslessly)
+    assert r.shape_dtype("model.embed_tokens.weight")[1] == "F32"
+    assert os.path.exists(out + ".opt")
+    out2 = str(tmp_path / "g16b.safetensors")
+    rc = main(flags + ["--steps", "3", "--resume_from", out,
+                       "--output_path", out2])
+    assert rc == 0
+    a = SafeTensorsReader(out).load_all()["model.embed_tokens.weight"]
+    b = SafeTensorsReader(out2).load_all()["model.embed_tokens.weight"]
+    assert not np.allclose(a, b)
+
+
 def test_train_lora_gemma_smoke(gemma_dir, wiki_dir, tmp_path):
     from mobilefinetuner_tpu.cli.train_lora_gemma import main
     out_dir = str(tmp_path / "gl")

@@ -139,10 +139,14 @@ def _launch_smoke(nprocs: int, ndev: int, timeout: int = 420):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out}"
         assert "MULTIHOST_OK" in out, out
-    losses = {ln.split("loss=")[1].split()[0]
-              for out in outs for ln in out.splitlines()
-              if "MULTIHOST_OK" in ln}
+    ok_lines = [ln for out in outs for ln in out.splitlines()
+                if "MULTIHOST_OK" in ln]
+    losses = {ln.split(" loss=")[1].split()[0] for ln in ok_lines}
     assert len(losses) == 1, f"processes disagree: {losses}"
+    # the Gemma phase (V-sharded embed + vocab-parallel CE over DCN) must
+    # also agree across processes
+    glosses = {ln.split("gemma_loss=")[1].split()[0] for ln in ok_lines}
+    assert len(glosses) == 1, f"Gemma losses disagree: {glosses}"
 
 
 def test_two_process_training_step_agrees():

@@ -189,3 +189,149 @@ def test_resume_equals_uninterrupted():
         compute3, opt3, _ = step(compute3, None, opt3, batch, jnp.int32(s))
     got = master_to_params(opt3, plan, params)
     assert_tree_matches(got, want, exact=True)
+
+
+# ----------------------- 16-bit host tier (round 5) --------------------------
+
+SPEC16 = OptOffloadSpec(min_stream_bytes=1 << 10, chunk_bytes=1 << 12,
+                        state_dtype="bfloat16", master_dtype="bfloat16")
+
+
+@pytest.mark.parametrize("state_dtype,master_dtype", [
+    ("bfloat16", "bfloat16"),
+    ("float16", "float32"),
+])
+def test_16bit_tier_dtypes_and_trains(state_dtype, master_dtype):
+    """The 16-bit tier stores streamed m/v (and optionally master) in
+    16-bit on the host, dequantizes on-chip, and still trains."""
+    params, batch = make_problem(seed=3)
+    spec = OptOffloadSpec(min_stream_bytes=1 << 10, chunk_bytes=1 << 12,
+                          state_dtype=state_dtype,
+                          master_dtype=master_dtype)
+    plan = plan_opt_offload(params, spec)
+    compute, opt = init_opt_offload(params, plan, spec=spec)
+    assert opt["master"]["embed"].dtype == jnp.dtype(master_dtype)
+    assert opt["m"]["blocks"]["attn"]["q_w"].dtype == jnp.dtype(state_dtype)
+    assert opt["v"]["embed"].dtype == jnp.dtype(state_dtype)
+    # resident (small) leaves always stay f32
+    assert opt["master"]["final_norm"].dtype == jnp.float32
+    assert opt["m"]["final_norm"].dtype == jnp.float32
+    tc = TrainConfig(total_steps=6, lr=5e-3, schedule="constant",
+                     warmup_ratio=0.0)
+    step = make_offload_train_step(loss_fn, tc, plan, donate=False,
+                                   spec=spec)
+    losses = []
+    for s in range(5):
+        compute, opt, m = step(compute, None, opt, batch, jnp.int32(s))
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_16bit_quality_tracks_f32_stream():
+    """Quality guard: a short 16-bit-tier run lands within optimizer-noise
+    distance of the f32 stream (same seed, same batches)."""
+    params, batch = make_problem(seed=4)
+    tc = TrainConfig(total_steps=5, lr=2e-3, schedule="constant",
+                     warmup_ratio=0.0)
+    finals = {}
+    for name, spec in (
+            ("f32", OptOffloadSpec(min_stream_bytes=1 << 10,
+                                   chunk_bytes=1 << 12)),
+            ("16bit", SPEC16)):
+        plan = plan_opt_offload(params, spec)
+        compute, opt = init_opt_offload(params, plan, spec=spec)
+        step = make_offload_train_step(loss_fn, tc, plan, donate=False,
+                                       spec=spec)
+        for s in range(4):
+            compute, opt, m = step(compute, None, opt, batch, jnp.int32(s))
+        finals[name] = float(m["loss"])
+    assert finals["16bit"] == pytest.approx(finals["f32"], rel=2e-2), finals
+
+
+def test_16bit_resume_equals_uninterrupted():
+    """The resume contract HOLDS on the 16-bit tier too: stochastic
+    rounding is counter-based on (step, leaf, chunk), so an interrupted
+    run replays the exact same quantization draws (opt_offload._sr_bfloat16)."""
+    from mobilefinetuner_tpu.optim.opt_offload import (resume_opt_sidecar,
+                                                       save_opt_sidecar)
+    import tempfile, os
+    params, batch = make_problem(seed=5)
+    tc = TrainConfig(total_steps=4, lr=1e-3, schedule="cosine",
+                     warmup_ratio=0.25)
+    plan = plan_opt_offload(params, SPEC16)
+    step = make_offload_train_step(loss_fn, tc, plan,
+                                   compute_dtype=jnp.float32, donate=False,
+                                   spec=SPEC16)
+    compute, opt = init_opt_offload(params, plan, compute_dtype=jnp.float32,
+                                    spec=SPEC16)
+    for s in range(4):
+        compute, opt, _ = step(compute, None, opt, batch, jnp.int32(s))
+    want = master_to_params(opt, plan, params)
+
+    compute2, opt2 = init_opt_offload(params, plan,
+                                      compute_dtype=jnp.float32,
+                                      spec=SPEC16)
+    for s in range(2):
+        compute2, opt2, _ = step(compute2, None, opt2, batch, jnp.int32(s))
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "ck.opt")
+        save_opt_sidecar(path, opt2, tc.adam())
+        master_mid = master_to_params(opt2, plan, params)
+        compute3, opt3 = init_opt_offload(master_mid, plan,
+                                          compute_dtype=jnp.float32,
+                                          spec=SPEC16)
+        opt3 = resume_opt_sidecar(path, opt3)
+    assert opt3["m"]["embed"].dtype == jnp.bfloat16  # sidecar kept 16-bit
+    for s in range(2, 4):
+        compute3, opt3, _ = step(compute3, None, opt3, batch, jnp.int32(s))
+    got = master_to_params(opt3, plan, params)
+    assert_tree_matches(got, want, exact=True)
+
+
+def test_resume_rejects_spec_mismatch():
+    """A sidecar saved under one spec must NOT silently load under
+    another (raw-f32 v reinterpreted as sqrt-encoded bf16 would corrupt
+    every Adam denominator)."""
+    from mobilefinetuner_tpu.optim.opt_offload import (resume_opt_sidecar,
+                                                       save_opt_sidecar)
+    import tempfile, os
+    params, batch = make_problem(seed=6)
+    tc = TrainConfig(total_steps=2, lr=1e-3, schedule="constant",
+                     warmup_ratio=0.0)
+    spec_f32 = OptOffloadSpec(min_stream_bytes=1 << 10,
+                              chunk_bytes=1 << 12)
+    plan = plan_opt_offload(params, spec_f32)
+    compute, opt = init_opt_offload(params, plan, spec=spec_f32)
+    step = make_offload_train_step(loss_fn, tc, plan, donate=False,
+                                   spec=spec_f32)
+    compute, opt, _ = step(compute, None, opt, batch, jnp.int32(0))
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "ck.opt")
+        save_opt_sidecar(path, opt, tc.adam())
+        _, opt16 = init_opt_offload(params, plan, spec=SPEC16)
+        with pytest.raises(ValueError, match="dtype mismatch"):
+            resume_opt_sidecar(path, opt16)
+
+
+def test_sr_bfloat16_unbiased():
+    """Stochastic rounding: every draw is one of the two bf16 neighbors,
+    and the mean over many salts converges to the f32 value (the property
+    that keeps tiny lr*update increments alive in expectation)."""
+    from mobilefinetuner_tpu.optim.opt_offload import _sr_bfloat16
+    x = jnp.asarray([1.0 + 1 / 512, -3.137e-3, 42.123, 1e-20], jnp.float32)
+    lo = x.astype(jnp.bfloat16)
+    draws = np.stack([np.asarray(_sr_bfloat16(x, jnp.int32(s)),
+                                 np.float32) for s in range(512)])
+    xf = np.asarray(x, np.float32)
+    lof = np.asarray(lo, np.float32)
+    for j in range(x.size):
+        uniq = np.unique(draws[:, j])
+        assert len(uniq) <= 2, uniq
+        assert np.all((uniq >= min(lof[j], xf[j]) - abs(xf[j]) / 128)
+                      & (uniq <= max(lof[j], xf[j]) + abs(xf[j]) / 128))
+    # unbiasedness: the mean must be much closer to x than the worst-case
+    # round-to-nearest error (bf16 ulp/2 ~ x/512)
+    mean = draws.mean(0)
+    for j in range(3):  # skip the subnormal-ish 1e-20
+        assert abs(mean[j] - xf[j]) < abs(xf[j]) / 1500, (j, mean[j], xf[j])

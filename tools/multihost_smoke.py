@@ -5,9 +5,14 @@ Each process brings up the jax.distributed runtime against a shared
 coordinator, builds the DCN-aware hybrid mesh, FSDP-shards a tiny GPT-2's
 frozen params over it, and runs TWO LoRA optimizer steps on a seeded global
 batch (every process computes the same batch; parallel/distributed.py feeds
-each process's addressable shards). Prints `MULTIHOST_OK loss=<x>` — the
-launcher asserts both processes print the same loss, which can only happen
-if the cross-process collectives actually ran.
+each process's addressable shards). A second phase runs tiny GEMMA-3 (GQA,
+local/global interleave, V-sharded tied embed, vocab-parallel chunked CE)
+across the same process boundaries — the riskiest DCN composition: the
+CE's vocab psums crossing the hybrid mesh with global-array feeding, with
+an in-program HLO assertion that the V-sharded table is never
+all-gathered. Prints `MULTIHOST_OK loss=<x> gemma_loss=<y>` — the launcher
+asserts every process prints the same losses, which can only happen if the
+cross-process collectives actually ran.
 
 Usage (one line per process):
   python tools/multihost_smoke.py <coordinator> <num_procs> <proc_id> [ndev]
@@ -114,7 +119,63 @@ def main():
         # replicated trainables gather via the fully-replicated fast path
         assert all(isinstance(x, np.ndarray)
                    for x in jax.tree.leaves(lora_h))
-    print(f"MULTIHOST_OK loss={loss:.6f} "
+    # ---- Gemma phase: vocab-parallel CE across REAL process boundaries
+    # (round-5 verdict item 4). The tied 2048-row embed V-shards over the
+    # per-process fsdp axis; the CE's max/sum-exp/gold psums cross the
+    # hybrid mesh; the compiled HLO must carry no full-table all-gather.
+    from mobilefinetuner_tpu.core.config import Gemma3TextConfig
+    from mobilefinetuner_tpu.lora.lora import init_lora_gemma3
+    from mobilefinetuner_tpu.models import gemma3
+    from mobilefinetuner_tpu.ops.loss import chunked_lm_cross_entropy_sum
+
+    gcfg = Gemma3TextConfig(
+        vocab_size=2048, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16, max_position_embeddings=64,
+        sliding_window=16, query_pre_attn_scalar=16.0,
+        sliding_window_pattern=3)
+    gparams = gemma3.init_params(gcfg, jax.random.PRNGKey(3))
+    gparams = shard_params(gparams, mesh, min_size=0)
+    assert gparams["embed"].sharding.spec[0] == "fsdp", \
+        gparams["embed"].sharding  # the risky bit: V-sharded tied table
+    glora = init_lora_gemma3(gcfg, LoRASpec(rank=2, alpha=4.0, init="peft"),
+                             jax.random.PRNGKey(4))
+    glora = jax.tree.map(
+        lambda x: dist.device_put_global(
+            x, jax.sharding.NamedSharding(mesh,
+                                          jax.sharding.PartitionSpec())),
+        glora)
+    gmask = trainable_mask(glora)
+    gopt = init_optimizer(glora, tc, gmask)
+
+    def gemma_loss_fn(lora_t, p, mb):
+        hidden = gemma3.hidden_states(
+            gcfg, p, mb["input_ids"], attention_mask=mb["attention_mask"],
+            lora=lora_t)
+        return chunked_lm_cross_entropy_sum(
+            hidden, p["embed"], mb["labels"], num_chunks=4, mesh=mesh)
+
+    gstep = make_train_step(gemma_loss_fn, tc, mask=gmask, donate=False)
+    gids = rng.integers(0, gcfg.vocab_size, (2 * B, 32)).astype(np.int32)
+    gbatch = shard_batch({"input_ids": gids,
+                          "attention_mask": np.ones_like(gids),
+                          "labels": gids}, mesh)
+    with mesh:
+        gcomp = gstep.lower(glora, gparams, gopt, gbatch,
+                            jnp.int32(0)).compile()
+        from mobilefinetuner_tpu.core.xla_stats import shaped_all_gathers
+        bad = shaped_all_gathers(gcomp, (gcfg.vocab_size, gcfg.hidden_size))
+        assert not bad, ("full-table all-gather across processes:\n"
+                         + "\n".join(bad[:3]))
+        glosses = []
+        for step in range(2):
+            glora, gopt, gm = gstep(glora, gparams, gopt, gbatch,
+                                    jnp.int32(step))
+            glosses.append(float(gm["loss"]))
+    assert np.isfinite(glosses[-1]), glosses
+    assert glosses[1] < glosses[0], glosses
+
+    print(f"MULTIHOST_OK loss={loss:.6f} gemma_loss={glosses[-1]:.6f} "
           f"proc={jax.process_index()}/{jax.process_count()}")
 
 

@@ -526,6 +526,20 @@ def main():
 
     suite = []
 
+    def flush_suite():
+        # incremental: a killed/timed-out run still leaves every
+        # completed row on disk (the 1B full-offload rows alone take
+        # ~30 min; losing 19 finished rows to a timeout is worse than
+        # a partial artifact). temp+rename so a kill MID-flush can't
+        # leave truncated JSON.
+        import os
+        with open("BENCH_SUITE.json.tmp", "w") as f:
+            json.dump({"suite": suite,
+                       "peak_flops_assumed": PEAK_FLOPS,
+                       "baseline_tokens_per_sec": BASELINE_TOKENS_PER_SEC},
+                      f, indent=1)
+        os.replace("BENCH_SUITE.json.tmp", "BENCH_SUITE.json")
+
     def run(name, fn, dtype, n, finisher=finish, **kw):
         try:
             r = fn(dtype=jnp.bfloat16 if dtype == bf16 else jnp.float32,
@@ -535,6 +549,7 @@ def main():
             row = {"config": name, "error": f"{type(e).__name__}: {e}"}
         suite.append(row)
         print(json.dumps(row), file=sys.stderr)
+        flush_suite()
         return row
 
     headline = run(f"gpt2s_lora_bf16_B{B}_S128", bench_gpt2_lora, bf16,
@@ -665,11 +680,7 @@ def main():
                                                 dtype=dtype), bf16, 1,
             finisher=gen_finish)
 
-    with open("BENCH_SUITE.json", "w") as f:
-        json.dump({"suite": suite,
-                   "peak_flops_assumed": PEAK_FLOPS,
-                   "baseline_tokens_per_sec": BASELINE_TOKENS_PER_SEC},
-                  f, indent=1)
+    # (run() flushed after every row — nothing left to write here)
 
     # driver contract: exactly one JSON line on stdout (headline config);
     # a failed headline must FAIL the run, not report a zero measurement

@@ -361,6 +361,9 @@ def bench_gemma_lora(B, S, dtype, accum=1, offload=False, steps=20,
     return r
 
 
+_GEMMA1B_NP = None
+
+
 def bench_gemma_full_offload(B, S, dtype, steps=10, loss_chunks=8,
                              tier16: bool = False):
     """Gemma-1B FULL fine-tune on one chip: f32 master weights + Adam m/v
@@ -379,12 +382,21 @@ def bench_gemma_full_offload(B, S, dtype, steps=10, loss_chunks=8,
     spec = OptOffloadSpec(state_dtype="bfloat16", master_dtype="bfloat16") \
         if tier16 else OptOffloadSpec()
     config = Gemma3TextConfig.gemma3_1b()
-    params = gemma3.init_params(config, jax.random.PRNGKey(0))
+    # host-numpy param cache shared by the f32 and tier16 rows: the 1B
+    # init + device->host staging costs minutes on this platform and is
+    # identical for both specs (init_opt_offload stages from host numpy
+    # either way)
+    global _GEMMA1B_NP
+    if _GEMMA1B_NP is None:
+        _GEMMA1B_NP = jax.tree.map(
+            lambda x: np.asarray(jax.device_get(x)),
+            gemma3.init_params(config, jax.random.PRNGKey(0)))
+    params = _GEMMA1B_NP
     n = sum(x.size for x in jax.tree.leaves(params))
     plan = plan_opt_offload(params, spec)
     compute, opt = init_opt_offload(params, plan, compute_dtype=dtype,
                                     spec=spec)
-    del params
+    del params  # the module-level np cache keeps the host copy
     tc = TrainConfig(total_steps=1000, lr=2e-5, schedule="constant",
                      warmup_ratio=0.0)
 
@@ -554,6 +566,28 @@ def main():
 
     headline = run(f"gpt2s_lora_bf16_B{B}_S128", bench_gpt2_lora, bf16,
                    steps, B=B, S=S)
+    # driver contract: exactly one JSON line on stdout (headline config).
+    # Printed IMMEDIATELY after the headline row — the full suite now
+    # runs >1 h on the chip (two 1B full-FT offload configs alone cost
+    # ~30 min of init+compile), and a driver-side timeout killing the
+    # tail must not lose the headline metric (completed rows survive in
+    # BENCH_SUITE.json via the per-row flush either way). A failed
+    # headline reports value 0 and exits 1 at the END — the remaining
+    # rows still run and land in the artifact.
+    if "error" in headline:
+        print(json.dumps({
+            "metric": "gpt2s_lora_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+            "error": headline["error"]}), flush=True)
+    else:
+        print(json.dumps({
+            "metric": "gpt2s_lora_train_tokens_per_sec_per_chip",
+            "value": headline["tokens_per_sec_per_chip"],
+            "unit": "tokens/sec/chip",
+            "vs_baseline": headline["vs_baseline"],
+            "mfu": headline["mfu"],
+            "peak_hbm_mb": headline["peak_hbm_mb"],
+        }), flush=True)
     if on_tpu:  # the full suite is a TPU artifact; off-TPU is a smoke
         run(f"gpt2s_lora_f32_B{B}_S128", bench_gpt2_lora, f32, steps,
             B=B, S=S)
@@ -633,6 +667,10 @@ def main():
         run("gemma1b_full_bf16_opt_offload16_B96",
             bench_gemma_full_offload, bf16, max(gsteps // 2, 2), B=96,
             S=GS, tier16=True)
+        # the 1B host-numpy cache (~4 GB) has no further consumers —
+        # release it before the flash/generate rows
+        global _GEMMA1B_NP
+        _GEMMA1B_NP = None
         # flash vs xla at the long-context shape ('auto' resolves flash)
         run("gpt2s_lora_bf16_S1024_flash", bench_gpt2_lora, bf16, steps,
             B=4, S=1024, impl="flash")
@@ -680,25 +718,9 @@ def main():
                                                 dtype=dtype), bf16, 1,
             finisher=gen_finish)
 
-    # (run() flushed after every row — nothing left to write here)
-
-    # driver contract: exactly one JSON line on stdout (headline config);
-    # a failed headline must FAIL the run, not report a zero measurement
-    if "error" in headline:
-        print(json.dumps({
-            "metric": "gpt2s_lora_train_tokens_per_sec_per_chip",
-            "value": 0.0, "unit": "tokens/sec/chip", "vs_baseline": 0.0,
-            "error": headline["error"]}))
-        return 1
-    print(json.dumps({
-        "metric": "gpt2s_lora_train_tokens_per_sec_per_chip",
-        "value": headline["tokens_per_sec_per_chip"],
-        "unit": "tokens/sec/chip",
-        "vs_baseline": headline["vs_baseline"],
-        "mfu": headline["mfu"],
-        "peak_hbm_mb": headline["peak_hbm_mb"],
-    }))
-    return 0
+    # (run() flushed after every row; the headline stdout line was
+    # printed right after the headline row above)
+    return 1 if "error" in headline else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,73 @@
+"""bench.py driver contract: exactly ONE JSON metric line on stdout
+(printed right after the headline row, so a tail timeout cannot lose
+it), per-row atomic BENCH_SUITE.json flushes, and a failed headline
+reporting value 0 without aborting the rest of the run.
+
+The heavy bench functions are stubbed — this pins the harness plumbing
+the round scoring depends on, not the measurements."""
+
+import contextlib
+import io
+import json
+import os
+
+import pytest
+
+
+@pytest.fixture
+def bench(monkeypatch, tmp_path):
+    import bench as b
+    monkeypatch.chdir(tmp_path)
+
+    def fake_bench(dtype, steps, **kw):
+        return {"dt": 1.0, "loss": 1.23, "peak_bytes": 2 ** 30,
+                "flops": 10 ** 12, "tokens": 1000,
+                "loss_tokens_seen": 24576}
+
+    monkeypatch.setattr(b, "bench_gpt2_lora", fake_bench)
+    return b
+
+
+def run_main(b):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = b.main()
+    lines = [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+    return rc, lines
+
+
+def test_single_stdout_line_and_suite_artifact(bench):
+    rc, lines = run_main(bench)
+    assert rc == 0
+    # exactly one stdout line, the driver metric schema
+    assert len(lines) == 1, lines
+    m = json.loads(lines[0])
+    assert m["metric"] == "gpt2s_lora_train_tokens_per_sec_per_chip"
+    assert m["unit"] == "tokens/sec/chip"
+    assert m["value"] > 0 and m["vs_baseline"] is not None
+    # the incremental flush left a valid artifact with the headline row
+    with open("BENCH_SUITE.json") as f:
+        suite = json.load(f)
+    assert suite["suite"][0]["config"].startswith("gpt2s_lora_bf16")
+    assert suite["suite"][0]["loss"] == 1.23
+    # atomic-replace leaves no temp file behind
+    assert not os.path.exists("BENCH_SUITE.json.tmp")
+
+
+def test_failed_headline_reports_zero_and_exits_nonzero(bench,
+                                                        monkeypatch):
+    def boom(dtype, steps, **kw):
+        raise RuntimeError("compile service hiccup")
+
+    monkeypatch.setattr(bench, "bench_gpt2_lora", boom)
+    rc, lines = run_main(bench)
+    assert rc == 1
+    assert len(lines) == 1
+    m = json.loads(lines[0])
+    assert m["value"] == 0.0 and "hiccup" in m["error"]
+    # the error row still landed in the artifact (run() records, not
+    # raises — off-TPU there are no further rows, but the suite file
+    # must exist and be valid JSON either way)
+    with open("BENCH_SUITE.json") as f:
+        suite = json.load(f)
+    assert "error" in suite["suite"][0]

@@ -247,6 +247,11 @@ def bench_gpt2_lora(B, S, dtype, accum=1, offload=False, impl="auto",
                     steps=40, size="small", remat=False):
     base = {"small": GPT2Config.gpt2_small, "medium": GPT2Config.gpt2_medium,
             "large": GPT2Config.gpt2_large, "xl": GPT2Config.gpt2_xl}[size]()
+    # long-context rows past GPT-2's native 1024 positions: the bench
+    # trains randomly-initialized weights, so extending the learned
+    # position table is shape plumbing, not a semantics change
+    if S > base.n_positions:
+        base = dataclasses.replace(base, n_positions=S)
     config = dataclasses.replace(base, attention_impl=impl)
     params = gpt2.init_params(config, jax.random.PRNGKey(0))
     spec = LoRASpec(rank=8, alpha=16.0)
@@ -316,9 +321,10 @@ def bench_gpt2_full(B, S, dtype, steps=40):
 
 def bench_gemma_lora(B, S, dtype, accum=1, offload=False, steps=20,
                      loss_chunks=4, size="270m", offload_budget=0,
-                     remat=False):
+                     remat=False, impl="auto"):
     config = (Gemma3TextConfig.gemma3_1b() if size == "1b"
               else Gemma3TextConfig.gemma3_270m())
+    config = dataclasses.replace(config, attention_impl=impl)
     params = gemma3.init_params(config, jax.random.PRNGKey(0))
     spec = LoRASpec(rank=8, alpha=32.0, targets="full")
     lora = init_lora_gemma3(config, spec, jax.random.PRNGKey(1))
@@ -356,7 +362,7 @@ def bench_gemma_lora(B, S, dtype, accum=1, offload=False, steps=20,
         config.head_dim, full_ft=False,
         remat_blocks=remat or offload,   # streaming forces body remat
         remat_head=True,                 # chunked CE is checkpointed
-        attn_factor=_attn_factor(S, config.head_dim))
+        attn_factor=_attn_factor(S, config.head_dim, impl))
     r["tokens"] = B * accum * S
     return r
 
@@ -683,6 +689,22 @@ def main():
             B=16, S=512, impl="flash")
         run("gpt2s_lora_bf16_S512_xla", bench_gpt2_lora, bf16, steps,
             B=16, S=512, impl="xla")
+        # S=2048 long-context e2e (r6): the regime the memory-efficient
+        # attention exists for. Pins DESIGN §6a's 2.7-2.8x claim (which
+        # only had a microbench artifact behind it) with driver-captured
+        # e2e rows, and exercises the merged one-pass backward kernel at
+        # depth 4 k-blocks per row block. GPT-2s runs with the position
+        # table extended to 2048 (randomly-init weights — shape plumbing
+        # only); the Gemma pair is the FIRST e2e measurement of the
+        # D=256 S>=2048 crossover resolve_impl asserts.
+        run("gpt2s_lora_bf16_S2048_flash", bench_gpt2_lora, bf16, steps,
+            B=2, S=2048, impl="flash")
+        run("gpt2s_lora_bf16_S2048_xla", bench_gpt2_lora, bf16, steps,
+            B=2, S=2048, impl="xla")
+        run("gemma270m_lora_bf16_S2048_flash", bench_gemma_lora, bf16,
+            gsteps, B=2, S=2048, impl="flash")
+        run("gemma270m_lora_bf16_S2048_xla", bench_gemma_lora, bf16,
+            gsteps, B=2, S=2048, impl="xla")
         # end-to-end generate throughput (prefill + sequential decode;
         # tokens/sec counts generated tokens only).
         # finish() is training-shaped, so pass run() a custom finisher.

@@ -1,0 +1,26 @@
+"""Version-skew shims, each the ONE copy of a jax rename.
+
+The tree is written against current jax (where `jax.shard_map` is public
+and its replication-check flag is `check_vma`); older runtimes still in
+some CI containers carry shard_map under `jax.experimental.shard_map`
+with the flag spelled `check_rep`. Call sites import from here so the
+skew is absorbed in one place instead of at every shard_map.
+
+(The analogous pallas rename — CompilerParams vs TPUCompilerParams — is
+absorbed by ops/pallas_util.tpu_call_params for the same reason.)
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """jax.shard_map with the check_vma flag, on every supported jax."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as legacy_sm
+    return legacy_sm(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=check_vma)

@@ -188,7 +188,8 @@ def trainable_mask(lora_tree) -> dict:
     """Pytree of bools: True for trainable leaves (A/B), False for scale
     and for multi-adapter routing ids. Feed to the optimizer so those are
     never updated/decayed."""
-    return jax.tree.map_with_path(
+    # tree_util spelling: jax.tree.map_with_path only exists on newer jax
+    return jax.tree_util.tree_map_with_path(
         lambda path, _: not (path and getattr(path[-1], "key", None)
                              in ("scale", "ids")),
         lora_tree)

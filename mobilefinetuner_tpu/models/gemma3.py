@@ -210,7 +210,17 @@ def hidden_states(config: Gemma3TextConfig, params, input_ids,
     if offload is not None:
         params, block_stream = resolve_offload(params, offload)
     stream = block_stream
-    x = params["embed"][input_ids].astype(compute_dtype)
+    if (cp_mesh is not None and cp_axis in cp_mesh.axis_names
+            and c.vocab_size % cp_mesh.shape[cp_axis] == 0
+            and S % cp_mesh.shape[cp_axis] == 0):
+        # sequence-parallel + V-sharded tied table: the structural
+        # vocab-parallel lookup — GSPMD left alone all-gathers the full
+        # table here at large mesh sizes (ops/loss.vp_embed_lookup)
+        from mobilefinetuner_tpu.ops.loss import vp_embed_lookup
+        x = vp_embed_lookup(params["embed"], input_ids, cp_mesh,
+                            vocab_axis=cp_axis).astype(compute_dtype)
+    else:
+        x = params["embed"][input_ids].astype(compute_dtype)
     # sqrt(hidden) embedding scaling, computed in the embed dtype as HF does
     normalizer = jnp.asarray(c.hidden_size ** 0.5, compute_dtype)
     x = x * normalizer

@@ -141,4 +141,7 @@ def attention(q, k, v, *, impl: str = "auto", **kwargs):
                 "attention_impl='flash' requires the Pallas kernel "
                 "(ops/flash_attention.py); use attention_impl='xla'") from e
         return flash_attention.flash_attention(q, k, v, **kwargs)
+    # backward-impl selection is a flash-kernel knob; the XLA path has
+    # one backward (jax autodiff)
+    kwargs.pop("bwd_impl", None)
     return dot_product_attention(q, k, v, **kwargs)

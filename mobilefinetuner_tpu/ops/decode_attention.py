@@ -61,7 +61,7 @@ NEG_INF = -1e30
 _VMEM_BUDGET = 12 * 2 ** 20
 
 
-from mobilefinetuner_tpu.ops.pallas_util import interpret_mode as _interpret
+from mobilefinetuner_tpu.ops.pallas_util import tpu_call_params
 
 
 def xla_reference(q, k_cache, v_cache, ok, scale):
@@ -78,23 +78,32 @@ def xla_reference(q, k_cache, v_cache, ok, scale):
                       preferred_element_type=jnp.float32)
 
 
-def pick_kvb(KV: int, T: int, D: int, itemsize: int):
+def pick_kvb(KV: int, T: int, D: int, itemsize: int, G: int = 1):
     """Largest divisor of KV whose double-buffered K+V whole-T blocks fit
     the VMEM budget, or None (caller falls back to XLA). Resident per grid
-    step: 2 (K, V) x 2 (double buffer) x [KVB, T, D] storage-dtype blocks;
-    q/ctx/score temps are O(G·T) f32 — charged as one extra T·D·4 term."""
+    step: 2 (K, V) x 2 (double buffer) x [KVB, T, D] storage-dtype
+    blocks; the [KVB, G, D] q input and f32 ctx output blocks; the
+    per-head [G, T] f32 score/prob rows; plus one T·D·4 slack term for
+    the compiler's elementwise temps. The G-dependent terms keep large-G
+    GQA shapes from passing the gate and overflowing VMEM at runtime
+    (before them, only the K/V blocks were charged)."""
     for kvb in range(KV, 0, -1):
         if KV % kvb:
             continue
-        if 4 * kvb * T * D * itemsize + T * D * 4 <= _VMEM_BUDGET:
+        need = (4 * kvb * T * D * itemsize     # K+V, double-buffered
+                + kvb * G * D * (itemsize + 4)  # q block + f32 ctx block
+                + G * T * 4                     # [G, T] score/prob rows
+                + T * D * 4)                    # elementwise-temp slack
+        if need <= _VMEM_BUDGET:
             return kvb
     return None
 
 
-def decode_eligible(KV: int, T: int, D: int, itemsize: int) -> bool:
+def decode_eligible(KV: int, T: int, D: int, itemsize: int,
+                    G: int = 1) -> bool:
     """T must be sublane-aligned (whole-T blocks are statically indexed,
     but the [T, D] tile still wants 8-row alignment); VMEM must fit."""
-    return T % 8 == 0 and pick_kvb(KV, T, D, itemsize) is not None
+    return T % 8 == 0 and pick_kvb(KV, T, D, itemsize, G) is not None
 
 
 def _decode_kernel(q_ref, k_ref, v_ref, ok_ref, o_ref, *, scale, kvb):
@@ -127,12 +136,12 @@ def decode_attention(q, k_cache, v_cache, ok, scale):
         raise ValueError(
             f"decode_attention requires q.dtype == cache dtype "
             f"(got {q.dtype} vs {k_cache.dtype})")
-    kvb = pick_kvb(KV, T, D, k_cache.dtype.itemsize)
+    kvb = pick_kvb(KV, T, D, k_cache.dtype.itemsize, G)
     if kvb is None or T % 8 != 0:
         raise ValueError(
             f"decode_attention ineligible for KV={KV}, T={T}, D={D}, "
-            f"itemsize={k_cache.dtype.itemsize} (check decode_eligible "
-            f"before calling)")
+            f"G={G}, itemsize={k_cache.dtype.itemsize} (check "
+            f"decode_eligible before calling)")
     kernel = functools.partial(_decode_kernel, scale=scale, kvb=kvb)
     ok2 = ok.astype(jnp.int32).reshape(B, 1, T)
     return pl.pallas_call(
@@ -151,7 +160,5 @@ def decode_attention(q, k_cache, v_cache, ok, scale):
         out_specs=pl.BlockSpec((1, kvb, G, D), lambda b, k: (b, k, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, KV, G, D), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel")),
-        interpret=_interpret(),
+        **tpu_call_params("parallel", "parallel"),
     )(q, k_cache, v_cache, ok2)

@@ -23,16 +23,28 @@ Design (sized for the fine-tuning regime S ≤ ~2k, D ≤ 256):
     repeat_kv_heads, core/ops.cpp:2072);
   - causal + sliding-window + key-padding masks built from broadcasted
     iotas inside the kernel;
-  - backward is split FlashAttention-2 style into two kernels instead of
-    one serialized pass:
-      dQ:    grid (B, Hq, S/BQ), ALL dims parallel, same skipping k-loop
+  - backward has TWO implementations behind a selector (resolve_bwd_impl;
+    'auto' picks merged whenever its VMEM accounting fits, the split pair
+    remains the parity oracle and the large-shape fallback):
+      merged (default): ONE kernel, grid (B, Hq, S/BK) with only the
+             innermost key-block dim sequential. Each program owns one
+             [BK, D] key block, loops over the q-blocks that can see it
+             (causal: qi ≥ ki·BK/BQ; window: qi·BQ < ki·BK+BK+w), and
+             computes dK/dV *and* the dQ contributions from ONE
+             recomputation of (s, p, dp, ds) — the split pair recomputes
+             those twice (7 tile matmuls vs 5, ~29% of backward MXU
+             work), reads K/V/dO/LSE from HBM twice, and costs twice the
+             kernel launches (the S=1024 GPT-2s step runs 24 backward
+             launches split, 12 merged). dQ accumulates in an f32 VMEM
+             scratch slab across the sequential key-block steps and is
+             written once on the last step; GQA emits per-q-head dK/dV
+             partials that XLA group-sums (free when Hq == Hkv).
+      split (oracle):
+       dQ:   grid (B, Hq, S/BQ), ALL dims parallel, same skipping k-loop
              as the forward;
-      dK/dV: grid (B, S/BK, Hq) with only the innermost head dim
-             sequential (fully parallel when Hq == Hkv): each program owns
-             one [BK, D] key block, loops over the q-blocks that can see
-             it (causal: qi ≥ ki·BK/BQ; window: qi·BQ < ki·BK+BK+w), and
-             accumulates the G q-heads of its kv-head over consecutive
-             innermost steps;
+       dK/dV: grid (B, S/BK, Hq) with only the innermost head dim
+             sequential (fully parallel when Hq == Hkv); accumulates the
+             G q-heads of a kv-head over consecutive innermost steps.
     Δ = rowsum(dO ∘ O) is precomputed in XLA (one fused elementwise pass).
 
 For shapes the kernel doesn't support (S not a multiple of the block, tiny
@@ -51,7 +63,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from mobilefinetuner_tpu.ops.pallas_util import interpret_mode as _interpret
+from mobilefinetuner_tpu.ops.pallas_util import (interpret_mode as
+                                                 _interpret,
+                                                 tpu_call_params)
 
 NEG_INF = -1e30
 
@@ -241,9 +255,7 @@ def _fwd(q, k, v, padding_mask, seed, *, scale, causal, window, block_q,
             jax.ShapeDtypeStruct((B, Hq, S, D), q.dtype),
             jax.ShapeDtypeStruct((B, Hq, S, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel")),
-        interpret=_interpret(),
+        **tpu_call_params("parallel", "parallel", "parallel"),
     )(q, k, v, pad3, seed)
     return out, lse
 
@@ -303,6 +315,46 @@ def _dq_kernel(q_ref, k_ref, v_ref, pad_ref, seed_ref, lse_ref, delta_ref,
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
+def _q_block_bounds(col0, block_q, block_k, nQ, causal, window):
+    """[qlo, qhi) q-block range that can see key block at col0 — the
+    transpose of _kv_block_bounds, shared by the split dK/dV kernel and
+    the merged one-pass kernel so the visibility arithmetic cannot
+    drift between them."""
+    if causal:
+        qlo = col0 // block_q
+    else:
+        qlo = 0
+    if window is not None:
+        qhi = jnp.minimum(nQ, (col0 + block_k + window - 2) // block_q + 1)
+    else:
+        qhi = nQ
+    return qlo, qhi
+
+
+def _bwd_tile(qb, dob, lseb, deltab, k, v, pad, seed, b, h, row0, col0,
+              block_q, block_k, scale, causal, window, p_drop):
+    """One (q-block, k-block) backward tile — the single recomputation of
+    (s, p, dp, ds) both backward implementations share. Returns
+    (pv, ds): pv is the dropped+rescaled probs feeding dV (pvᵀ·dO), ds
+    feeds dK (dsᵀ·q) and dQ (ds·k)."""
+    s = jax.lax.dot_general(qb, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    mask = _block_mask(row0, col0, block_q, block_k, causal, window, pad)
+    p = jnp.where(mask, jnp.exp(s - lseb), 0.0)             # [BQ, BK]
+    dp = jax.lax.dot_general(dob, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    if p_drop > 0.0:
+        keep = _keep_mask(seed, b, h, row0, col0, block_q, block_k,
+                          p_drop)
+        inv_keep = 1.0 / (1.0 - p_drop)
+        pv = jnp.where(keep, p, 0.0) * inv_keep      # dropped+rescaled p̃
+        dp = jnp.where(keep, dp, 0.0) * inv_keep
+    else:
+        pv = p
+    ds = p * (dp - deltab) * scale                          # [BQ, BK]
+    return pv, ds
+
+
 def _dkv_kernel(q_ref, k_ref, v_ref, pad_ref, seed_ref, lse_ref, delta_ref,
                 do_ref, dk_ref, dv_ref, *, scale, block_q, block_k, causal,
                 window, S, G, p_drop):
@@ -314,44 +366,23 @@ def _dkv_kernel(q_ref, k_ref, v_ref, pad_ref, seed_ref, lse_ref, delta_ref,
     v = v_ref[0, 0].astype(jnp.float32)
     pad = pad_ref[0]                               # [1, BK]
     D = k.shape[-1]
-    nQ = S // block_q
     # q-blocks that can see this key block (transpose of the fwd bounds)
-    if causal:
-        qlo = col0 // block_q
-    else:
-        qlo = 0
-    if window is not None:
-        qhi = jnp.minimum(nQ, (col0 + block_k + window - 2) // block_q + 1)
-    else:
-        qhi = nQ
+    qlo, qhi = _q_block_bounds(col0, block_q, block_k, S // block_q,
+                               causal, window)
 
     def body(qi, carry):
         dk, dv = carry
         row0 = qi * block_q
         qb = q_ref[0, 0, pl.ds(row0, block_q), :].astype(jnp.float32)
         dob = do_ref[0, 0, pl.ds(row0, block_q), :].astype(jnp.float32)
-        lseb = lse_ref[0, 0, pl.ds(row0, block_q), :]
-        deltab = delta_ref[0, 0, pl.ds(row0, block_q), :]
-        s = jax.lax.dot_general(qb, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        mask = _block_mask(row0, col0, block_q, block_k, causal, window,
-                           pad)
-        p = jnp.where(mask, jnp.exp(s - lseb), 0.0)         # [BQ, BK]
-        if p_drop > 0.0:
-            keep = _keep_mask(seed_ref[0], b, h, row0, col0, block_q,
-                              block_k, p_drop)
-            inv_keep = 1.0 / (1.0 - p_drop)
-            pv = jnp.where(keep, p, 0.0) * inv_keep  # dropped+rescaled p̃
-        else:
-            pv = p
+        pv, ds = _bwd_tile(
+            qb, dob, lse_ref[0, 0, pl.ds(row0, block_q), :],
+            delta_ref[0, 0, pl.ds(row0, block_q), :], k, v, pad,
+            seed_ref[0], b, h, row0, col0, block_q, block_k, scale,
+            causal, window, p_drop)
         dv = dv + jax.lax.dot_general(
             pv, dob, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # [BK, D]
-        dp = jax.lax.dot_general(dob, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        if p_drop > 0.0:
-            dp = jnp.where(keep, dp, 0.0) * inv_keep
-        ds = p * (dp - deltab) * scale                      # [BQ, BK]
         dk = dk + jax.lax.dot_general(
             ds, qb, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -377,23 +408,178 @@ def _dkv_kernel(q_ref, k_ref, v_ref, pad_ref, seed_ref, lse_ref, delta_ref,
             dv_ref[0, 0] += dv
 
 
+def _dkvq_kernel(q_ref, k_ref, v_ref, pad_ref, seed_ref, lse_ref, delta_ref,
+                 do_ref, dq_ref, dk_ref, dv_ref, dq_acc, *, scale, block_q,
+                 block_k, causal, window, S, p_drop):
+    """Merged one-pass backward: dK, dV AND the dQ contributions of one
+    [BK, D] key block from a single recomputation of (s, p, dp, ds).
+
+    Grid (B, Hq, S/BK), key-block dim innermost and SEQUENTIAL: the dQ
+    slab for (b, h) accumulates in the f32 VMEM scratch `dq_acc` across
+    the consecutive key-block steps (zeroed at ki == 0, flushed to the
+    output in q.dtype at the last step), so dQ is read-modify-written in
+    VMEM only — never round-tripped through HBM per key block. dK/dV are
+    emitted per Q-HEAD ([B, Hq, S, D] partials); the GQA group-sum
+    happens in XLA outside (one fused reduction, a no-op when G == 1)
+    because the per-kv-head blocks would otherwise be revisited
+    non-consecutively across the outer head dim, which Pallas TPU output
+    residency does not allow."""
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    ki = pl.program_id(2)
+    col0 = ki * block_k
+    k = k_ref[0, 0].astype(jnp.float32)            # [BK, D]
+    v = v_ref[0, 0].astype(jnp.float32)
+    pad = pad_ref[0]                               # [1, BK]
+    D = k.shape[-1]
+    nK = S // block_k
+
+    @pl.when(ki == 0)
+    def _zero():
+        dq_acc[...] = jnp.zeros(dq_acc.shape, dq_acc.dtype)
+
+    qlo, qhi = _q_block_bounds(col0, block_q, block_k, S // block_q,
+                               causal, window)
+
+    def body(qi, carry):
+        dk, dv = carry
+        row0 = qi * block_q
+        qb = q_ref[0, 0, pl.ds(row0, block_q), :].astype(jnp.float32)
+        dob = do_ref[0, 0, pl.ds(row0, block_q), :].astype(jnp.float32)
+        pv, ds = _bwd_tile(
+            qb, dob, lse_ref[0, 0, pl.ds(row0, block_q), :],
+            delta_ref[0, 0, pl.ds(row0, block_q), :], k, v, pad,
+            seed_ref[0], b, h, row0, col0, block_q, block_k, scale,
+            causal, window, p_drop)
+        dv = dv + jax.lax.dot_general(
+            pv, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [BK, D]
+        dk = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # the pass the split pair duplicates: dQ rows reuse THIS tile's ds
+        dq_acc[pl.ds(row0, block_q), :] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros((block_k, D), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qlo, qhi, body, (z, z))
+    dk_ref[0, 0] = dk
+    dv_ref[0, 0] = dv
+
+    @pl.when(ki == nK - 1)
+    def _flush():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+_BWD_VMEM_BUDGET = 12 * 2 ** 20
+
+
+def merged_bwd_fits(S: int, D: int, block_k: int, itemsize: int) -> bool:
+    """VMEM accounting for one merged-backward program: whole-S q/dO
+    slabs + the f32 dQ accumulator + the dQ output block + lse/Δ rows
+    resident for the whole (b, h) sweep, plus double-buffered K/V input
+    and dK/dV output blocks."""
+    need = (2 * S * D * itemsize          # q + dO slabs
+            + S * D * 4                   # dq f32 scratch accumulator
+            + S * D * itemsize            # dq output block
+            + 2 * S * 4                   # lse + delta rows
+            + 2 * 2 * block_k * D * itemsize   # K/V blocks, double-buffered
+            + 2 * 2 * block_k * D * 4)    # dk/dv out blocks, double-buffered
+    return need <= _BWD_VMEM_BUDGET
+
+
+def resolve_bwd_impl(S: int, D: int, block_k: int, itemsize: int) -> str:
+    """The backward 'auto' rule, mirroring ops/attention.resolve_impl:
+    the merged one-pass kernel whenever its VMEM accounting fits — it
+    does for every bf16 training shape the forward dispatches today
+    (S ≤ 2048 at D ≤ 256) and for f32 up to S=2048 at D=64; f32
+    Gemma-shaped S=2048 D=256 slabs exceed the budget and take the split
+    FlashAttention-2 pair. Kept as ONE function so the vjp and the tests
+    force paths through the same gate."""
+    return "merged" if merged_bwd_fits(S, D, block_k, itemsize) else "split"
+
+
+def _bwd_merged(scale, causal, window, block_q, block_k, p_drop, q, k, v,
+                pad3, seed, lse, delta, do):
+    B, Hq, S, D = q.shape
+    Hkv = k.shape[1]
+    G = Hq // Hkv
+    kernel = functools.partial(
+        _dkvq_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal, window=window, S=S, p_drop=p_drop)
+    dq, dk_p, dv_p = pl.pallas_call(
+        kernel,
+        grid=(B, Hq, S // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i: (b, h // G, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i: (b, h // G, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k), lambda b, h, i: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, S, 1), lambda b, h, i: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, 1), lambda b, h, i: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, Hq, S, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hq, S, D), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((S, D), jnp.float32)],
+        **tpu_call_params("parallel", "parallel", "arbitrary"),
+    )(q, k, v, pad3, seed, lse, delta, do)
+    if G > 1:
+        dk = dk_p.reshape(B, Hkv, G, S, D).sum(axis=2)
+        dv = dv_p.reshape(B, Hkv, G, S, D).sum(axis=2)
+    else:
+        dk, dv = dk_p, dv_p
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype), None, None
+
+
 def _bwd(scale, causal, window, block_q, block_k, res, g, dlse=None,
-         p_drop=0.0):
+         p_drop=0.0, bwd_impl="auto"):
     q, k, v, padding_mask, seed, out, lse = res
     do = g
     B, Hq, S, D = q.shape
     Hkv = k.shape[1]
     G = Hq // Hkv
     pad3 = padding_mask.reshape(B, 1, S)
-    # Δ = rowsum(dO ∘ O): one fused XLA pass, shared by both kernels.
+    # Δ = rowsum(dO ∘ O): one fused XLA pass, shared by every kernel.
     # A joint (out, lse) cotangent (the ring-attention partials) folds in
     # exactly here: ∂lse/∂s_ij = p_ij, so ds_ij = p_ij(dO·v_j − Δ_i +
     # dlse_i) — i.e. Δ ← Δ − dlse, with dv untouched (∂lse/∂v = 0). The
-    # kernels themselves are unchanged.
+    # kernels themselves are unchanged, so the folding works identically
+    # for the merged and split backward implementations.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)
+
+    if bwd_impl == "auto":
+        bwd_impl = resolve_bwd_impl(S, D, block_k, q.dtype.itemsize)
+    if bwd_impl == "merged":
+        return _bwd_merged(scale, causal, window, block_q, block_k,
+                           p_drop, q, k, v, pad3, seed, lse, delta, do)
+    assert bwd_impl == "split", bwd_impl
 
     dq_kernel = functools.partial(
         _dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
@@ -424,9 +610,7 @@ def _bwd(scale, causal, window, block_q, block_k, res, g, dlse=None,
                                lambda b, h, i: (b, h, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, Hq, S, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel")),
-        interpret=_interpret(),
+        **tpu_call_params("parallel", "parallel", "parallel"),
     )(q, k, v, pad3, seed, lse, delta, do)
 
     dkv_kernel = functools.partial(
@@ -468,19 +652,17 @@ def _bwd(scale, causal, window, block_q, block_k, res, g, dlse=None,
             jax.ShapeDtypeStruct((B, Hkv, S, D), jnp.float32),
             jax.ShapeDtypeStruct((B, Hkv, S, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel",
-                                 "parallel" if G == 1 else "arbitrary")),
-        interpret=_interpret(),
+        **tpu_call_params("parallel", "parallel",
+                          "parallel" if G == 1 else "arbitrary"),
     )(q, k, v, pad3, seed, lse, delta, do)
     return dq, dk.astype(k.dtype), dv.astype(v.dtype), None, None
 
 
 # ------------------------------- public API ---------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
 def _flash(q, k, v, padding_mask, seed, scale, causal, window, block_q,
-           block_k, p_drop):
+           block_k, p_drop, bwd_impl):
     out, _ = _fwd(q, k, v, padding_mask, seed, scale=scale, causal=causal,
                   window=window, block_q=block_q, block_k=block_k,
                   p_drop=p_drop)
@@ -488,24 +670,25 @@ def _flash(q, k, v, padding_mask, seed, scale, causal, window, block_q,
 
 
 def _flash_fwd(q, k, v, padding_mask, seed, scale, causal, window, block_q,
-               block_k, p_drop):
+               block_k, p_drop, bwd_impl):
     out, lse = _fwd(q, k, v, padding_mask, seed, scale=scale, causal=causal,
                     window=window, block_q=block_q, block_k=block_k,
                     p_drop=p_drop)
     return out, (q, k, v, padding_mask, seed, out, lse)
 
 
-def _flash_bwd(scale, causal, window, block_q, block_k, p_drop, res, g):
+def _flash_bwd(scale, causal, window, block_q, block_k, p_drop, bwd_impl,
+               res, g):
     return _bwd(scale, causal, window, block_q, block_k, res, g,
-                p_drop=p_drop)
+                p_drop=p_drop, bwd_impl=bwd_impl)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash_lse(q, k, v, padding_mask, seed, scale, causal, window, block_q,
-               block_k):
+               block_k, bwd_impl):
     """(out, lse) with gradients through BOTH outputs — the online-softmax
     partial for ring attention's cross-device merge. No dropout: partials
     compose across devices, and dropout on a renormalized merge would
@@ -516,16 +699,17 @@ def _flash_lse(q, k, v, padding_mask, seed, scale, causal, window, block_q,
 
 
 def _flash_lse_fwd(q, k, v, padding_mask, seed, scale, causal, window,
-                   block_q, block_k):
+                   block_q, block_k, bwd_impl):
     out, lse = _fwd(q, k, v, padding_mask, seed, scale=scale, causal=causal,
                     window=window, block_q=block_q, block_k=block_k)
     return (out, lse), (q, k, v, padding_mask, seed, out, lse)
 
 
-def _flash_lse_bwd(scale, causal, window, block_q, block_k, res, g):
+def _flash_lse_bwd(scale, causal, window, block_q, block_k, bwd_impl, res,
+                   g):
     do, dlse = g
     return _bwd(scale, causal, window, block_q, block_k, res, do,
-                dlse=dlse)
+                dlse=dlse, bwd_impl=bwd_impl)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -542,7 +726,8 @@ def flash_attention_partial(q, k, v, padding_mask=None, *,
                             scale: Optional[float] = None,
                             is_causal: bool = True,
                             sliding_window: Optional[int] = None,
-                            block_q: int = 512, block_k: int = 512):
+                            block_q: int = 512, block_k: int = 512,
+                            bwd_impl: str = "auto"):
     """Partial-attention stats (out, lse) for online-softmax composition
     (parallel/ring_attention.py), or None when the shape is not
     kernel-eligible (caller falls back to its dense path).
@@ -554,6 +739,9 @@ def flash_attention_partial(q, k, v, padding_mask=None, *,
     values shift the band above the local diagonal; the block-bounds and
     mask arithmetic handle them as-is). Differentiable w.r.t. q/k/v
     through BOTH out and lse (see _bwd's Δ−dlse folding)."""
+    if bwd_impl not in ("auto", "merged", "split"):
+        raise ValueError(f"bwd_impl must be 'auto', 'merged' or 'split', "
+                         f"got {bwd_impl!r}")
     B, Hq, S, D = q.shape
     if D not in (64, 128, 256) or k.shape[2] != S:
         return None
@@ -573,7 +761,7 @@ def flash_attention_partial(q, k, v, padding_mask=None, *,
                       float(scale), bool(is_causal),
                       None if sliding_window is None
                       else int(sliding_window),
-                      int(block_q), int(block_k))
+                      int(block_q), int(block_k), str(bwd_impl))
 
 
 def flash_attention(q, k, v, *,
@@ -586,7 +774,8 @@ def flash_attention(q, k, v, *,
                     attn_dropout: float = 0.0,
                     attn_dropout_rng: Optional[jnp.ndarray] = None,
                     block_q: int = 512,
-                    block_k: int = 512) -> jnp.ndarray:
+                    block_k: int = 512,
+                    bwd_impl: str = "auto") -> jnp.ndarray:
     """Drop-in for ops.attention.dot_product_attention (same signature).
 
     attn_mask (a precomputed [S, S] matrix) has no blockwise structure the
@@ -603,6 +792,11 @@ def flash_attention(q, k, v, *,
     not per-mask — exactly like the reference's RNG vs ours. Dropout=0 or
     rng=None compiles the dropout-free kernels (p_drop is static).
 
+    bwd_impl selects the backward kernel implementation: 'auto' (the
+    merged one-pass dK/dV+dQ kernel whenever resolve_bwd_impl's VMEM
+    accounting admits it), 'merged', or 'split' (the FlashAttention-2
+    two-kernel pair — the parity oracle and large-shape fallback).
+
     Default blocks are 512×512 (clamped to S): measured on TPU v5e,
     large blocks amortize the k-loop — every smaller block combination
     swept at S <= 512 (r4: 256x512 down to 64x128) only added
@@ -612,6 +806,9 @@ def flash_attention(q, k, v, *,
     attention() 'auto' / resolve_impl).
     """
     from mobilefinetuner_tpu.ops.attention import dot_product_attention
+    if bwd_impl not in ("auto", "merged", "split"):
+        raise ValueError(f"bwd_impl must be 'auto', 'merged' or 'split', "
+                         f"got {bwd_impl!r}")
     B, Hq, S, D = q.shape
     # sliding_window implies causal in the oracle's mask semantics
     # (attention.causal_mask is always causal when a window is given);
@@ -647,4 +844,4 @@ def flash_attention(q, k, v, *,
         seed = jnp.zeros((1,), jnp.int32)
     return _flash(q, k, v, pad, seed, float(scale), bool(is_causal),
                   None if sliding_window is None else int(sliding_window),
-                  int(block_q), int(block_k), p_drop)
+                  int(block_q), int(block_k), p_drop, str(bwd_impl))

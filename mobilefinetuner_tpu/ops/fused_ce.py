@@ -52,7 +52,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-from mobilefinetuner_tpu.ops.pallas_util import interpret_mode as _interpret
+from mobilefinetuner_tpu.ops.pallas_util import tpu_call_params
 
 _VMEM_BUDGET = 14 * 2 ** 20   # leave headroom under the 16 MB scoped limit
 
@@ -161,9 +161,7 @@ def _fwd(h2, w, labels2):
             pltpu.VMEM((R, 1), jnp.float32),
             pltpu.VMEM((R, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
-        interpret=_interpret(),
+        **tpu_call_params("arbitrary"),
     )(h2, w, labels2)
     return lse[:, 0], gold[:, 0]
 
@@ -239,9 +237,7 @@ def _bwd_dh(h2, w, labels2, lse2, dlse2, dgold2):
         out_specs=pl.BlockSpec((R, H), row, memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((R, H), jnp.float32),
         scratch_shapes=[pltpu.VMEM((R, H), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
-        interpret=_interpret(),
+        **tpu_call_params("arbitrary"),
     )(h2, w, labels2, lse2, dlse2, dgold2)
 
 
@@ -267,9 +263,7 @@ def _bwd_dw(h2, w, labels2, lse2, dlse2, dgold2):
         out_specs=pl.BlockSpec((bv, H), lambda vi: (vi, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((V, H), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
-        interpret=_interpret(),
+        **tpu_call_params("arbitrary"),
     )(h2, w, labels2, lse2, dlse2, dgold2)
 
 

@@ -143,7 +143,7 @@ def _vp_chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks,
     own sequence slice, so the backward keeps the sequence sharded too.
     """
     from jax.sharding import PartitionSpec as P
-    shard_map = jax.shard_map
+    from mobilefinetuner_tpu.core.compat import shard_map
 
     if jnp.issubdtype(hidden.dtype, jnp.floating):
         lm_head_w = lm_head_w.astype(hidden.dtype)
@@ -195,6 +195,55 @@ def _vp_chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks,
         in_specs=(P(None, batch_axis, chunk_spec, None),
                   P(None, batch_axis, None), P(vocab_axis, None)),
         out_specs=(P(), P()), check_vma=False)(hs, ls, lm_head_w)
+
+
+def vp_embed_lookup(table, ids, mesh, *, vocab_axis: str = "fsdp",
+                    batch_axis: str = "data"):
+    """Sequence-parallel vocab-parallel embedding LOOKUP: the Megatron
+    front-end companion of _vp_chunked_nll_sum's head.
+
+    Under --sequence_parallel one mesh axis carries BOTH the sequence
+    shard of the activations and the vocab shard of the tied [V, H]
+    table. Left to itself, GSPMD's cost model resolves `table[ids]` by
+    ALL-GATHERING THE TABLE at large mesh sizes (observed at fsdp >= 16
+    in the pod dryrun; at fsdp=4 it happens to pick the sharded plan) —
+    re-materializing the 262k-row table per step, exactly the failure
+    the vocab-parallel CE exists to prevent. shard_map makes the sharded
+    plan structural: each device all-gathers the TINY int ids over the
+    axis, looks the full sequence up against its OWN table shard
+    (out-of-shard rows contribute zero), and the partial embeddings
+    psum_scatter straight back to the sequence shard — one [B, S, H]
+    reduce-scatter, the same bytes the SP activations already move, and
+    the full table never exists. Differentiable end to end: the
+    psum_scatter's transpose is an all-gather and the masked take's is a
+    scatter-add into the local shard, so the trainable tied embed (full
+    FT) gets exact vocab-sharded gradients.
+
+    ids: [B, S] int, sequence-sharded over `vocab_axis` (batch over
+    `batch_axis` when present); table: [V, H] V-sharded. V and S must
+    divide by the axis size (the caller gates). Returns [B, S, H] in the
+    table's dtype, sharded like the SP activations."""
+    from jax.sharding import PartitionSpec as P
+    from mobilefinetuner_tpu.core.compat import shard_map
+    ba = batch_axis if batch_axis in mesh.axis_names else None
+
+    def local(w, ids_loc):
+        vloc = w.shape[0]
+        start = jax.lax.axis_index(vocab_axis) * vloc
+        ids_full = jax.lax.all_gather(ids_loc, vocab_axis, axis=1,
+                                      tiled=True)          # [B_loc, S]
+        loc = ids_full - start
+        in_shard = (loc >= 0) & (loc < vloc)
+        safe = jnp.clip(loc, 0, vloc - 1)
+        e = jnp.take(w, safe, axis=0)                      # [B_loc, S, H]
+        e = jnp.where(in_shard[..., None], e, 0)
+        return jax.lax.psum_scatter(e, vocab_axis, scatter_dimension=1,
+                                    tiled=True)            # [B_loc, S/n, H]
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(vocab_axis, None), P(ba, vocab_axis)),
+        out_specs=P(ba, vocab_axis, None), check_vma=False)(table, ids)
 
 
 def _use_fused_ce(use_fused_kernel, R, V, H, itemsize=2) -> bool:

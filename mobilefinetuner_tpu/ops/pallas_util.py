@@ -10,3 +10,19 @@ def interpret_mode() -> bool:
     """Pallas interpret mode off-TPU (CPU test mesh, SURVEY.md §4.6) —
     the ONE copy of the policy every kernel consults."""
     return jax.default_backend() != "tpu"
+
+
+def tpu_call_params(*dimension_semantics: str) -> dict:
+    """The compiler_params + interpret kwargs every pallas_call in this
+    tree passes — one copy of the dimension-semantics plumbing so a
+    kernel cannot set semantics without also consulting the interpret
+    policy (and one copy of the CompilerParams/TPUCompilerParams rename
+    shim across jax versions). Returns a dict to splat into
+    pl.pallas_call."""
+    from jax.experimental.pallas import tpu as pltpu
+    params_cls = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+    return dict(
+        compiler_params=params_cls(
+            dimension_semantics=dimension_semantics),
+        interpret=interpret_mode())

@@ -136,7 +136,7 @@ def save_state(path: str, state: dict, config: AdamConfig):
     (Adam::save analog, adam.cpp:103+)."""
     from mobilefinetuner_tpu.io.safetensors_io import save_safetensors
     flat = {}
-    leaves, _ = jax.tree.flatten_with_path(state)
+    leaves, _ = jax.tree_util.tree_flatten_with_path(state)
     for path_keys, leaf in leaves:
         key = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
                        for k in path_keys)
@@ -151,7 +151,7 @@ def load_state(path: str, state_template: dict) -> Tuple[dict, AdamConfig]:
     from mobilefinetuner_tpu.io.safetensors_io import SafeTensorsReader
     reader = SafeTensorsReader(path)
     raw = reader.load_all()
-    leaves, treedef = jax.tree.flatten_with_path(state_template)
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(state_template)
     out = []
     for path_keys, leaf in leaves:
         key = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))

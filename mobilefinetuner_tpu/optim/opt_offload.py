@@ -124,12 +124,14 @@ def _shardings(device=None):
     tier is device memory too: CPU jit drops host memory kinds on
     outputs, which breaks AOT re-calls (compiled-for-host inputs vs
     device-kind state coming back) — and host==device there anyway, so
-    the fallback changes placement, not semantics. Tests exercise the
-    full numerics on CPU; the actual pinned-host tier runs on TPU."""
+    the fallback changes placement, not semantics. The memory-kind NAMES
+    come from parallel/offload.host_kind/device_kind (the one copy of
+    the jax kind-name skew). Tests exercise the full numerics on CPU;
+    the actual pinned-host tier runs on TPU."""
+    from mobilefinetuner_tpu.parallel.offload import device_kind, host_kind
     device = device or jax.devices()[0]
-    host_kind = "device" if device.platform == "cpu" else "pinned_host"
-    return (SingleDeviceSharding(device, memory_kind="device"),
-            SingleDeviceSharding(device, memory_kind=host_kind))
+    return (SingleDeviceSharding(device, memory_kind=device_kind()),
+            SingleDeviceSharding(device, memory_kind=host_kind()))
 
 
 def init_opt_offload(params, plan, compute_dtype=jnp.bfloat16, device=None,
@@ -217,7 +219,16 @@ def resume_opt_sidecar(path: str, opt_state):
     for path_keys, leaf in jax.tree_util.tree_flatten_with_path(sub)[0]:
         key = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
                        for k in path_keys)
-        stored = reader.shape_dtype(key)[1]
+        try:
+            stored = reader.shape_dtype(key)[1]
+        except KeyError:
+            raise ValueError(
+                f"opt sidecar {path} is missing tensor {key!r}: the "
+                f"sidecar was written under a different offload "
+                f"layout/plan than this run's template (it holds "
+                f"{len(reader.keys())} tensors) — resume with the "
+                f"flags/model the sidecar was saved with, or start "
+                f"fresh optimizer state") from None
         if st_dtypes.get(stored, None) != leaf.dtype:
             raise ValueError(
                 f"opt sidecar dtype mismatch at {key}: stored {stored}, "
@@ -228,6 +239,30 @@ def resume_opt_sidecar(path: str, opt_state):
     placed = jax.tree.map(lambda x, t: jax.device_put(x, t.sharding),
                           loaded, sub)
     return dict(opt_state, **placed)
+
+
+def _lowbias32(x):
+    """lowbias32 uint32 mix (the same constants as _sr_bfloat16's
+    per-element scramble and the flash kernel's dropout hash)."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _sr_salt(step_no, leaf_idx: int):
+    """uint32 SR salt base for (step, leaf); stream_leaf adds the chunk
+    index. The step counter is MIXED through lowbias32 rather than
+    multiplied by 2**20: the old int32 product wrapped with period 4096
+    steps (2**32 / 2**20), silently repeating every element's rounding
+    draw from step s at step s + 4096. Hashing decorrelates all 32 bits
+    of the step, so no two steps in an int32 counter's range share a
+    salt; 1009 (prime) keeps the per-leaf offsets disjoint from the
+    chunk increments for any realistic chunk count."""
+    return _lowbias32(step_no.astype(jnp.uint32)) \
+        + jnp.uint32(leaf_idx * 1009)
 
 
 def _sr_bfloat16(x, salt):
@@ -242,12 +277,7 @@ def _sr_bfloat16(x, salt):
     hardware/interpret agree exactly."""
     bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
     idx = jnp.arange(x.size, dtype=jnp.uint32).reshape(x.shape)
-    z = idx * jnp.uint32(0x9E3779B9) ^ salt.astype(jnp.uint32)
-    z = z ^ (z >> 16)
-    z = z * jnp.uint32(0x7FEB352D)
-    z = z ^ (z >> 15)
-    z = z * jnp.uint32(0x846CA68B)
-    z = z ^ (z >> 16)
+    z = _lowbias32(idx * jnp.uint32(0x9E3779B9) ^ salt.astype(jnp.uint32))
     q = bits + (z & jnp.uint32(0xFFFF))
     out = jax.lax.bitcast_convert_type(
         (q >> 16).astype(jnp.uint16), jnp.bfloat16)
@@ -321,7 +351,7 @@ def make_offload_train_step(loss_fn, train_cfg, plan,
                 v = v * v
             w2, m2, v2 = adam_math(w, sl(g_st), m, v, lr, bc1, bc2)
             if m_dt == jnp.bfloat16:
-                w2 = _sr_bfloat16(w2, salt0 + i)
+                w2 = _sr_bfloat16(w2, salt0 + i.astype(jnp.uint32))
             v_store = jnp.sqrt(v2) if sqrt_v else v2
             up = lambda t, x: jax.lax.dynamic_update_index_in_dim(
                 t, jax.device_put(x.astype(t.dtype), host_sh), i, 0)
@@ -379,9 +409,9 @@ def make_offload_train_step(loss_fn, train_cfg, plan,
                                                  leaves_c)):
             if c:
                 # SR salt: unique per (step, leaf, chunk) — chunk is
-                # added inside stream_leaf; 1009 (prime) * max chunks
-                # keeps leaf ranges disjoint for any realistic C
-                salt0 = step_no * jnp.int32(2 ** 20) + jnp.int32(li * 1009)
+                # added inside stream_leaf; uint32 throughout, step mixed
+                # via lowbias32 (_sr_salt has the period-4096 story)
+                salt0 = _sr_salt(step_no, li)
                 w2, m2, v2, bf = stream_leaf(g, w, m, v, lr, bc1, bc2,
                                              salt0)
             else:

@@ -84,6 +84,23 @@ HOST = "pinned_host"
 DEVICE = "device"
 
 
+def host_kind() -> str:
+    """The host-tier memory kind on the CURRENT backend: "pinned_host"
+    on TPU; the CPU backend's sole memory otherwise (its NAME varies
+    across jax versions — "device" vs "unpinned_host" — so it is read
+    off the device rather than assumed). Resolved lazily: resolving at
+    import would initialize the backend before force_host_devices can
+    set the virtual device count."""
+    d = jax.devices()[0]
+    return HOST if d.platform != "cpu" else d.default_memory().kind
+
+
+def device_kind() -> str:
+    """Device-tier memory kind on the current backend (see host_kind)."""
+    d = jax.devices()[0]
+    return DEVICE if d.platform != "cpu" else d.default_memory().kind
+
+
 @dataclasses.dataclass
 class OffloadConfig:
     """Analog of ShardConfig (parameter_sharder.h:37-41)."""
@@ -223,7 +240,7 @@ def apply_placement(params, plan, shardings, config: OffloadConfig):
         x = jnp.asarray(x)
         if off:
             return device_put_global(x.astype(od),
-                                     sh.with_memory_kind(HOST))
+                                     sh.with_memory_kind(host_kind()))
         return device_put_global(x, sh)
 
     return jax.tree.map(place, params, plan, shardings)
@@ -239,7 +256,7 @@ def fetch(params, plan, shardings, compute_dtype=None):
 
     def pull(x, off, sh):
         if off:
-            x = jax.device_put(x, sh.with_memory_kind(DEVICE))
+            x = jax.device_put(x, sh.with_memory_kind(device_kind()))
         if compute_dtype is not None and jnp.issubdtype(x.dtype,
                                                         jnp.floating):
             x = x.astype(compute_dtype)
@@ -257,8 +274,8 @@ def _slice_sharding(sh):
     if isinstance(sh, NamedSharding):
         rest = tuple(sh.spec)[1:]
         return NamedSharding(sh.mesh, PartitionSpec(*rest),
-                             memory_kind=DEVICE)
-    return sh.with_memory_kind(DEVICE)
+                             memory_kind=device_kind())
+    return sh.with_memory_kind(device_kind())
 
 
 def fetch_layer(blocks, plan, i, shardings, compute_dtype=None):

@@ -82,7 +82,7 @@ def _ring_hops(n: int, window, Sq: int) -> int:
     return min(n - 1, max(0, (int(window) - 2) // Sq + 1))
 
 
-def _ring_shard_flash(q, k, v, pad, *, axis, scale, window):
+def _ring_shard_flash(q, k, v, pad, *, axis, n, scale, window):
     """Flash-kernel ring body: per-device memory is O(Sq·D) — scores only
     ever exist blockwise in VMEM (ops/flash_attention.py), never as a
     [.., Sq, Sk] tensor in HBM. The hop loop is unrolled so each hop's
@@ -93,11 +93,17 @@ def _ring_shard_flash(q, k, v, pad, *, axis, scale, window):
     future tokens: computed in lockstep (SPMD — skipping wouldn't free the
     step) and merged with weight 0 via an lse of NEG_INF. Gradients flow
     through both out and lse of every partial (flash_attention_partial's
-    joint custom_vjp), so reverse-mode AD of the merge tree is exact."""
+    joint custom_vjp), so reverse-mode AD of the merge tree is exact —
+    and each partial's backward dispatches through the same
+    resolve_bwd_impl selector as the plain kernel, so a kernel-eligible
+    ring shard runs the merged one-pass dK/dV+dQ kernel per hop (half
+    the backward launches per rotation; the dlse cotangent folds into Δ
+    before the kernel, identically for either backward impl)."""
     from mobilefinetuner_tpu.ops.flash_attention import \
         flash_attention_partial
 
-    n = jax.lax.axis_size(axis)
+    # n arrives STATIC from the caller (mesh.shape[axis]): the hop loop
+    # is unrolled over it, so it cannot be a traced axis_size
     idx = jax.lax.axis_index(axis)
     B, Hq, Sq, D = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -123,10 +129,10 @@ def _ring_shard_flash(q, k, v, pad, *, axis, scale, window):
     return out.astype(q.dtype)
 
 
-def _ring_shard(q, k, v, pad, *, axis, scale, causal, window):
+def _ring_shard(q, k, v, pad, *, axis, n, scale, causal, window):
     """Runs on each device inside shard_map: local Q stays, K/V/pad
-    rotate; online-softmax merge across the n ring steps."""
-    n = jax.lax.axis_size(axis)
+    rotate; online-softmax merge across the n (static, from mesh.shape)
+    ring steps."""
     idx = jax.lax.axis_index(axis)
     B, Hq, Sq, D = q.shape
     Hkv = k.shape[1]
@@ -200,12 +206,13 @@ def ring_attention(q, k, v, mesh: Mesh, *,
         flash_partial_eligible
     Sq = S // mesh.shape[axis]
     if is_causal and flash_partial_eligible(Sq, D):
-        fn = partial(_ring_shard_flash, axis=axis, scale=float(scale),
-                     window=window)
+        fn = partial(_ring_shard_flash, axis=axis, n=mesh.shape[axis],
+                     scale=float(scale), window=window)
     else:
-        fn = partial(_ring_shard, axis=axis, scale=float(scale),
-                     causal=is_causal, window=window)
-    return jax.shard_map(
+        fn = partial(_ring_shard, axis=axis, n=mesh.shape[axis],
+                     scale=float(scale), causal=is_causal, window=window)
+    from mobilefinetuner_tpu.core.compat import shard_map
+    return shard_map(
         fn, mesh=mesh,
         in_specs=(spec_s, spec_s, spec_s, spec_p),
         out_specs=spec_s,

@@ -83,3 +83,25 @@ def test_eligibility_gates():
     # a long-cache shape falls back to fewer kv heads per program
     kvb = pick_kvb(12, 8192, 64, 4)
     assert kvb is not None and kvb < 12 and 12 % kvb == 0
+
+
+def test_vmem_gate_charges_gqa_terms():
+    """Regression for the G-blind budget: the old estimate charged only
+    the K/V blocks plus a flat T*D*4 term, so a large-G GQA shape whose
+    [KVB, G, D] q/ctx blocks and [G, T] score rows dominate VMEM passed
+    the gate and would overflow at runtime. The gate must now count
+    kvb*G*D*(itemsize+4) and G*T*4."""
+    # KV=1, T=8192, D=64, bf16: K/V terms alone need ~6.3 MB — admitted
+    # with or without a moderate G...
+    assert pick_kvb(1, 8192, 64, 2) == 1
+    assert pick_kvb(1, 8192, 64, 2, G=8) == 1
+    # ...but at G=256 the [G, T] f32 score rows alone add 8 MB: the OLD
+    # G-blind estimate still said kvb=1 (it cannot subdivide KV=1 and
+    # charged nothing for G); the tightened gate must refuse.
+    assert pick_kvb(1, 8192, 64, 2, G=256) is None
+    assert not decode_eligible(1, 8192, 64, 2, G=256)
+    # G must also shrink the picked block when KV is divisible: the
+    # per-program q/ctx blocks scale with kvb*G
+    big = pick_kvb(16, 2048, 256, 2)
+    small = pick_kvb(16, 2048, 256, 2, G=64)
+    assert big is not None and small is not None and small <= big

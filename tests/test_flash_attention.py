@@ -96,6 +96,7 @@ def test_forward_with_padding_mask():
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("bwd_impl", ["merged", "split"])
 @pytest.mark.parametrize("case", [dict(), dict(sliding_window=32),
                                   dict(Hkv=1),
                                   # 64-blocks: exercise the qi>0 offsets,
@@ -104,13 +105,13 @@ def test_forward_with_padding_mask():
                                   dict(S=256, Hkv=2, block=64),
                                   dict(S=256, Hkv=1, sliding_window=64,
                                        block=64)])
-def test_gradients_match_oracle(case):
+def test_gradients_match_oracle(case, bwd_impl):
     case = dict(case)
     kw = {k: case.pop(k) for k in ("sliding_window",) if k in case}
-    bkw = {}
+    bkw = {"bwd_impl": bwd_impl}
     if "block" in case:
         b = case.pop("block")
-        bkw = dict(block_q=b, block_k=b)
+        bkw.update(block_q=b, block_k=b)
     q, k, v = make_qkv(jax.random.PRNGKey(2), **case)
 
     def loss(fn, q, k, v):
@@ -127,7 +128,8 @@ def test_gradients_match_oracle(case):
                                    atol=5e-5, rtol=5e-5, err_msg=name)
 
 
-def test_gradients_with_padding_mask():
+@pytest.mark.parametrize("bwd_impl", ["merged", "split"])
+def test_gradients_with_padding_mask(bwd_impl):
     q, k, v = make_qkv(jax.random.PRNGKey(3))
     B, S = q.shape[0], q.shape[2]
     pad = np.ones((B, S), np.float32)
@@ -136,7 +138,8 @@ def test_gradients_with_padding_mask():
     valid = pad.astype(bool)[:, None, :, None]
 
     def loss(fn, q, k, v):
-        kw = {"block_q": 64, "block_k": 64} if fn is flash_attention else {}
+        kw = {"block_q": 64, "block_k": 64, "bwd_impl": bwd_impl} \
+            if fn is flash_attention else {}
         out = fn(q, k, v, is_causal=True, padding_mask=pad, **kw)
         return jnp.sum(jnp.where(valid, out, 0.0) ** 2)
 
@@ -147,6 +150,76 @@ def test_gradients_with_padding_mask():
     for a, b, name in zip(g_ours, g_ref, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-5, rtol=5e-5, err_msg=name)
+
+
+def test_merged_backward_equals_split_exactly():
+    """The merged one-pass kernel and the split pair must agree to
+    float-exact tolerance (same tile math, same per-tile recomputation)
+    — tighter than the oracle comparison — across GQA + window +
+    multi-block, asymmetric blocks, and a whole-S static-block shape."""
+    for case, kw in [(dict(S=256, Hkv=1), dict(block_q=64, block_k=64,
+                                               sliding_window=96)),
+                     (dict(S=256, Hkv=2), dict(block_q=64, block_k=128)),
+                     (dict(S=192, Hkv=2), {})]:  # whole-S static block
+        q, k, v = make_qkv(jax.random.PRNGKey(8), **case)
+
+        def loss(bwd_impl, q, k, v):
+            out = flash_attention(q, k, v, is_causal=True,
+                                  bwd_impl=bwd_impl, **kw)
+            return jnp.sum(out * jnp.cos(out))
+
+        g_m = jax.grad(functools.partial(loss, "merged"),
+                       argnums=(0, 1, 2))(q, k, v)
+        g_s = jax.grad(functools.partial(loss, "split"),
+                       argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g_m, g_s, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, rtol=1e-6,
+                                       err_msg=f"{case} {kw} {name}")
+
+
+def test_partial_joint_vjp_merged_equals_split():
+    """The ring-attention contract: flash_attention_partial's custom_vjp
+    carries cotangents through BOTH (out, lse) — including the non-causal
+    negative-band windows ring hops use — and the merged backward must
+    reproduce the split pair's gradients exactly (the dlse cotangent
+    folds into Δ before either kernel runs)."""
+    from mobilefinetuner_tpu.ops.flash_attention import \
+        flash_attention_partial
+    q, k, v = make_qkv(jax.random.PRNGKey(9), S=256, Hkv=1)
+
+    for causal, window in [(True, None), (True, 96), (False, -32)]:
+        def loss(bwd_impl, q, k, v):
+            out, lse = flash_attention_partial(
+                q, k, v, is_causal=causal, sliding_window=window,
+                block_q=64, block_k=64, bwd_impl=bwd_impl)
+            return jnp.sum(out * jnp.sin(out)) + jnp.sum(jnp.tanh(lse))
+
+        g_m = jax.grad(functools.partial(loss, "merged"),
+                       argnums=(0, 1, 2))(q, k, v)
+        g_s = jax.grad(functools.partial(loss, "split"),
+                       argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g_m, g_s, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, rtol=1e-6,
+                                       err_msg=f"{causal}/{window} {name}")
+
+
+def test_resolve_bwd_impl_gate():
+    """'auto' must pick merged for every shape the forward dispatches
+    today, and fall back to split when the whole-S q/dO/dQ slabs cannot
+    fit the VMEM accounting."""
+    from mobilefinetuner_tpu.ops.flash_attention import (merged_bwd_fits,
+                                                         resolve_bwd_impl)
+    # the bench shapes: GPT-2 D=64 and Gemma D=256, bf16
+    assert resolve_bwd_impl(512, 64, 512, 2) == "merged"
+    assert resolve_bwd_impl(1024, 64, 512, 2) == "merged"
+    assert resolve_bwd_impl(2048, 64, 512, 2) == "merged"
+    assert resolve_bwd_impl(2048, 256, 512, 2) == "merged"
+    # f32 at the largest Gemma shape exceeds the budget -> split
+    assert resolve_bwd_impl(2048, 256, 512, 4) == "split"
+    assert resolve_bwd_impl(8192, 256, 512, 4) == "split"
+    assert not merged_bwd_fits(8192, 256, 512, 4)
 
 
 def test_unsupported_shapes_fall_back():
@@ -295,10 +368,11 @@ def test_dropout_forward_matches_hash_oracle():
                                        err_msg=f"p={p_drop} w={window}")
 
 
-def test_dropout_gradients_match_hash_oracle():
+@pytest.mark.parametrize("bwd_impl", ["merged", "split"])
+def test_dropout_gradients_match_hash_oracle(bwd_impl):
     """Backward with dropout: dq/dk/dv vs jax.grad of the dense
-    same-mask oracle — the dq and dkv kernels must regenerate the exact
-    forward mask."""
+    same-mask oracle — BOTH backward implementations must regenerate the
+    exact forward mask."""
     q, k, v = make_qkv(jax.random.PRNGKey(1), B=1, Hq=2, Hkv=1, S=128,
                        D=64)
     rng = jax.random.PRNGKey(7)
@@ -309,7 +383,7 @@ def test_dropout_gradients_match_hash_oracle():
     def loss_kernel(q, k, v):
         out = flash_attention(q, k, v, attn_dropout=p_drop,
                               attn_dropout_rng=rng, block_q=64,
-                              block_k=64)
+                              block_k=64, bwd_impl=bwd_impl)
         return jnp.sum(out * jnp.cos(out))
 
     def loss_ref(q, k, v):

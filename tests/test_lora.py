@@ -78,7 +78,7 @@ def test_adapter_roundtrip(tmp_path, setup):
 def test_trainable_mask_excludes_scale(setup):
     _, spec, lora, _ = setup
     mask = trainable_mask(lora)
-    flat = jax.tree.flatten_with_path(mask)[0]
+    flat = jax.tree_util.tree_flatten_with_path(mask)[0]
     for path, val in flat:
         is_scale = getattr(path[-1], "key", None) == "scale"
         assert val != is_scale

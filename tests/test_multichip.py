@@ -294,21 +294,57 @@ def test_gemma_sp_vocab_parallel_ce_compose(mesh):
         for s in range(3):
             l2, o2, m = sp_step(l2, params, o2, sp_batch, jnp.int32(s))
             losses.append(float(m["loss"]))
-        # (b) batch-parallel mesh step on the same data agrees
+        # (b) batch-parallel mesh steps on the same data agree — run TWO
+        # so the post-step-1 loss compares as well: step 1's loss is
+        # evaluated at weights produced by step 0's GRADIENT, so any
+        # SP/BP divergence in the seq-shard all-gather backward (the
+        # psum_scatter transpose) shows up here, not just in the
+        # forward-only step-0 number.
         bp_step = make_train_step(
             functools.partial(loss_fn, ce_mesh=mesh, cp_mesh=None,
                               sp=False), tc, mask=mask, donate=False)
-        _, _, bp_m = bp_step(lora, params, opt, shard_batch(batch_h, mesh),
-                             jnp.int32(0))
+        bp_losses = []
+        bl, bo = lora, opt
+        for s in range(2):
+            bl, bo, bp_m = bp_step(bl, params, bo,
+                                   shard_batch(batch_h, mesh),
+                                   jnp.int32(s))
+            bp_losses.append(float(bp_m["loss"]))
     # unsharded oracle (sum/count contract)
     s_ref, c_ref = jax.jit(lambda l, p, mb: loss_fn(
         l, p, mb, ce_mesh=None, cp_mesh=None, sp=False))(
         lora_h, params_h, batch_h)
     oracle = float(s_ref) / float(c_ref)
     assert losses[0] == pytest.approx(oracle, rel=1e-4)
-    assert losses[0] == pytest.approx(float(bp_m["loss"]), rel=1e-4)
+    assert losses[0] == pytest.approx(bp_losses[0], rel=1e-4)
+    # post-step-1 agreement pins the SP backward path
+    assert losses[1] == pytest.approx(bp_losses[1], rel=1e-4)
     # (c) trains
     assert losses[-1] < losses[0], losses
+
+
+def test_vp_embed_lookup_matches_plain_lookup(mesh):
+    """The Megatron-style sequence-parallel embedding lookup
+    (ops/loss.vp_embed_lookup — all-gather the tiny ids, local-shard
+    masked take, psum_scatter back to the sequence shard) must equal the
+    plain table[ids] in values AND in the table's gradient (the full-FT
+    tied-embed path), without ever materializing the table."""
+    from mobilefinetuner_tpu.ops.loss import vp_embed_lookup
+    V, H, B, S = 64, 16, 4, 32
+    table = jax.random.normal(jax.random.PRNGKey(0), (V, H), jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, V)
+
+    got = jax.jit(lambda t, i: vp_embed_lookup(t, i, mesh))(table, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(table[ids]),
+                               atol=1e-6, rtol=1e-6)
+
+    # gradient w.r.t. the (trainable, V-sharded) table: scatter-add parity
+    cot = jax.random.normal(jax.random.PRNGKey(2), (B, S, H), jnp.float32)
+    g_vp = jax.grad(lambda t: jnp.sum(
+        vp_embed_lookup(t, ids, mesh) * cot))(table)
+    g_ref = jax.grad(lambda t: jnp.sum(t[ids] * cot))(table)
+    np.testing.assert_allclose(np.asarray(g_vp), np.asarray(g_ref),
+                               atol=1e-5, rtol=1e-5)
 
 
 def test_gemma_sp_chunk_misalignment_falls_back_loudly(mesh):

@@ -11,6 +11,7 @@ import pytest
 from mobilefinetuner_tpu.parallel.mesh import (make_mesh, params_shardings,
                                                replicated_sharding)
 from mobilefinetuner_tpu.parallel.offload import (HOST, OffloadConfig,
+                                                  host_kind,
                                                   apply_placement, fetch,
                                                   placement_stats,
                                                   plan_placement)
@@ -68,7 +69,7 @@ def test_round_trip_values_preserved_f32():
     # offloaded leaves actually live in host memory
     for x, off in zip(jax.tree.leaves(placed), jax.tree.leaves(plan)):
         if off:
-            assert x.sharding.memory_kind == HOST
+            assert x.sharding.memory_kind == host_kind()
     back = fetch(placed, plan, sh)
     for k in t:
         np.testing.assert_array_equal(np.asarray(back[k]), np.asarray(t[k]))
@@ -221,7 +222,8 @@ def test_fetch_layer_drops_leading_axis_of_fsdp_spec():
     from mobilefinetuner_tpu.parallel.offload import fetch_layer
     mesh = make_mesh(data=1, fsdp=4, devices=jax.devices()[:4])
     stack = jnp.arange(6 * 256 * 8, dtype=jnp.float32).reshape(6, 256, 8)
-    sh = NamedSharding(mesh, P(None, "fsdp", None), memory_kind=HOST)
+    sh = NamedSharding(mesh, P(None, "fsdp", None),
+                       memory_kind=host_kind())
     t = {"w": jax.device_put(stack, sh)}
     plan = {"w": True}
     shardings = {"w": sh}
@@ -247,7 +249,7 @@ def test_offload_composes_with_fsdp_mesh():
                         offload_dtype="float32", min_offload_size=1024)
     plan = plan_placement(t, cfg)
     placed = apply_placement(t, plan, shardings, cfg)
-    assert placed["w"].sharding.memory_kind == HOST
+    assert placed["w"].sharding.memory_kind == host_kind()
     assert not placed["w"].sharding.is_fully_replicated  # still FSDP-sharded
     back = fetch(placed, plan, shardings)
     np.testing.assert_array_equal(np.asarray(back["w"]), np.ones((256, 64)))

@@ -119,16 +119,20 @@ def test_streamed_state_lives_on_host():
     spec = OptOffloadSpec(min_stream_bytes=1 << 10, chunk_bytes=1 << 12)
     plan = plan_opt_offload(params, spec)
     compute, opt = init_opt_offload(params, plan)
-    # on the CPU test backend the host tier falls back to device memory
-    # (see _shardings); on TPU this is "pinned_host"
-    host_kind = "device" if jax.devices()[0].platform == "cpu" \
-        else "pinned_host"
+    # on the CPU test backend the host tier falls back to the backend's
+    # sole memory (its NAME varies across jax versions — see _shardings);
+    # on TPU this is "pinned_host" vs "device"
+    d = jax.devices()[0]
+    if d.platform == "cpu":
+        host_kind = device_kind = d.default_memory().kind
+    else:
+        host_kind, device_kind = "pinned_host", "device"
     assert opt["master"]["embed"].sharding.memory_kind == host_kind
     assert opt["v"]["blocks"]["mlp"]["gate_w"].sharding.memory_kind == \
         host_kind
-    assert opt["master"]["final_norm"].sharding.memory_kind == "device"
+    assert opt["master"]["final_norm"].sharding.memory_kind == device_kind
     assert compute["embed"].dtype == jnp.bfloat16
-    assert compute["embed"].sharding.memory_kind == "device"
+    assert compute["embed"].sharding.memory_kind == device_kind
 
 
 def test_bf16_compute_trains_and_loss_decreases():
@@ -312,6 +316,56 @@ def test_resume_rejects_spec_mismatch():
         _, opt16 = init_opt_offload(params, plan, spec=SPEC16)
         with pytest.raises(ValueError, match="dtype mismatch"):
             resume_opt_sidecar(path, opt16)
+
+
+def test_resume_missing_key_raises_informative_error():
+    """A sidecar from an older/different offload layout (missing a
+    template leaf) must raise a ValueError NAMING the missing tensor,
+    not a bare KeyError from the safetensors reader."""
+    from mobilefinetuner_tpu.io.safetensors_io import (SafeTensorsReader,
+                                                       save_safetensors)
+    from mobilefinetuner_tpu.optim.opt_offload import (resume_opt_sidecar,
+                                                       save_opt_sidecar)
+    import tempfile, os
+    params, batch = make_problem(seed=7)
+    tc = TrainConfig(total_steps=2, lr=1e-3, schedule="constant",
+                     warmup_ratio=0.0)
+    spec = OptOffloadSpec(min_stream_bytes=1 << 10, chunk_bytes=1 << 12)
+    plan = plan_opt_offload(params, spec)
+    compute, opt = init_opt_offload(params, plan, spec=spec)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "ck.opt")
+        save_opt_sidecar(path, opt, tc.adam())
+        # truncate: rewrite the sidecar without one m-leaf
+        reader = SafeTensorsReader(path)
+        kept = {k: reader.load(k) for k in reader.keys()
+                if k != "m/embed"}
+        assert len(kept) == len(reader.keys()) - 1  # the leaf existed
+        trunc = os.path.join(d, "trunc.opt")
+        save_safetensors(trunc, kept, metadata=reader.metadata)
+        with pytest.raises(ValueError, match="m/embed"):
+            resume_opt_sidecar(trunc, opt)
+
+
+def test_sr_salt_has_no_4096_step_period():
+    """Regression for the int32 salt overflow: the old
+    step_no * 2**20 product wrapped mod 2**32, so steps s and s + 4096
+    shared every per-element rounding draw. The lowbias32-mixed uint32
+    salt must differ across 0/2048/4096 (and the draws with it)."""
+    from mobilefinetuner_tpu.optim.opt_offload import (_sr_bfloat16,
+                                                       _sr_salt)
+    salts = {s: int(_sr_salt(jnp.int32(s), 0)) for s in
+             (0, 2048, 4096, 8192)}
+    assert len(set(salts.values())) == len(salts), salts
+    # and the actual quantization draws decorrelate: mid-ulp values
+    # round differently under different step salts
+    x = jnp.full((4096,), 1.0 + 1 / 512, jnp.float32)  # halfway point
+    draws = {s: np.asarray(_sr_bfloat16(x, _sr_salt(jnp.int32(s), 0)),
+                           np.float32) for s in (0, 2048, 4096)}
+    assert (draws[0] != draws[4096]).any()
+    assert (draws[0] != draws[2048]).any()
+    # chunk/leaf offsets stay disjoint from the step mixing
+    assert int(_sr_salt(jnp.int32(3), 0)) != int(_sr_salt(jnp.int32(3), 1))
 
 
 def test_sr_bfloat16_unbiased():

@@ -19,7 +19,10 @@ therefore tuned from END-TO-END train steps instead
 at GPT-2s S=512 while this harness reads ~parity). Trust rows here from
 S >= 1024, where op time clears the floor.
 
-Prints one JSON line per config; exit 0 iff all numerics agree.
+Prints one JSON line per config; exit 0 iff all numerics agree. Every
+row times the backward BOTH ways — the merged one-pass dK/dV+dQ kernel
+('auto') and the forced split pair — and reports `merged_vs_split`;
+`--sweep_blocks` adds the r6 block-size sweep rows at the long-S shapes.
 """
 
 import functools
@@ -50,7 +53,8 @@ def timeit(fn, *args, iters=5, warmup=2):
     return (time.perf_counter() - t0) / iters / CHAIN * 1e3  # ms per op
 
 
-def run(name, B, Hq, Hkv, S, D, window, dtype=jnp.bfloat16, dropout=0.0):
+def run(name, B, Hq, Hkv, S, D, window, dtype=jnp.bfloat16, dropout=0.0,
+        block_q=512, block_k=512):
     from mobilefinetuner_tpu.ops.attention import dot_product_attention
     from mobilefinetuner_tpu.ops.flash_attention import flash_attention
 
@@ -61,12 +65,14 @@ def run(name, B, Hq, Hkv, S, D, window, dtype=jnp.bfloat16, dropout=0.0):
     do = jax.random.normal(ks[3], (B, Hq, S, D), dtype)
     drng = jax.random.PRNGKey(9) if dropout > 0.0 else None
 
-    def make(impl):
+    def make(impl, bwd_impl="auto"):
         f = flash_attention if impl == "flash" else dot_product_attention
 
         def att(q, k, v):
+            extra = {"bwd_impl": bwd_impl, "block_q": block_q,
+                     "block_k": block_k} if impl == "flash" else {}
             return f(q, k, v, is_causal=True, sliding_window=window,
-                     attn_dropout=dropout, attn_dropout_rng=drng)
+                     attn_dropout=dropout, attn_dropout_rng=drng, **extra)
 
         @jax.jit
         def fwd(q, k, v):
@@ -89,7 +95,8 @@ def run(name, B, Hq, Hkv, S, D, window, dtype=jnp.bfloat16, dropout=0.0):
             return out
         return fwd, fwdbwd
 
-    f_fwd, f_bwd = make("flash")
+    f_fwd, f_bwd = make("flash")            # 'auto' backward (merged)
+    _, f_bwd_split = make("flash", "split")  # forced split pair
     x_fwd, x_bwd = make("xla")
 
     def one_bwd(f):
@@ -120,20 +127,33 @@ def run(name, B, Hq, Hkv, S, D, window, dtype=jnp.bfloat16, dropout=0.0):
 
     r = {"config": name, "B": B, "Hq": Hq, "Hkv": Hkv, "S": S, "D": D,
          "window": window, "dropout": dropout,
+         "block_q": block_q, "block_k": block_k,
          "flash_fwd_ms": round(timeit(f_fwd, q, k, v), 3),
          "xla_fwd_ms": round(timeit(x_fwd, q, k, v), 3),
          "flash_fwdbwd_ms": round(timeit(f_bwd, q, k, v, do), 3),
+         # the merged-vs-split backward comparison (r6): fwdbwd with the
+         # one-pass dK/dV+dQ kernel vs the FlashAttention-2 split pair
+         "flash_fwdbwd_split_ms": round(timeit(f_bwd_split, q, k, v, do),
+                                        3),
          "xla_fwdbwd_ms": round(timeit(x_bwd, q, k, v, do), 3),
          "max_rel_err": None if rel is None else round(rel, 5),
          "numerics_ok": ok}
     r["fwd_speedup"] = round(r["xla_fwd_ms"] / r["flash_fwd_ms"], 2)
     r["fwdbwd_speedup"] = round(r["xla_fwdbwd_ms"] / r["flash_fwdbwd_ms"],
                                 2)
+    r["merged_vs_split"] = round(
+        r["flash_fwdbwd_split_ms"] / r["flash_fwdbwd_ms"], 2)
     print(json.dumps(r))
     return ok
 
 
 def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sweep_blocks", action="store_true",
+                    help="block-size sweep rows for the merged backward "
+                         "at the long-S GPT-2/Gemma shapes (r6 retune)")
+    args = ap.parse_args()
     ok = True
     for S in (512, 1024, 2048):
         ok &= run(f"gpt2s_causal_S{S}", 8, 12, 12, S, 64, None)
@@ -145,6 +165,17 @@ def main():
     for S in (1024, 2048):
         ok &= run(f"gpt2s_causal_dropout_S{S}", 8, 12, 12, S, 64, None,
                   dropout=0.1)
+    if args.sweep_blocks:
+        # the merged kernel's q-loop depth per program is S/BQ while its
+        # dq-slab residency scales with S alone, so the r4/r5 512x512
+        # verdict must be re-checked per impl (each row reports both
+        # backward impls at the chosen blocks via merged_vs_split)
+        for bq, bk in ((512, 512), (256, 512), (512, 256), (256, 256)):
+            for S in (1024, 2048):
+                ok &= run(f"sweep_gpt2s_S{S}_bq{bq}_bk{bk}", 8, 12, 12,
+                          S, 64, None, block_q=bq, block_k=bk)
+            ok &= run(f"sweep_gemma_S2048_bq{bq}_bk{bk}", 4, 4, 1,
+                      2048, 256, None, block_q=bq, block_k=bk)
     return 0 if ok else 1
 
 

@@ -137,7 +137,7 @@ def kernel_microbench(gemma: bool):
         return fn(q, kc, vc, ok, scale)
 
     print(f"kernel microbench B={B} KV={KV} G={G} T={T} D={D} "
-          f"eligible={decode_eligible(KV, T, D, 2)}")
+          f"eligible={decode_eligible(KV, T, D, 2, G)}")
     r1 = run("xla", xla_reference)
     r2 = run("pallas", decode_attention)
     print("  max|diff| =", float(jnp.max(jnp.abs(r1 - r2))))

@@ -367,6 +367,137 @@ def bench_gemma_lora(B, S, dtype, accum=1, offload=False, steps=20,
     return r
 
 
+def _pipeline_corpus(path: str, n_lines: int = 8000, seed: int = 0):
+    """Synthetic WikiText-shaped corpus for the input-pipeline rows."""
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(n_lines):
+            n = int(rng.integers(8, 40))
+            f.write(" ".join(f"w{rng.integers(0, 5000)}"
+                             for _ in range(n)) + "\n")
+
+
+def bench_input_pipeline(dtype, steps, model="gpt2", prefetch=2, B=8,
+                         S=128, accum=2, size=None, warmup=2,
+                         window_tokens=20_000):
+    """Input-pipeline rows: the REAL host data path — streaming-mode
+    WikiText2Dataset (bounded window, per-epoch shuffle, on-demand
+    re-tokenization), grad-accum step-batch assembly, and device
+    placement — feeding the standard LoRA train step, with the async
+    prefetcher on (depth `prefetch`, lookahead 1) or off (prefetch=0,
+    the synchronous reference path). Reports tokens/s plus the step
+    loop's measured host-wait, so the BENCH artifact carries the
+    host/device breakdown the overlap claims rest on. The other rows
+    feed pre-built device arrays and so never see host cost; these two
+    columns are where input-pipeline regressions become visible."""
+    import itertools
+    import tempfile
+    import zlib
+
+    from mobilefinetuner_tpu.cli.common import micro_batches
+    from mobilefinetuner_tpu.core.xla_stats import compiled_peak_bytes
+    from mobilefinetuner_tpu.data.prefetch import Prefetcher
+    from mobilefinetuner_tpu.data.wikitext2 import (WT2Config,
+                                                    WikiText2Dataset)
+    from mobilefinetuner_tpu.parallel.mesh import make_batch_placer
+
+    if model == "gemma":
+        config = (Gemma3TextConfig.tiny() if size == "tiny"
+                  else Gemma3TextConfig.gemma3_270m())
+        params = gemma3.init_params(config, jax.random.PRNGKey(0))
+        spec = LoRASpec(rank=8, alpha=32.0, targets="full")
+        lora = init_lora_gemma3(config, spec, jax.random.PRNGKey(1))
+
+        def loss_fn(lora_t, p, mb):
+            hidden = gemma3.hidden_states(
+                config, p, mb["input_ids"],
+                attention_mask=mb["attention_mask"], lora=lora_t,
+                compute_dtype=dtype)
+            return chunked_lm_cross_entropy_sum(hidden, p["embed"],
+                                                mb["labels"], num_chunks=4)
+    else:
+        config = (GPT2Config.tiny() if size == "tiny"
+                  else GPT2Config.gpt2_small())
+        params = gpt2.init_params(config, jax.random.PRNGKey(0))
+        spec = LoRASpec(rank=8, alpha=16.0)
+        lora = init_lora_gpt2(config, spec, jax.random.PRNGKey(1))
+
+        def loss_fn(lora_t, p, mb):
+            logits = gpt2.forward(config, p, mb["input_ids"],
+                                  attention_mask=mb["attention_mask"],
+                                  lora=lora_t, compute_dtype=dtype)
+            return lm_cross_entropy_sum(logits, mb["labels"])
+
+    mask = trainable_mask(lora)
+    tc = TrainConfig(total_steps=1000, lr=2e-4, schedule="constant",
+                     warmup_ratio=0.0, grad_accum_steps=accum)
+    step_fn = make_train_step(loss_fn, tc, mask=mask, donate=True)
+    opt = init_optimizer(lora, tc, mask)
+
+    # deterministic word->id hash (crc32, NOT python hash(): unsalted, so
+    # prefetch-on and prefetch-off rows train on the identical stream and
+    # their loss columns stay comparable across runs too)
+    V = config.vocab_size
+    encode = lambda s: [zlib.crc32(w.encode()) % (V - 1)
+                        for w in s.split()]
+    with tempfile.TemporaryDirectory() as d:
+        corpus = f"{d}/wiki.train.tokens"
+        _pipeline_corpus(corpus)
+        cfg = WT2Config(seq_len=S, batch_size=B, seed=0, streaming=True,
+                        window_tokens=window_tokens)
+        ds = WikiText2Dataset(corpus, "train", cfg, encode, eos_id=V - 1)
+        place = make_batch_placer(
+            make_mesh(1, 1, devices=jax.devices()[:1]))
+        gen = (b for _, b in micro_batches(ds, accum))
+        # budget: the compile batch + max(warmup-1, 0) + the timed steps
+        stream = Prefetcher(
+            itertools.islice(gen, max(warmup, 1) + steps),
+            depth=prefetch, place_fn=place, lookahead=1)
+        try:
+            first = next(stream)
+            compiled = step_fn.lower(lora, params, opt, first,
+                                     jnp.int32(0)).compile()
+            peak = compiled_peak_bytes(compiled)
+            tr, op, m = compiled(lora, params, opt, first, jnp.int32(0))
+            for s in range(1, warmup):
+                tr, op, m = compiled(tr, params, op, next(stream),
+                                     jnp.int32(s))
+            float(m["loss"])  # drain: the timed window starts clean
+            wait_ms = 0.0
+            t0 = time.perf_counter()
+            for s in range(steps):
+                tw = time.perf_counter()
+                batch = next(stream)
+                wait_ms += (time.perf_counter() - tw) * 1000
+                tr, op, m = compiled(tr, params, op, batch,
+                                     jnp.int32(warmup + s))
+            loss = float(m["loss"])  # host sync closes the window
+            dt = time.perf_counter() - t0
+        finally:
+            stream.close()
+    return {"dt": dt, "loss": loss, "peak_bytes": peak,
+            "tokens": B * accum * S, "host_wait_ms": wait_ms,
+            "flops": 0}
+
+
+def pipe_finish(name, r, dtype, steps) -> dict:
+    """Input-pipeline row shape: throughput + host/device breakdown."""
+    toks_per_sec = r["tokens"] * steps / r["dt"]
+    return {
+        "config": name,
+        "tokens_per_sec_per_chip": round(toks_per_sec, 1),
+        "vs_baseline": round(toks_per_sec / BASELINE_TOKENS_PER_SEC, 2),
+        # fraction of the timed window the step loop spent blocked on the
+        # input pipeline (queue wait + lookahead placement); the sync-vs-
+        # prefetch row pair is the overlap measurement
+        "host_wait_frac": round(r["host_wait_ms"] / (r["dt"] * 1000), 4),
+        "host_wait_ms_per_step": round(r["host_wait_ms"] / steps, 2),
+        "mfu": None,
+        "peak_hbm_mb": round(r["peak_bytes"] / 2 ** 20, 1),
+        "loss": round(r["loss"], 4),
+    }
+
+
 _GEMMA1B_NP = None
 
 
@@ -705,6 +836,24 @@ def main():
             gsteps, B=2, S=2048, impl="flash")
         run("gemma270m_lora_bf16_S2048_xla", bench_gemma_lora, bf16,
             gsteps, B=2, S=2048, impl="xla")
+        # input-pipeline rows (r7): every other row feeds pre-built
+        # device arrays, so host-side batch production (streaming-window
+        # tokenization + accum assembly + placement) never shows up in
+        # them. These four run the REAL data path and measure the step
+        # loop's host-wait with the async prefetcher off vs on — the
+        # sync/prefetch pair per model is the overlap measurement, and
+        # host_wait_frac is the bubble the prefetcher exists to close.
+        run(f"gpt2s_input_pipeline_sync_B{B}_S128", bench_input_pipeline,
+            bf16, steps, B=B, S=S, prefetch=0, finisher=pipe_finish)
+        run(f"gpt2s_input_pipeline_prefetch2_B{B}_S128",
+            bench_input_pipeline, bf16, steps, B=B, S=S, prefetch=2,
+            finisher=pipe_finish)
+        run(f"gemma270m_input_pipeline_sync_B{GB}_S256",
+            bench_input_pipeline, bf16, gsteps, model="gemma", B=GB,
+            S=GS, prefetch=0, finisher=pipe_finish)
+        run(f"gemma270m_input_pipeline_prefetch2_B{GB}_S256",
+            bench_input_pipeline, bf16, gsteps, model="gemma", B=GB,
+            S=GS, prefetch=2, finisher=pipe_finish)
         # end-to-end generate throughput (prefill + sequential decode;
         # tokens/sec counts generated tokens only).
         # finish() is training-shaped, so pass run() a custom finisher.

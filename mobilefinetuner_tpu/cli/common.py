@@ -12,6 +12,7 @@ SURVEY.md §5 Checkpoint/resume).
 from __future__ import annotations
 
 import argparse
+import itertools
 import os
 import time
 from typing import Callable, Iterator, Optional
@@ -24,12 +25,12 @@ from mobilefinetuner_tpu.core.logging import (JSONLWriter, MetricsLogger,
                                               get_logger)
 from mobilefinetuner_tpu.core.xla_stats import (compiled_peak_mb,
                                                 live_hbm_mb)
+from mobilefinetuner_tpu.data.prefetch import Prefetcher
 from mobilefinetuner_tpu.data.wikitext2 import WikiText2Dataset
 from mobilefinetuner_tpu.ops.loss import perplexity_from_loss
-from mobilefinetuner_tpu.parallel.mesh import (make_mesh,
+from mobilefinetuner_tpu.parallel.mesh import (make_batch_placer, make_mesh,
                                                params_shardings,
-                                               replicated_sharding,
-                                               shard_batch)
+                                               replicated_sharding)
 from mobilefinetuner_tpu.parallel.offload import (OffloadConfig,
                                                   apply_placement, fetch,
                                                   placement_stats,
@@ -103,6 +104,16 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
     g.add_argument("--profile_start", type=int, default=10,
                    help="first profiled step (past compile+warmup)")
     g.add_argument("--profile_steps", type=int, default=5)
+    g.add_argument("--prefetch", type=int, default=2,
+                   help="async input pipeline (data/prefetch.py): a "
+                        "background thread produces host batches into a "
+                        "bounded queue of this depth, and batch k+1's "
+                        "device placement is issued while step k "
+                        "computes. 0 = fully synchronous kill-switch. "
+                        "The batch sequence is byte-identical either "
+                        "way (incl. resume and multi-host sharding); "
+                        "the metrics' host_wait_ms column shows what "
+                        "the overlap buys")
 
 
 def add_align_flags(p: argparse.ArgumentParser):
@@ -317,6 +328,14 @@ def micro_batches(dataset: WikiText2Dataset, accum: int,
     """Yield (epoch, [accum*micro_b, ...] step batch) forever, cycling
     epochs (the reference's per-step micro-batch pulls, main.cpp:569-583).
 
+    The step batch is assembled ONCE: chunk rows are written straight
+    into preallocated [accum*b, S] arrays (dataset.fill_rows) instead of
+    per-micro-batch np.stack followed by an np.concatenate over the
+    accumulation — that was two full copies of every step batch on the
+    host critical path. The arrays are freshly allocated per step, never
+    reused: the async prefetch queue (data/prefetch.py) may hold several
+    step batches at once, and a recycled buffer would corrupt them.
+
     skip_steps fast-forwards the stream past batches an interrupted run
     already consumed, WITHOUT building them — a resumed run continues the
     exact data order of an uninterrupted one (same seed => same per-epoch
@@ -327,42 +346,69 @@ def micro_batches(dataset: WikiText2Dataset, accum: int,
             "dataset yields zero batches (num_chunks < batch_size with "
             "drop_last=True — seq_len/batch_size too large or "
             "--data_fraction too small for this split)")
+    b = dataset.config.batch_size
+    S = dataset.config.seq_len
     # the stream is continuous across epochs (a partial accumulation at an
     # epoch boundary carries into the next epoch), so step s consumes
     # micro-batches [s*accum, (s+1)*accum) of the concatenated stream
-    epoch, start_batch = divmod(skip_steps * accum, nb)
-    pending = []
+    epoch, bi = divmod(skip_steps * accum, nb)
+    order = dataset.chunk_order(epoch)
     while True:
-        for b in dataset.epoch(epoch, start_batch=start_batch):
-            pending.append(b)
-            if len(pending) == accum:
-                yield epoch, {k: np.concatenate([p[k] for p in pending])
-                              for k in pending[0]}
-                pending = []
-        start_batch = 0
-        epoch += 1
+        # collect the step's chunk-index slices first — they can cross an
+        # epoch boundary (reshuffling the order) and, without drop_last,
+        # the final slice of an epoch may be short — then fill one buffer
+        slices = []
+        for _ in range(accum):
+            if bi >= nb:
+                bi = 0
+                epoch += 1
+                order = dataset.chunk_order(epoch)
+            slices.append(order[bi * b:(bi + 1) * b])
+            bi += 1
+        rows = sum(len(s) for s in slices)
+        ids = np.empty((rows, S), np.int32)
+        mask = np.empty((rows, S), np.float32)
+        labels = np.empty((rows, S), np.int32)
+        r0 = 0
+        for sl in slices:
+            dataset.fill_rows(sl, ids, mask, labels, row0=r0)
+            r0 += len(sl)
+        yield epoch, {"input_ids": ids, "attention_mask": mask,
+                      "labels": labels}
 
 
 def evaluate(eval_step, trainable, frozen, dataset: WikiText2Dataset,
              max_batches: int, mesh=None,
-             sequence_parallel: bool = False) -> dict:
+             sequence_parallel: bool = False, prefetch: int = 2) -> dict:
     """Token-weighted mean NLL over the split -> {loss, ppl, tokens}
     (eval_ppl.cpp:157-200 semantics), under the no-grad eval step.
     `mesh`: place eval batches like train batches (required under
-    multi-host, where raw host numpy cannot feed a global-mesh jit)."""
-    total, count, n = 0.0, 0, 0
-    for b in dataset.epoch(0):
-        if mesh is not None:
-            b = shard_batch(b, mesh, sequence_parallel)
-        s, c = eval_step(trainable, frozen, b)
-        total += float(s)
-        count += int(c)
-        n += 1
-        if max_batches and n >= max_batches:
-            break
-    mean = total / max(count, 1)
+    multi-host, where raw host numpy cannot feed a global-mesh jit).
+
+    The sum-NLL/token-count accumulators stay ON DEVICE (one tiny add per
+    batch rides the async dispatch queue) and transfer once after the
+    loop — per-batch float()/int() forced a full device sync per eval
+    step. Batches come through the same background producer + placement
+    lookahead as training (prefetch=0: synchronous)."""
+    place = make_batch_placer(mesh, sequence_parallel)
+    source = dataset.epoch(0)
+    if max_batches:
+        source = itertools.islice(source, max_batches)
+    total, count, n = None, None, 0
+    with Prefetcher(source, depth=prefetch, place_fn=place) as batches:
+        for b in batches:
+            s, c = eval_step(trainable, frozen, b)
+            total = s if total is None else total + s
+            count = c if count is None else count + c
+            n += 1
+    if n == 0:
+        tokens, mean = 0, 0.0
+    else:
+        total, count = jax.device_get((total, count))
+        tokens = int(count)
+        mean = float(total) / max(tokens, 1)
     return {"loss": mean, "ppl": perplexity_from_loss(mean),
-            "tokens": count, "batches": n}
+            "tokens": tokens, "batches": n}
 
 
 def compute_dtype_from_args(args):
@@ -462,8 +508,37 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
             lambda x: device_put_global(x, repl), opt_state)
 
     ema = EMA(args.ema_beta)
-    batches = micro_batches(train_ds, tc.grad_accum_steps,
+    # async input pipeline: micro-batch assembly (tokenization, streaming
+    # refetch, accum fill) runs in a background producer thread; dropout
+    # keys + device placement are issued one batch AHEAD on the consumer
+    # side, so batch k+1's host->HBM transfer overlaps step k's compute.
+    # --prefetch 0 collapses to the synchronous path (same interface,
+    # byte-identical batch sequence).
+    prefetch_depth = max(getattr(args, "prefetch", 2), 0)
+    sp = getattr(args, "sequence_parallel", False)
+    place_batch = make_batch_placer(mesh, sp)
+
+    def numbered_batches():
+        gen = micro_batches(train_ds, tc.grad_accum_steps,
                             skip_steps=start_step)
+        for step in itertools.count(start_step):
+            epoch, batch = next(gen)
+            yield step, epoch, batch
+
+    def place_step(item):
+        step, epoch, batch = item
+        if dropout_rng is not None:
+            nb = batch["input_ids"].shape[0]
+            batch["dropout_rng"] = jax.random.split(
+                jax.random.fold_in(dropout_rng, step), nb)
+        return step, epoch, place_batch(batch)
+
+    # max(..., 0): a resume at/after total_steps runs zero steps (the loop
+    # below is empty) and must not build a stream at all
+    stream = Prefetcher(
+        itertools.islice(numbered_batches(),
+                         max(total_steps - start_step, 0)),
+        depth=prefetch_depth, place_fn=place_step, lookahead=1)
     t_start = time.time()
     metrics = {}
     epoch = 0
@@ -500,6 +575,7 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     buffered = []  # [(step, epoch, tokens, device_metrics), ...]
     t_interval = time.perf_counter()
     slept_ms = 0.0  # governor sleep inside the interval, excluded from dt
+    waited_ms = 0.0  # host-wait: step loop blocked on the input pipeline
     # flush cadence: the log interval; if step logging is off but a CSV was
     # requested, flush every 50 steps so rows survive a crash; 1000-step
     # hard cap bounds the device-metrics buffer in all cases.
@@ -510,13 +586,19 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
         """One host sync for everything buffered since the last flush.
         Rows in a flush share the interval-averaged step_time_ms (per-step
         wall time under async dispatch measures only dispatch latency, so
-        the average over a synced interval is the honest number)."""
-        nonlocal t_interval, slept_ms
+        the average over a synced interval is the honest number) and
+        host_wait_ms — the interval-averaged time the step loop spent
+        BLOCKED pulling the next batch from the input pipeline (queue
+        wait + lookahead placement; with the producer keeping up this is
+        ~0, which is the observable proof the prefetch overlap works —
+        the host/device breakdown, not an assumption)."""
+        nonlocal t_interval, slept_ms, waited_ms
         if not buffered:
             return
         fetched = jax.device_get([m for _, _, _, m in buffered])
         dt_ms = ((time.perf_counter() - t_interval) * 1000 - slept_ms) \
             / len(buffered)
+        wait_ms = waited_ms / len(buffered)
         hbm = live_hbm_mb() or peak_hbm["mb"]
         for (s, ep, toks, _), m in zip(buffered, fetched):
             loss = float(m["loss"])
@@ -524,7 +606,8 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
             if metrics_csv:
                 metrics_csv.log(epoch=ep, step=s + 1, loss=loss,
                                 avg_loss=avg, lr=float(m["lr"]),
-                                step_time_ms=dt_ms, hbm_mb=hbm)
+                                step_time_ms=dt_ms, host_wait_ms=wait_ms,
+                                hbm_mb=hbm)
         s, ep, toks, _ = buffered[-1]
         m = fetched[-1]
         if emit_log and args.log_interval:
@@ -534,65 +617,76 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 f"ppl={perplexity_from_loss(float(m['loss'])):.2f} "
                 f"grad_norm={float(m['grad_norm']):.3f} "
                 f"lr={float(m['lr']):.2e} "
-                f"{toks / (dt_ms / 1000):.0f} tok/s")
+                f"{toks / (dt_ms / 1000):.0f} tok/s "
+                f"host_wait={wait_ms:.1f}ms")
         buffered.clear()
         slept_ms = 0.0
+        waited_ms = 0.0
         t_interval = time.perf_counter()
 
-    for step in range(start_step, total_steps):
-        epoch, batch = next(batches)
-        if dropout_rng is not None:
-            n = batch["input_ids"].shape[0]
-            batch["dropout_rng"] = jax.random.split(
-                jax.random.fold_in(dropout_rng, step), n)
-        if mesh is not None:
-            batch = shard_batch(batch, mesh,
-                                getattr(args, "sequence_parallel", False))
-        if compiled_step is None:
-            # AOT compile once: the SAME executable serves every step
-            # (shapes are static), and its memory analysis gives peak HBM
-            # for free — no second trace/compile on the jit cache path.
-            compiled_step = step_fn.lower(
-                trainable, frozen, opt_state, batch,
-                jnp.int32(step)).compile()
-            peak_hbm["mb"] = compiled_peak_mb(compiled_step)
-            if peak_hbm["mb"]:
-                log.info(f"compiled step peak HBM: "
-                         f"{peak_hbm['mb']:.0f} MB")
-        maybe_profile(step)
-        trainable, opt_state, metrics = compiled_step(
-            trainable, frozen, opt_state, batch, jnp.int32(step))
-        toks = batch["input_ids"].shape[0] * batch["input_ids"].shape[1]
-        buffered.append((step, epoch, toks, metrics))
-        log_boundary = bool(args.log_interval) \
-            and (step + 1) % args.log_interval == 0
-        if log_boundary or (step + 1) % flush_every == 0:
-            # capped flushes (flush_every < log_interval) only write CSV
-            # rows; the log line fires exactly on the requested cadence
-            flush_metrics(emit_log=log_boundary)
+    try:
+        for step in range(start_step, total_steps):
+            # the prefetched stream yields batches already placed (and
+            # dropout-keyed); this next() is the step loop's only input
+            # dependency, and the time it blocks is the host/device
+            # breakdown's host_wait_ms
+            t_wait = time.perf_counter()
+            step_i, epoch, batch = next(stream)
+            waited_ms += (time.perf_counter() - t_wait) * 1000
+            assert step_i == step  # strict order preservation
+            if compiled_step is None:
+                # AOT compile once: the SAME executable serves every step
+                # (shapes are static), and its memory analysis gives peak
+                # HBM for free — no second trace/compile on the jit cache
+                # path.
+                compiled_step = step_fn.lower(
+                    trainable, frozen, opt_state, batch,
+                    jnp.int32(step)).compile()
+                peak_hbm["mb"] = compiled_peak_mb(compiled_step)
+                if peak_hbm["mb"]:
+                    log.info(f"compiled step peak HBM: "
+                             f"{peak_hbm['mb']:.0f} MB")
+            maybe_profile(step)
+            trainable, opt_state, metrics = compiled_step(
+                trainable, frozen, opt_state, batch, jnp.int32(step))
+            toks = batch["input_ids"].shape[0] * batch["input_ids"].shape[1]
+            buffered.append((step, epoch, toks, metrics))
+            log_boundary = bool(args.log_interval) \
+                and (step + 1) % args.log_interval == 0
+            if log_boundary or (step + 1) % flush_every == 0:
+                # capped flushes (flush_every < log_interval) only write
+                # CSV rows; the log line fires exactly on the requested
+                # cadence
+                flush_metrics(emit_log=log_boundary)
 
-        if (args.eval_interval and valid_ds is not None
-                and (step + 1) % args.eval_interval == 0):
-            flush_metrics(emit_log=False)  # off-cadence boundary flush
-            ev = evaluate(eval_step, trainable, frozen, valid_ds,
-                          args.eval_batches, mesh=eval_mesh,
-                          sequence_parallel=eval_sp)
-            log.info(f"eval @ step {step + 1}: loss={ev['loss']:.4f} "
-                     f"ppl={ev['ppl']:.2f} ({ev['tokens']} tokens)")
-            if eval_jsonl:
-                eval_jsonl.write({"type": "eval", "step": step + 1,
-                                  "loss": ev["loss"], "ppl": ev["ppl"],
-                                  "tokens": ev["tokens"],
-                                  "time": time.time() - t_start})
-            t_interval = time.perf_counter()  # eval time is not step time
+            if (args.eval_interval and valid_ds is not None
+                    and (step + 1) % args.eval_interval == 0):
+                flush_metrics(emit_log=False)  # off-cadence boundary flush
+                ev = evaluate(eval_step, trainable, frozen, valid_ds,
+                              args.eval_batches, mesh=eval_mesh,
+                              sequence_parallel=eval_sp,
+                              prefetch=prefetch_depth)
+                log.info(f"eval @ step {step + 1}: loss={ev['loss']:.4f} "
+                         f"ppl={ev['ppl']:.2f} ({ev['tokens']} tokens)")
+                if eval_jsonl:
+                    eval_jsonl.write({"type": "eval", "step": step + 1,
+                                      "loss": ev["loss"], "ppl": ev["ppl"],
+                                      "tokens": ev["tokens"],
+                                      "time": time.time() - t_start})
+                t_interval = time.perf_counter()  # eval time ≠ step time
 
-        if args.save_every and save_hook and (step + 1) % args.save_every \
-                == 0 and (step + 1) < total_steps:
-            flush_metrics(emit_log=False)  # off-cadence boundary flush
-            save_hook(step + 1, trainable, opt_state, final=False)
-            t_interval = time.perf_counter()  # save time is not step time
+            if args.save_every and save_hook and (step + 1) % \
+                    args.save_every == 0 and (step + 1) < total_steps:
+                flush_metrics(emit_log=False)  # off-cadence boundary flush
+                save_hook(step + 1, trainable, opt_state, final=False)
+                t_interval = time.perf_counter()  # save time ≠ step time
 
-        slept_ms += governor.throttle(step)
+            slept_ms += governor.throttle(step)
+    finally:
+        # stop the producer thread even when the consumer dies mid-epoch
+        # (compiled-step failure, KeyboardInterrupt): no leaked threads,
+        # and the original exception propagates untouched
+        stream.close()
 
     if prof_active:
         maybe_profile(prof_end)  # close an unfinished trace
@@ -600,7 +694,7 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     if valid_ds is not None and args.eval_interval:
         ev = evaluate(eval_step, trainable, frozen, valid_ds,
                       args.eval_batches, mesh=eval_mesh,
-                      sequence_parallel=eval_sp)
+                      sequence_parallel=eval_sp, prefetch=prefetch_depth)
         log.info(f"final eval: loss={ev['loss']:.4f} ppl={ev['ppl']:.2f}")
         if eval_jsonl:
             eval_jsonl.write({"type": "final_eval", "step": total_steps,

@@ -23,6 +23,7 @@ text_config); force with --family.
 from __future__ import annotations
 
 import argparse
+import itertools
 import json
 import sys
 import time
@@ -32,6 +33,7 @@ import jax.numpy as jnp
 
 from mobilefinetuner_tpu.cli.family import apply_adapter, load_family
 from mobilefinetuner_tpu.core.logging import JSONLWriter, get_logger
+from mobilefinetuner_tpu.data.prefetch import Prefetcher
 from mobilefinetuner_tpu.data.wikitext2 import WT2Config, WikiText2Dataset
 from mobilefinetuner_tpu.ops.loss import (lm_cross_entropy_sum,
                                           perplexity_from_loss)
@@ -64,6 +66,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--loss_chunks", type=int, default=8,
                    help="sequence chunks for Gemma's 262k-vocab chunked "
                         "CE")
+    p.add_argument("--prefetch", type=int, default=2,
+                   help="async input pipeline depth (background batch "
+                        "producer + device-placement lookahead, "
+                        "data/prefetch.py); 0 = synchronous")
     return p
 
 
@@ -119,22 +125,38 @@ def main(argv=None) -> int:
                           eos_id, pad_id=pad_id)
 
     jsonl = JSONLWriter(args.out) if args.out else None
-    total, count = 0.0, 0
+    # device-side accumulation: per-batch float(s)/int(c) forced a full
+    # device sync per eval step — the sums stay on device (tiny adds on
+    # the async dispatch queue) and come to host only at progress-log
+    # boundaries and once after the loop. Batches arrive via the async
+    # producer + placement lookahead (tokenization and the host->device
+    # transfer overlap the previous batch's compute; --prefetch 0 is the
+    # synchronous reference path).
+    total, count, n_done = None, None, 0
     t0 = time.time()
-    for n, batch in enumerate(ds.epoch(0)):
-        s, c = step(params, lora, batch)
-        total += float(s)
-        count += int(c)
-        if args.log_every and (n + 1) % args.log_every == 0:
-            mean = total / max(count, 1)
-            log.info(f"batch {n + 1}/{ds.num_batches()} "
-                     f"nll={mean:.4f} ppl={perplexity_from_loss(mean):.2f}")
-            if jsonl:
-                jsonl.write({"type": "progress", "batch": n + 1,
-                             "nll": mean,
-                             "ppl": perplexity_from_loss(mean)})
-        if args.max_batches and n + 1 >= args.max_batches:
-            break
+    source = ds.epoch(0)
+    if args.max_batches:
+        source = itertools.islice(source, args.max_batches)
+    with Prefetcher(source, depth=args.prefetch,
+                    place_fn=jax.device_put) as batches:
+        for n, batch in enumerate(batches):
+            s, c = step(params, lora, batch)
+            total = s if total is None else total + s
+            count = c if count is None else count + c
+            n_done = n + 1
+            if args.log_every and (n + 1) % args.log_every == 0:
+                t, k = jax.device_get((total, count))
+                mean = float(t) / max(int(k), 1)
+                log.info(f"batch {n + 1}/{ds.num_batches()} "
+                         f"nll={mean:.4f} "
+                         f"ppl={perplexity_from_loss(mean):.2f}")
+                if jsonl:
+                    jsonl.write({"type": "progress", "batch": n + 1,
+                                 "nll": mean,
+                                 "ppl": perplexity_from_loss(mean)})
+    if n_done:
+        total, count = jax.device_get((total, count))
+    total, count = (float(total), int(count)) if n_done else (0.0, 0)
     mean = total / max(count, 1)
     ppl = perplexity_from_loss(mean)
     record = {"type": "final", "family": family, "split": args.split,

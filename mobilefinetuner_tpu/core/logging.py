@@ -48,14 +48,17 @@ class MetricsLogger:
     """CSV training-metrics sink, one row per logged step.
 
     Columns mirror the reference MetricsLogger (logger.h:131-190) plus
-    hbm_mb — the observability analog of the reference's per-interval
-    memory prints (gpt2_lora_finetune/main.cpp:639-642): live device
-    bytes-in-use when the platform exposes memory_stats(), else the
-    compiled peak estimate the caller provides.
+    two TPU-native observability columns: hbm_mb — the analog of the
+    reference's per-interval memory prints (main.cpp:639-642): live
+    device bytes-in-use when the platform exposes memory_stats(), else
+    the compiled peak estimate the caller provides — and host_wait_ms,
+    the interval-averaged time the step loop blocked pulling the next
+    batch from the input pipeline (the host share of the host/device
+    step-time breakdown; ~0 when the async prefetcher keeps up).
     """
 
     COLUMNS = ["timestamp", "epoch", "step", "loss", "avg_loss", "lr",
-               "step_time_ms", "hbm_mb"]
+               "step_time_ms", "host_wait_ms", "hbm_mb"]
 
     def __init__(self, path: str):
         self.path = path
@@ -76,10 +79,12 @@ class MetricsLogger:
             self._f.flush()
 
     def log(self, epoch: int, step: int, loss: float, avg_loss: float,
-            lr: float, step_time_ms: float, hbm_mb: float = 0.0):
+            lr: float, step_time_ms: float, host_wait_ms: float = 0.0,
+            hbm_mb: float = 0.0):
         self._w.writerow([f"{time.time():.3f}", epoch, step, f"{loss:.6f}",
                           f"{avg_loss:.6f}", f"{lr:.8f}",
-                          f"{step_time_ms:.2f}", f"{hbm_mb:.1f}"])
+                          f"{step_time_ms:.2f}", f"{host_wait_ms:.2f}",
+                          f"{hbm_mb:.1f}"])
         self._f.flush()
 
     def close(self):

@@ -205,14 +205,13 @@ class WikiText2Dataset:
             return self.num_chunks // b
         return (self.num_chunks + b - 1) // b
 
-    def epoch(self, epoch: Optional[int] = None,
-              start_batch: int = 0) -> Iterator[dict]:
-        """Yield batches for one epoch; chunk order reshuffled per epoch
-        from (seed, epoch). start_batch skips ahead without building the
-        skipped batches (checkpoint-resume fast-forward)."""
-        if epoch is None:
-            epoch = self._epoch
-            self._epoch += 1
+    def chunk_order(self, epoch: int) -> np.ndarray:
+        """The epoch's chunk visitation order: seeded per-epoch shuffle
+        (wikitext2_dataset.cpp:266-268 analog). Exposed so batch builders
+        that assemble multi-micro-batch step buffers directly
+        (cli/common.micro_batches, the prefetch producer) share the EXACT
+        order `epoch()` uses — the determinism contract of the async
+        input pipeline hangs off this single function."""
         order = np.arange(self.num_chunks)
         if self.config.shuffle:
             rng = np.random.default_rng(self.config.seed + epoch)
@@ -233,16 +232,42 @@ class WikiText2Dataset:
                     if blocks else order
             else:
                 rng.shuffle(order)
+        return order
+
+    def fill_rows(self, idxs, input_ids: np.ndarray, mask: np.ndarray,
+                  labels: np.ndarray, row0: int = 0) -> None:
+        """Write chunks `idxs` into rows [row0, row0+len(idxs)) of
+        preallocated [N, S] batch arrays — the allocation-free core of
+        batch assembly (`epoch()` and `micro_batches` both build on it,
+        so a step buffer is filled ONCE instead of stack-then-concat)."""
+        for j, ci in enumerate(idxs):
+            i_row, m_row, l_row = self.chunk(int(ci))
+            input_ids[row0 + j] = i_row
+            mask[row0 + j] = m_row
+            labels[row0 + j] = l_row
+
+    def epoch(self, epoch: Optional[int] = None,
+              start_batch: int = 0) -> Iterator[dict]:
+        """Yield batches for one epoch; chunk order reshuffled per epoch
+        from (seed, epoch) (`chunk_order`). start_batch skips ahead
+        without building the skipped batches (checkpoint-resume
+        fast-forward)."""
+        if epoch is None:
+            epoch = self._epoch
+            self._epoch += 1
+        order = self.chunk_order(epoch)
         b = self.config.batch_size
+        S = self.config.seq_len
         nb = self.num_batches()
         for bi in range(start_batch, nb):
             idxs = order[bi * b:(bi + 1) * b]
-            rows = [self.chunk(int(i)) for i in idxs]
-            yield {
-                "input_ids": np.stack([r[0] for r in rows]),
-                "attention_mask": np.stack([r[1] for r in rows]),
-                "labels": np.stack([r[2] for r in rows]),
-            }
+            n = len(idxs)
+            batch = {"input_ids": np.empty((n, S), np.int32),
+                     "attention_mask": np.empty((n, S), np.float32),
+                     "labels": np.empty((n, S), np.int32)}
+            self.fill_rows(idxs, batch["input_ids"],
+                           batch["attention_mask"], batch["labels"])
+            yield batch
 
     def total_valid_tokens(self) -> int:
         return self._total_tokens

@@ -18,18 +18,23 @@ this module supplies the process-level runtime around them:
     per step) crosses the DCN host boundary — the standard
     bandwidth-hierarchy-aware layout (scaling-book recipe; built on
     `mesh_utils.create_hybrid_device_mesh`).
-  * `global_batch_array(...)` — multi-host batch feeding. Under multi-host
-    jit every argument must be a global `jax.Array` spanning all processes;
-    `jax.device_put` of host numpy cannot produce one. Each process runs
-    the SAME seeded data pipeline (identical global batch everywhere —
-    WikiText-2 is small and tokenization is cheap/pretokenizable), and
+  * `device_put_global(...)` / `put_batch_global(...)` — multi-host batch
+    feeding. Under multi-host jit every argument must be a global
+    `jax.Array` spanning all processes; `jax.device_put` of host numpy
+    cannot produce one. Each process runs the SAME seeded data pipeline
+    (identical global batch everywhere — WikiText-2 is small and
+    tokenization is cheap/pretokenizable), and
     `jax.make_array_from_callback` slices out exactly the shards addressable
     from this process. No cross-host data exchange ever happens on the
-    input path.
+    input path — which is also what makes the async prefetcher
+    (data/prefetch.py) multi-host safe: placement is collective-free, so
+    issuing batch k+1's put while step k computes needs no cross-process
+    coordination, and every process's background producer yields the same
+    seeded sequence.
 
 Single-process runs (including every test and the tunneled single-chip
 bench) pass through all of this untouched: `initialize` is a no-op without
-a multi-process request, and `global_batch_array` degrades to a plain
+a multi-process request, and `device_put_global` degrades to a plain
 sharded device_put.
 """
 
@@ -181,6 +186,16 @@ def device_put_global(x, sharding) -> jax.Array:
     x = np.asarray(x)  # multi-process only: feed shards from a host copy
     return jax.make_array_from_callback(x.shape, sharding,
                                         lambda idx: x[idx])
+
+
+def put_batch_global(batch: dict, sharding_for) -> dict:
+    """One placement pass over a batch dict: `sharding_for(key)` names
+    each leaf's sharding, `device_put_global` makes the transfer (global
+    under multi-host, plain async device_put single-process). This is the
+    shard-aware placement primitive behind `mesh.shard_batch` and the
+    input pipeline's lookahead placer (`mesh.make_batch_placer`)."""
+    return {k: device_put_global(v, sharding_for(k))
+            for k, v in batch.items()}
 
 
 def gather_to_host(tree):

@@ -104,6 +104,26 @@ def shard_params(params, mesh: Mesh, min_size: int = 2 ** 16):
     return jax.tree.map(device_put_global, params, shardings)
 
 
+def make_batch_placer(mesh: Optional[Mesh],
+                      sequence_parallel: bool = False):
+    """Build place(batch) -> placed once, NamedShardings precomputed —
+    the per-step closure the async input pipeline (data/prefetch.py)
+    issues for batch k+1 while step k computes. Placement is identical
+    to `shard_batch`; only WHEN it runs differs. mesh=None returns
+    identity (the single-process uncommitted-host-numpy fast path, where
+    the jit transfers on dispatch)."""
+    from mobilefinetuner_tpu.parallel.distributed import put_batch_global
+    if mesh is None:
+        return lambda batch: batch
+    if not sequence_parallel:
+        s = batch_sharding(mesh)
+        return lambda batch: put_batch_global(batch, lambda k: s)
+    sp = sp_batch_sharding(mesh)
+    b_only = NamedSharding(mesh, P("data"))
+    return lambda batch: put_batch_global(
+        batch, lambda k: b_only if k == "dropout_rng" else sp)
+
+
 def shard_batch(batch, mesh: Mesh, sequence_parallel: bool = False):
     """Place a batch pytree (leading batch axis) onto the mesh. In
     sequence-parallel mode, [B, S] token arrays shard S over "fsdp";
@@ -111,11 +131,4 @@ def shard_batch(batch, mesh: Mesh, sequence_parallel: bool = False):
     only the batch dim. Multi-host: every process holds the same global
     batch and feeds only its addressable shards
     (parallel/distributed.device_put_global)."""
-    from mobilefinetuner_tpu.parallel.distributed import device_put_global
-    if not sequence_parallel:
-        s = batch_sharding(mesh)
-        return {k: device_put_global(v, s) for k, v in batch.items()}
-    sp = sp_batch_sharding(mesh)
-    b_only = NamedSharding(mesh, P("data"))
-    return {k: device_put_global(v, sp if k != "dropout_rng" else b_only)
-            for k, v in batch.items()}
+    return make_batch_placer(mesh, sequence_parallel)(batch)

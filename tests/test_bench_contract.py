@@ -54,6 +54,29 @@ def test_single_stdout_line_and_suite_artifact(bench):
     assert not os.path.exists("BENCH_SUITE.json.tmp")
 
 
+def test_input_pipeline_row_shape_and_tiny_e2e(bench):
+    """The input-pipeline rows carry the host/device breakdown: run the
+    REAL bench_input_pipeline (tiny model, CPU) prefetch off vs on and
+    check the pipe_finish row schema — tokens/s plus host_wait_frac, the
+    number the round scoring reads for the overlap claim."""
+    import jax.numpy as jnp
+    for prefetch in (0, 2):
+        r = bench.bench_input_pipeline(jnp.float32, steps=3, size="tiny",
+                                       B=2, S=32, prefetch=prefetch,
+                                       warmup=1)
+        assert r["tokens"] == 2 * 2 * 32  # B * accum * S
+        assert r["host_wait_ms"] >= 0 and r["dt"] > 0
+        row = bench.pipe_finish(f"pipe{prefetch}", r, "float32", 3)
+        assert row["tokens_per_sec_per_chip"] > 0
+        assert 0.0 <= row["host_wait_frac"] <= 1.0
+        assert row["host_wait_ms_per_step"] >= 0
+        assert "loss" in row and "peak_hbm_mb" in row
+    # no leaked producer threads after the rows complete
+    import threading
+    assert not [t for t in threading.enumerate()
+                if t.name == "batch-producer"]
+
+
 def test_failed_headline_reports_zero_and_exits_nonzero(bench,
                                                         monkeypatch):
     def boom(dtype, steps, **kw):

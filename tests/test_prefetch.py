@@ -1,0 +1,237 @@
+"""Async input pipeline (data/prefetch.py + cli/common.micro_batches):
+determinism contract (byte-identical batch sequence vs the synchronous
+path, across epoch boundaries, skip_steps resume, and mesh sharding),
+bounded queue depth, clean shutdown (no leaked producer threads, consumer
+exceptions propagate, producer exceptions surface in order)."""
+
+import itertools
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mobilefinetuner_tpu.cli.common import evaluate, micro_batches
+from mobilefinetuner_tpu.data.prefetch import Prefetcher
+from mobilefinetuner_tpu.data.wikitext2 import WT2Config, WikiText2Dataset
+
+EOS = 999
+
+
+def _encode(line: str):
+    return [abs(hash(w)) % 900 for w in line.split()]
+
+
+@pytest.fixture(scope="module")
+def corpus_file(tmp_path_factory):
+    d = tmp_path_factory.mktemp("wt2pf")
+    path = str(d / "wiki.train.tokens")
+    rng = np.random.default_rng(0)
+    with open(path, "w") as f:
+        for _ in range(120):
+            n = int(rng.integers(3, 30))
+            f.write(" ".join(f"w{rng.integers(0, 500)}"
+                             for _ in range(n)) + "\n")
+    return path
+
+
+def _mk(path, **kw):
+    cfg = WT2Config(**{"seq_len": 32, "batch_size": 2, "seed": 7, **kw})
+    return WikiText2Dataset(path, "train", cfg, _encode, eos_id=EOS)
+
+
+def _producer_threads():
+    return [t for t in threading.enumerate() if t.name == "batch-producer"]
+
+
+def _take(ds_factory, n, accum=2, skip_steps=0, depth=0):
+    """First n (epoch, batch) pairs through a depth-`depth` pipeline."""
+    src = micro_batches(ds_factory(), accum, skip_steps=skip_steps)
+    with Prefetcher(itertools.islice(src, n), depth=depth) as stream:
+        return list(stream)
+
+
+def _assert_same_stream(a, b):
+    assert len(a) == len(b)
+    for (ep_a, ba), (ep_b, bb) in zip(a, b):
+        assert ep_a == ep_b
+        assert set(ba) == set(bb)
+        for k in ba:
+            np.testing.assert_array_equal(ba[k], bb[k])
+
+
+# --------------------------- determinism contract ---------------------------
+
+def test_byte_identical_across_epoch_boundaries(corpus_file):
+    """accum=2 over an odd number of per-epoch batches: accumulation
+    carries across reshuffled epoch boundaries; the prefetched stream
+    must reproduce the synchronous one byte for byte."""
+    mk = lambda: _mk(corpus_file)
+    nb = mk().num_batches()
+    n = 2 * nb + 3  # several epoch crossings
+    sync = _take(mk, n, depth=0)
+    pref = _take(mk, n, depth=3)
+    _assert_same_stream(sync, pref)
+    assert sync[-1][0] >= 2  # really crossed epochs
+
+
+def test_byte_identical_streaming_mode(corpus_file):
+    """Streaming dataset (window refetch runs in the producer thread,
+    mutating the dataset's resident window): prefetched == synchronous.
+    (Streaming uses its own window-local shuffle, so the oracle is the
+    streaming-mode sync path, not the in-RAM dataset.)"""
+    mk = lambda: _mk(corpus_file, streaming=True, window_tokens=64)
+    sync = _take(mk, 10, depth=0)
+    pref = _take(mk, 10, depth=2)
+    _assert_same_stream(sync, pref)
+
+
+def test_byte_identical_skip_steps_resume(corpus_file):
+    """A prefetched resume (skip_steps) continues the exact sequence of
+    an uninterrupted prefetched run — and of an uninterrupted sync run."""
+    mk = lambda: _mk(corpus_file)
+    nb = mk().num_batches()
+    skip = nb + 1  # resume point past an epoch boundary
+    full = _take(mk, skip + 4, depth=2)
+    resumed = _take(mk, 4, skip_steps=skip, depth=2)
+    _assert_same_stream(full[skip:], resumed)
+    resumed_sync = _take(mk, 4, skip_steps=skip, depth=0)
+    _assert_same_stream(resumed_sync, resumed)
+
+
+def test_byte_identical_mesh_sharded_placement(corpus_file):
+    """Lookahead placement over a (2,4) mesh: the placed global arrays
+    carry the same bytes, per shard, as synchronous shard_batch — the
+    prefetcher changes WHEN placement happens, never what is placed."""
+    from mobilefinetuner_tpu.parallel.mesh import (make_batch_placer,
+                                                   make_mesh, shard_batch)
+    mesh = make_mesh(data=2, fsdp=4)
+    mk = lambda: _mk(corpus_file, batch_size=8)
+    place = make_batch_placer(mesh)
+    src = (b for _, b in micro_batches(mk(), 1))
+    with Prefetcher(itertools.islice(src, 6), depth=2,
+                    place_fn=place) as stream:
+        placed = list(stream)
+    sync = [shard_batch(b, mesh)
+            for _, b in itertools.islice(micro_batches(mk(), 1), 6)]
+    for pa, pb in zip(placed, sync):
+        for k in pa:
+            assert pa[k].sharding == pb[k].sharding
+            np.testing.assert_array_equal(np.asarray(pa[k]),
+                                          np.asarray(pb[k]))
+
+
+# --------------------------- queue mechanics --------------------------------
+
+def test_bounded_queue_depth():
+    """The producer must never run more than depth + lookahead + 1 items
+    ahead of the consumer (bounded host memory is the point of the
+    queue)."""
+    produced = [0]
+
+    def counting_source():
+        for i in range(1000):
+            produced[0] += 1
+            yield i
+
+    depth, lookahead = 3, 1
+    with Prefetcher(counting_source(), depth=depth,
+                    lookahead=lookahead) as stream:
+        got = [next(stream) for _ in range(5)]
+        time.sleep(0.3)  # let the producer run as far ahead as it can
+        assert got == list(range(5))
+        # consumed + queue + lookahead buffer + one in the producer's hand
+        assert produced[0] <= 5 + depth + lookahead + 2, produced[0]
+
+
+def test_order_is_strict_and_complete():
+    with Prefetcher(iter(range(257)), depth=2) as stream:
+        assert list(stream) == list(range(257))
+
+
+def test_kill_switch_is_threadless():
+    before = len(_producer_threads())
+    with Prefetcher(iter(range(10)), depth=0) as stream:
+        assert len(_producer_threads()) == before  # no thread spawned
+        assert list(stream) == list(range(10))
+
+
+# --------------------------- shutdown ---------------------------------------
+
+def test_consumer_exception_propagates_and_no_leaked_threads():
+    """A consumer dying mid-epoch must not leak the producer thread, and
+    its own exception must propagate unchanged."""
+    def endless():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    with pytest.raises(RuntimeError, match="consumer died"):
+        with Prefetcher(endless(), depth=2) as stream:
+            next(stream)
+            next(stream)
+            raise RuntimeError("consumer died")
+    deadline = time.time() + 5
+    while _producer_threads() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not _producer_threads(), "producer thread leaked"
+
+
+def test_producer_exception_surfaces_after_prior_items():
+    """A generator that raises mid-epoch: everything produced before the
+    raise is delivered first, then the SAME exception type/message
+    surfaces at the consumer (synchronous-path error semantics)."""
+    def bad_source():
+        yield from range(4)
+        raise ValueError("tokenizer exploded")
+
+    stream = Prefetcher(bad_source(), depth=2)
+    got = [next(stream) for _ in range(4)]
+    assert got == [0, 1, 2, 3]
+    with pytest.raises(ValueError, match="tokenizer exploded"):
+        next(stream)
+    assert not _producer_threads()
+
+
+def test_close_unblocks_full_queue_producer():
+    """close() while the producer is parked on a full queue must stop it
+    promptly (the put is timeout-polled against the stop event)."""
+    stream = Prefetcher(itertools.count(), depth=1)
+    next(stream)
+    time.sleep(0.05)  # producer now blocked on the full queue
+    stream.close()
+    deadline = time.time() + 5
+    while _producer_threads() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not _producer_threads()
+    with pytest.raises(StopIteration):
+        next(stream)  # closed stream is terminal
+
+
+# --------------------------- evaluate() integration -------------------------
+
+def test_evaluate_device_accumulation_matches_sync(corpus_file):
+    """evaluate()'s on-device accumulators + prefetch produce the same
+    totals as a hand-rolled synchronous float()-per-batch loop."""
+    ds = _mk(corpus_file)
+
+    def eval_step(tr, fr, b):
+        return (jnp.sum(b["input_ids"]).astype(jnp.float32),
+                jnp.int32(b["input_ids"].size))
+
+    ref_total, ref_count, ref_n = 0.0, 0, 0
+    for b in itertools.islice(_mk(corpus_file).epoch(0), 5):
+        s, c = eval_step(None, None, b)
+        ref_total += float(s)
+        ref_count += int(c)
+        ref_n += 1
+
+    for depth in (0, 2):
+        out = evaluate(eval_step, None, None, ds, 5, prefetch=depth)
+        assert out["tokens"] == ref_count
+        assert out["batches"] == ref_n
+        assert out["loss"] == pytest.approx(ref_total / ref_count)
+    assert not _producer_threads()

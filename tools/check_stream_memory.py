@@ -1,18 +1,28 @@
-"""Peak-HBM proof for per-layer offload streaming (run on a real TPU).
+"""Host/device memory-boundedness proofs for the two streaming paths.
 
-Compiles the LoRA train loss+grad for a GPT-2-medium-shaped stack twice —
-fully resident vs budget-0 streamed — and reports XLA's compiled memory
-analysis. On TPU, host-placed arguments are billed to host memory and the
-streamed program's device footprint is ~one layer of weights + activations;
-this is the rebuild's analog of the reference's RSS benchmark for the
-ParameterSharder (reference: scripts/Finetune/measure_rss.sh:22-42,
-parameter_sharder.cpp:242-271 per-layer require()).
+1. Peak-HBM proof for per-layer offload streaming (needs a real TPU):
+   compiles the LoRA train loss+grad for a GPT-2-medium-shaped stack
+   twice — fully resident vs budget-0 streamed — and reports XLA's
+   compiled memory analysis. On TPU, host-placed arguments are billed to
+   host memory and the streamed program's device footprint is ~one layer
+   of weights + activations; this is the rebuild's analog of the
+   reference's RSS benchmark for the ParameterSharder (reference:
+   scripts/Finetune/measure_rss.sh:22-42, parameter_sharder.cpp:242-271
+   per-layer require()).
+
+2. Host-RAM proof for the async input pipeline (runs anywhere, CPU
+   included): a streaming-mode dataset consumed through the bounded-queue
+   background producer (data/prefetch.py) for hundreds of steps must keep
+   the traced Python/numpy heap inside (resident token window) +
+   (queue depth + lookahead) step batches + slack — i.e. the queue, not
+   the epoch, bounds host memory.
 
 Prints one JSON line:
-  {"ok": bool, "blocks_bytes": N, "resident": {...}, "streamed": {...}}
+  {"ok": bool, "queue": {...}, "blocks_bytes": N, "resident": {...},
+   "streamed": {...}}    (offload keys replaced by "reason" off-TPU)
 
-Used by tests/test_offload.py (subprocess, skipped when no TPU) and
-runnable standalone:  python tools/check_stream_memory.py
+Used by tests/test_offload.py (subprocess, offload part skipped when no
+TPU) and runnable standalone:  python tools/check_stream_memory.py
 """
 
 import json
@@ -26,12 +36,76 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def check_queue_memory(steps: int = 120, warm_steps: int = 10,
+                       depth: int = 4) -> dict:
+    """Prove the prefetch queue keeps host memory bounded in streaming
+    mode. An unbounded producer (or a queue that leaks consumed batches)
+    would grow the heap by ~48 KB x `steps` here (~5.8 MB), ~2x past the
+    asserted bound; the passing state is the resident window + at most
+    depth+lookahead in-flight step batches (measured ~0.8 MB growth).
+    Sized to stay cheap inside tests/test_offload's subprocess run."""
+    import tempfile
+    import tracemalloc
+    import zlib
+
+    from mobilefinetuner_tpu.cli.common import micro_batches
+    from mobilefinetuner_tpu.data.prefetch import Prefetcher
+    from mobilefinetuner_tpu.data.wikitext2 import (WT2Config,
+                                                    WikiText2Dataset)
+
+    B, S, accum = 8, 256, 2
+    window_tokens = 20_000
+    encode = lambda s: [zlib.crc32(w.encode()) % 50_000
+                        for w in s.split()]
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as d:
+        corpus = os.path.join(d, "wiki.train.tokens")
+        with open(corpus, "w") as f:
+            for _ in range(2000):
+                n = int(rng.integers(8, 40))
+                f.write(" ".join(f"w{rng.integers(0, 3000)}"
+                                 for _ in range(n)) + "\n")
+        cfg = WT2Config(seq_len=S, batch_size=B, seed=0, streaming=True,
+                        window_tokens=window_tokens)
+        ds = WikiText2Dataset(corpus, "train", cfg, encode, eos_id=1)
+        stream = Prefetcher((b for _, b in micro_batches(ds, accum)),
+                            depth=depth)
+        try:
+            tracemalloc.start()
+            for _ in range(warm_steps):  # window populated, queue full
+                next(stream)
+            steady, _ = tracemalloc.get_traced_memory()
+            tracemalloc.reset_peak()
+            for _ in range(steps):
+                next(stream)
+            _, peak = tracemalloc.get_traced_memory()
+        finally:
+            stream.close()
+            tracemalloc.stop()
+    step_bytes = accum * B * S * 12  # i32 ids + f32 mask + i32 labels
+    # window tokens resident as i32 + a re-tokenization list of python
+    # ints in flight, + in-flight step batches, + fixed slack for
+    # interpreter noise
+    bound = (window_tokens * 40 + (depth + 4) * step_bytes
+             + 2 * 2 ** 20)
+    growth = peak - steady
+    return {"ok": bool(growth < bound), "steps": steps,
+            "steady_bytes": int(steady), "peak_growth_bytes": int(growth),
+            "bound_bytes": int(bound), "step_bytes": step_bytes,
+            "queue_depth": depth}
+
+
 def main() -> int:
+    queue = check_queue_memory()
     if jax.devices()[0].platform == "cpu":
+        # the offload half needs accelerator memory-space accounting; the
+        # queue half has already run — surface its verdict in the exit
+        # code (2 keeps test_offload's "no TPU" skip contract)
         print(json.dumps({"ok": False,
                           "reason": "cpu backend has no host/device "
-                                    "memory-space accounting"}))
-        return 2
+                                    "memory-space accounting",
+                          "queue": queue}))
+        return 2 if queue["ok"] else 1
 
     from mobilefinetuner_tpu.core.config import GPT2Config
     from mobilefinetuner_tpu.lora.lora import LoRASpec, init_lora_gpt2
@@ -84,8 +158,10 @@ def main() -> int:
     ok = (stm["dev_args"] < blocks_bytes / 10
           and stm["host_args"] > 0.8 * blocks_bytes
           and stm["temp"] < 3 * per_layer + 32 * 2 ** 20
-          and dev_peak_stm < dev_peak_res / 2)
-    print(json.dumps({"ok": bool(ok), "blocks_bytes": blocks_bytes,
+          and dev_peak_stm < dev_peak_res / 2
+          and queue["ok"])
+    print(json.dumps({"ok": bool(ok), "queue": queue,
+                      "blocks_bytes": blocks_bytes,
                       "per_layer_bytes": int(per_layer),
                       "resident": res, "streamed": stm}))
     return 0 if ok else 1

@@ -49,26 +49,33 @@ from mobilefinetuner_tpu.train.trainer import (TrainConfig, init_optimizer,
                                                make_train_step)
 
 BASELINE_TOKENS_PER_SEC = 2_391_884 / 18_000.0  # ≈ 132.9 (reference CPU)
-# TPU v5e (lite) peak: 197 TFLOP/s bf16 per chip (public spec). The same
-# number applies to "float32" configs: XLA's default matmul precision on
-# TPU runs f32 matmuls as bf16 passes on the MXU, so the available peak is
-# the bf16 one (measured f32 MFU vs a hypothetical smaller f32 peak came
-# out >1, confirming the default-precision lowering).
-PEAK_FLOPS = {"bfloat16": 197e12, "float32": 197e12}
+# Per-chip bf16 peak resolved from the device kind via the SAME table the
+# training loop's in-loop MFU uses (core/telemetry.device_peak_flops), so
+# the two MFU columns share numerator AND denominator; 197e12 (v5e) is
+# the fallback for unknown kinds (CPU smoke runs — their MFU is not
+# meaningful anyway). The same number applies to "float32" configs:
+# XLA's default matmul precision on TPU runs f32 matmuls as bf16 passes
+# on the MXU, so the available peak is the bf16 one (measured f32 MFU vs
+# a hypothetical smaller f32 peak came out >1, confirming the
+# default-precision lowering). Resolved LAZILY: device_peak_flops()
+# touches jax.devices(), and importing bench must not initialize the
+# backend as a side effect (it would pin a single-process backend under
+# an importer that calls jax.distributed.initialize afterwards).
+from mobilefinetuner_tpu.core.telemetry import device_peak_flops
+
+_PEAK_CACHE = {}
 
 
-def transformer_flops(n_params_active, n_params_frozen, B, S, n_layer,
-                      n_head, head_dim, full_ft):
-    """FLOPs per optimizer step (forward+backward), standard estimate:
-    matmul fwd = 2*N*T; backward dx = 2*N*T always (the loss gradient
-    flows through frozen weights to reach LoRA/embedding sites), dW only
-    for trained weights; + attention 2*2*B*H*S^2*D fwd, doubled in bwd."""
-    T = B * S
-    N = n_params_active + n_params_frozen
-    fwd = 2 * N * T
-    bwd = 2 * N * T + 2 * (n_params_active if not full_ft else N) * T
-    attn = 4 * B * n_layer * n_head * S * S * head_dim
-    return fwd + bwd + 3 * attn
+def peak_flops(dtype: str) -> float:
+    if "chip" not in _PEAK_CACHE:
+        _PEAK_CACHE["chip"] = device_peak_flops() or 197e12
+    return _PEAK_CACHE["chip"]
+
+
+# The analytic per-step FLOP estimator lives in core/telemetry.py so the
+# in-loop step_stats.mfu and this suite's MFU column agree by
+# construction (tests/test_bench_contract.py pins the identity).
+from mobilefinetuner_tpu.core.telemetry import transformer_flops  # noqa: E402
 
 
 def executed_flops(n_block_mm, n_head_mm, n_active, B, S, n_layer, n_head,
@@ -645,12 +652,12 @@ def finish(name, r, dtype, steps) -> dict:
         "config": name,
         "tokens_per_sec_per_chip": round(toks_per_sec, 1),
         "vs_baseline": round(toks_per_sec / BASELINE_TOKENS_PER_SEC, 2),
-        "mfu": round(r["flops"] * steps / r["dt"] / PEAK_FLOPS[dtype], 4),
+        "mfu": round(r["flops"] * steps / r["dt"] / peak_flops(dtype), 4),
         # mfu from XLA's executed-FLOP count (remat recompute included,
         # embedding gathers excluded); mfu above is the standard 6ND-style
         # formula — both published so neither misleads alone
         "mfu_executed": (round(r["flops_exec"] * steps / r["dt"]
-                               / PEAK_FLOPS[dtype], 4)
+                               / peak_flops(dtype), 4)
                          if r.get("flops_exec") else None),
         "peak_hbm_mb": round(r["peak_bytes"] / 2 ** 20, 1),
         # held-out loss after >= LOSS_MARK_TOKENS training tokens on the
@@ -684,7 +691,8 @@ def main():
         import os
         with open("BENCH_SUITE.json.tmp", "w") as f:
             json.dump({"suite": suite,
-                       "peak_flops_assumed": PEAK_FLOPS,
+                       "peak_flops_assumed": {"bfloat16": peak_flops("bfloat16"),
+                                              "float32": peak_flops("float32")},
                        "baseline_tokens_per_sec": BASELINE_TOKENS_PER_SEC},
                       f, indent=1)
         os.replace("BENCH_SUITE.json.tmp", "BENCH_SUITE.json")

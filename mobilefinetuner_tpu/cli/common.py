@@ -23,7 +23,11 @@ import numpy as np
 
 from mobilefinetuner_tpu.core.logging import (JSONLWriter, MetricsLogger,
                                               get_logger)
-from mobilefinetuner_tpu.core.xla_stats import (compiled_peak_mb,
+from mobilefinetuner_tpu.core.telemetry import (SpikeConfig, SpikeDetector,
+                                                Telemetry, device_peak_flops,
+                                                mfu_from, run_manifest)
+from mobilefinetuner_tpu.core.xla_stats import (compiled_flops,
+                                                compiled_peak_mb,
                                                 live_hbm_mb)
 from mobilefinetuner_tpu.data.prefetch import Prefetcher
 from mobilefinetuner_tpu.data.wikitext2 import WikiText2Dataset
@@ -114,6 +118,26 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
                         "way (incl. resume and multi-host sharding); "
                         "the metrics' host_wait_ms column shows what "
                         "the overlap buys")
+    g.add_argument("--telemetry_out", default="",
+                   help="append-only JSONL run-telemetry stream "
+                        "(core/telemetry.py): run_start manifest, "
+                        "compile, step_stats (loss/mfu/tok_s/health), "
+                        "throttle/eval/checkpoint/anomaly, run_end. "
+                        "Coordinator-only under multi-host; appending "
+                        "to an existing file continues its sequence "
+                        "numbers (crash/resume). Render with "
+                        "tools/telemetry_report.py")
+    g.add_argument("--spike_z", type=float, default=8.0,
+                   help="loss-spike detector: emit an `anomaly` "
+                        "telemetry event when a step's loss exceeds "
+                        "this many EMA standard deviations (host-side, "
+                        "on the flushed metrics; <= 0 disables)")
+    g.add_argument("--spike_beta", type=float, default=0.98,
+                   help="EMA decay of the spike detector's running "
+                        "mean/variance")
+    g.add_argument("--spike_warmup", type=int, default=20,
+                   help="steps observed before the spike detector arms "
+                        "(early-training loss is legitimately wild)")
 
 
 def add_align_flags(p: argparse.ArgumentParser):
@@ -195,7 +219,7 @@ def add_mesh_flags(p: argparse.ArgumentParser):
                    help="this process's id (or JAX_PROCESS_ID; -1 = auto)")
 
 
-def governor_from_args(args) -> StepGovernor:
+def governor_from_args(args, event_sink=None) -> StepGovernor:
     cfg = GovernorConfig(
         enable=args.pm_interval > 0 or bool(args.pm_schedule),
         # 0 = telemetry disabled: a schedule-only run stays full speed on
@@ -212,7 +236,7 @@ def governor_from_args(args) -> StepGovernor:
         manual_battery=None if args.pm_disable_batt else args.pm_manual_batt,
         manual_temp=None if args.pm_disable_temp else args.pm_manual_temp,
     )
-    return StepGovernor(cfg)
+    return StepGovernor(cfg, event_sink=event_sink)
 
 
 def offload_config_from_args(args) -> OffloadConfig:
@@ -452,262 +476,371 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                  mask=None, start_step: int = 0, opt_state=None,
                  save_hook: Optional[Callable] = None,
                  mesh=None, replicate_trainable: bool = True,
-                 dropout_rng=None, step_builder=None):
+                 dropout_rng=None, step_builder=None,
+                 flops_per_step: Optional[float] = None):
     """The shared optimizer-step loop: compiled step + eval cadence + EMA +
-    metrics CSV + JSONL eval records + governor throttle + periodic saves.
+    metrics CSV + JSONL eval records + governor throttle + periodic saves
+    + the run-telemetry event stream (--telemetry_out, core/telemetry.py).
 
     save_hook(step, trainable, opt_state, final) persists checkpoints.
     dropout_rng: base PRNG key; when set, a fresh per-sample key array
     folded with the step index rides in batch["dropout_rng"], so dropout
     masks differ across steps AND micro-batches (a fixed closure key would
     silently reuse one mask for the whole run).
+    flops_per_step: the CLI's analytic transformer_flops estimate for ONE
+    optimizer step — drives the in-loop MFU in the log line, the CSV, and
+    step_stats (None: MFU omitted).
     Returns (trainable, opt_state, last_metrics).
     """
     from mobilefinetuner_tpu.parallel.distributed import (device_put_global,
                                                           gather_to_host,
                                                           is_coordinator)
-    governor = governor_from_args(args)
     # multi-host: every process runs the identical compiled step over global
-    # arrays; file sinks (CSV/JSONL/checkpoints) write once, on process 0.
-    # Saving first gathers cross-process-sharded trees to host on EVERY
-    # process (gather_to_host is collective), then only process 0 writes.
+    # arrays; file sinks (CSV/JSONL/telemetry/checkpoints) write once, on
+    # process 0. Saving first gathers cross-process-sharded trees to host
+    # on EVERY process (gather_to_host is collective), then only process 0
+    # writes.
     coord = is_coordinator()
     multiproc = jax.process_count() > 1
-    metrics_csv = MetricsLogger(args.metrics_csv) \
-        if args.metrics_csv and coord else None
-    eval_jsonl = JSONLWriter(args.eval_out) \
-        if getattr(args, "eval_out", "") and coord else None
-    if save_hook is not None and multiproc:
-        orig_save = save_hook
-
-        def save_hook(step, tr, opt, final=False):
-            tr_h, opt_h = gather_to_host(tr), gather_to_host(opt)
-            if coord:
-                orig_save(step, tr_h, opt_h, final=final)
-    # the eval path must feed global arrays under multi-host (raw host
-    # numpy cannot address a global mesh); single-process keeps the
-    # uncommitted-numpy fast path
-    eval_mesh = mesh if (mesh is not None and multiproc) else None
-    eval_sp = getattr(args, "sequence_parallel", False)
-
-    # step_builder: alternate step factory with make_train_step's contract
-    # (the optimizer-offload path, optim/opt_offload.py, plugs in here)
-    step_fn = (step_builder or make_train_step)(loss_fn, tc, mask=mask,
-                                                donate=True)
-    eval_step = make_eval_step(nll_fn)
-    if opt_state is None:
-        opt_state = init_optimizer(trainable, tc, mask)
-
-    if mesh is not None and replicate_trainable:
-        # LoRA-style tiny trainables: replicate A/B + Adam state; FSDP'd
-        # trainables (full FT) arrive pre-placed and are left alone.
-        repl = replicated_sharding(mesh)
-        trainable = jax.tree.map(
-            lambda x: device_put_global(x, repl), trainable)
-        opt_state = jax.tree.map(
-            lambda x: device_put_global(x, repl), opt_state)
-
-    ema = EMA(args.ema_beta)
-    # async input pipeline: micro-batch assembly (tokenization, streaming
-    # refetch, accum fill) runs in a background producer thread; dropout
-    # keys + device placement are issued one batch AHEAD on the consumer
-    # side, so batch k+1's host->HBM transfer overlaps step k's compute.
-    # --prefetch 0 collapses to the synchronous path (same interface,
-    # byte-identical batch sequence).
-    prefetch_depth = max(getattr(args, "prefetch", 2), 0)
-    sp = getattr(args, "sequence_parallel", False)
-    place_batch = make_batch_placer(mesh, sp)
-
-    def numbered_batches():
-        gen = micro_batches(train_ds, tc.grad_accum_steps,
-                            skip_steps=start_step)
-        for step in itertools.count(start_step):
-            epoch, batch = next(gen)
-            yield step, epoch, batch
-
-    def place_step(item):
-        step, epoch, batch = item
-        if dropout_rng is not None:
-            nb = batch["input_ids"].shape[0]
-            batch["dropout_rng"] = jax.random.split(
-                jax.random.fold_in(dropout_rng, step), nb)
-        return step, epoch, place_batch(batch)
-
-    # max(..., 0): a resume at/after total_steps runs zero steps (the loop
-    # below is empty) and must not build a stream at all
-    stream = Prefetcher(
-        itertools.islice(numbered_batches(),
-                         max(total_steps - start_step, 0)),
-        depth=prefetch_depth, place_fn=place_step, lookahead=1)
+    tel = Telemetry(getattr(args, "telemetry_out", ""), enabled=coord)
+    tel.emit("run_start", **run_manifest(vars(args), mesh))
     t_start = time.time()
-    metrics = {}
-    epoch = 0
-    compiled_step = None       # AOT-compiled at the first step
-    peak_hbm = {"mb": 0.0}     # from the compiled step's memory analysis
-    profile_dir = getattr(args, "profile_dir", "")
-    prof_start = start_step + getattr(args, "profile_start", 10)
-    prof_end = prof_start + getattr(args, "profile_steps", 5)
-    prof_active = False
-
-    def maybe_profile(step):
-        nonlocal prof_active
-        if not profile_dir:
-            return
-        try:
-            if step == prof_start and not prof_active:
-                jax.profiler.start_trace(profile_dir)
-                prof_active = True
-            elif step >= prof_end and prof_active:
-                if metrics:
-                    jax.device_get(metrics["loss"])  # drain queued work
-                jax.profiler.stop_trace()
-                prof_active = False
-                log.info(f"profiler trace -> {profile_dir}")
-        except Exception as e:  # profiling must never kill training
-            log.warning(f"profiler: {e}")
-            prof_active = False
-
-    # Per-step metrics stay on device; they are buffered and pulled to host
-    # in ONE device_get per log boundary. An unconditional per-step
-    # float(loss) would sync the dispatch queue every step and serialize
-    # the pipeline (the reference has no such concern: it is synchronous
-    # CPU code; on TPU async dispatch is the throughput lever).
-    buffered = []  # [(step, epoch, tokens, device_metrics), ...]
-    t_interval = time.perf_counter()
-    slept_ms = 0.0  # governor sleep inside the interval, excluded from dt
-    waited_ms = 0.0  # host-wait: step loop blocked on the input pipeline
-    # flush cadence: the log interval; if step logging is off but a CSV was
-    # requested, flush every 50 steps so rows survive a crash; 1000-step
-    # hard cap bounds the device-metrics buffer in all cases.
-    flush_every = (min(args.log_interval, 1000) if args.log_interval
-                   else (50 if metrics_csv else 1000))
-
-    def flush_metrics(emit_log=True):
-        """One host sync for everything buffered since the last flush.
-        Rows in a flush share the interval-averaged step_time_ms (per-step
-        wall time under async dispatch measures only dispatch latency, so
-        the average over a synced interval is the honest number) and
-        host_wait_ms — the interval-averaged time the step loop spent
-        BLOCKED pulling the next batch from the input pipeline (queue
-        wait + lookahead placement; with the producer keeping up this is
-        ~0, which is the observable proof the prefetch overlap works —
-        the host/device breakdown, not an assumption)."""
-        nonlocal t_interval, slept_ms, waited_ms
-        if not buffered:
-            return
-        fetched = jax.device_get([m for _, _, _, m in buffered])
-        dt_ms = ((time.perf_counter() - t_interval) * 1000 - slept_ms) \
-            / len(buffered)
-        wait_ms = waited_ms / len(buffered)
-        hbm = live_hbm_mb() or peak_hbm["mb"]
-        for (s, ep, toks, _), m in zip(buffered, fetched):
-            loss = float(m["loss"])
-            avg = ema.update(loss)
-            if metrics_csv:
-                metrics_csv.log(epoch=ep, step=s + 1, loss=loss,
-                                avg_loss=avg, lr=float(m["lr"]),
-                                step_time_ms=dt_ms, host_wait_ms=wait_ms,
-                                hbm_mb=hbm)
-        s, ep, toks, _ = buffered[-1]
-        m = fetched[-1]
-        if emit_log and args.log_interval:
-            log.info(
-                f"step {s + 1}/{total_steps} loss={float(m['loss']):.4f} "
-                f"ema={ema.value:.4f} "
-                f"ppl={perplexity_from_loss(float(m['loss'])):.2f} "
-                f"grad_norm={float(m['grad_norm']):.3f} "
-                f"lr={float(m['lr']):.2e} "
-                f"{toks / (dt_ms / 1000):.0f} tok/s "
-                f"host_wait={wait_ms:.1f}ms")
-        buffered.clear()
-        slept_ms = 0.0
-        waited_ms = 0.0
-        t_interval = time.perf_counter()
-
+    done_steps = 0
+    # EVERYTHING after run_start runs under one handler: a setup
+    # failure (device placement OOM, stream construction) must still
+    # terminate the stream with run_end{exit: <type>} — emit/close
+    # are no-ops once the stream is closed, so the inner handlers
+    # (loop, post-loop tail) and this outer one compose without
+    # double emission.
     try:
-        for step in range(start_step, total_steps):
-            # the prefetched stream yields batches already placed (and
-            # dropout-keyed); this next() is the step loop's only input
-            # dependency, and the time it blocks is the host/device
-            # breakdown's host_wait_ms
-            t_wait = time.perf_counter()
-            step_i, epoch, batch = next(stream)
-            waited_ms += (time.perf_counter() - t_wait) * 1000
-            assert step_i == step  # strict order preservation
-            if compiled_step is None:
-                # AOT compile once: the SAME executable serves every step
-                # (shapes are static), and its memory analysis gives peak
-                # HBM for free — no second trace/compile on the jit cache
-                # path.
-                compiled_step = step_fn.lower(
-                    trainable, frozen, opt_state, batch,
-                    jnp.int32(step)).compile()
-                peak_hbm["mb"] = compiled_peak_mb(compiled_step)
-                if peak_hbm["mb"]:
-                    log.info(f"compiled step peak HBM: "
-                             f"{peak_hbm['mb']:.0f} MB")
-            maybe_profile(step)
-            trainable, opt_state, metrics = compiled_step(
-                trainable, frozen, opt_state, batch, jnp.int32(step))
-            toks = batch["input_ids"].shape[0] * batch["input_ids"].shape[1]
-            buffered.append((step, epoch, toks, metrics))
-            log_boundary = bool(args.log_interval) \
-                and (step + 1) % args.log_interval == 0
-            if log_boundary or (step + 1) % flush_every == 0:
-                # capped flushes (flush_every < log_interval) only write
-                # CSV rows; the log line fires exactly on the requested
-                # cadence
-                flush_metrics(emit_log=log_boundary)
+        governor = governor_from_args(
+            args, event_sink=lambda p: tel.emit("throttle", **p))
+        spikes = SpikeDetector(SpikeConfig(
+            zscore=getattr(args, "spike_z", 8.0),
+            beta=getattr(args, "spike_beta", 0.98),
+            warmup=getattr(args, "spike_warmup", 20)))
+        # flops_per_step covers the GLOBAL batch, so the MFU denominator is
+        # the GLOBAL peak: per-chip peak × every device in the run (a
+        # single-chip run is unchanged; an 8-chip run divided by one chip's
+        # peak would report 8× the true utilization)
+        peak_flops = device_peak_flops() * len(jax.devices())
+        metrics_csv = MetricsLogger(args.metrics_csv) \
+            if args.metrics_csv and coord else None
+        eval_jsonl = JSONLWriter(args.eval_out) \
+            if getattr(args, "eval_out", "") and coord else None
+        if save_hook is not None and multiproc:
+            orig_save = save_hook
 
-            if (args.eval_interval and valid_ds is not None
-                    and (step + 1) % args.eval_interval == 0):
-                flush_metrics(emit_log=False)  # off-cadence boundary flush
+            def save_hook(step, tr, opt, final=False):
+                tr_h, opt_h = gather_to_host(tr), gather_to_host(opt)
+                if coord:
+                    orig_save(step, tr_h, opt_h, final=final)
+        # the eval path must feed global arrays under multi-host (raw host
+        # numpy cannot address a global mesh); single-process keeps the
+        # uncommitted-numpy fast path
+        eval_mesh = mesh if (mesh is not None and multiproc) else None
+        eval_sp = getattr(args, "sequence_parallel", False)
+
+        # step_builder: alternate step factory with make_train_step's contract
+        # (the optimizer-offload path, optim/opt_offload.py, plugs in here)
+        step_fn = (step_builder or make_train_step)(loss_fn, tc, mask=mask,
+                                                    donate=True)
+        eval_step = make_eval_step(nll_fn)
+        if opt_state is None:
+            opt_state = init_optimizer(trainable, tc, mask)
+
+        if mesh is not None and replicate_trainable:
+            # LoRA-style tiny trainables: replicate A/B + Adam state; FSDP'd
+            # trainables (full FT) arrive pre-placed and are left alone.
+            repl = replicated_sharding(mesh)
+            trainable = jax.tree.map(
+                lambda x: device_put_global(x, repl), trainable)
+            opt_state = jax.tree.map(
+                lambda x: device_put_global(x, repl), opt_state)
+
+        ema = EMA(args.ema_beta)
+        # async input pipeline: micro-batch assembly (tokenization, streaming
+        # refetch, accum fill) runs in a background producer thread; dropout
+        # keys + device placement are issued one batch AHEAD on the consumer
+        # side, so batch k+1's host->HBM transfer overlaps step k's compute.
+        # --prefetch 0 collapses to the synchronous path (same interface,
+        # byte-identical batch sequence).
+        prefetch_depth = max(getattr(args, "prefetch", 2), 0)
+        sp = getattr(args, "sequence_parallel", False)
+        place_batch = make_batch_placer(mesh, sp)
+
+        def numbered_batches():
+            gen = micro_batches(train_ds, tc.grad_accum_steps,
+                                skip_steps=start_step)
+            for step in itertools.count(start_step):
+                epoch, batch = next(gen)
+                yield step, epoch, batch
+
+        def place_step(item):
+            step, epoch, batch = item
+            if dropout_rng is not None:
+                nb = batch["input_ids"].shape[0]
+                batch["dropout_rng"] = jax.random.split(
+                    jax.random.fold_in(dropout_rng, step), nb)
+            return step, epoch, place_batch(batch)
+
+        # max(..., 0): a resume at/after total_steps runs zero steps (the loop
+        # below is empty) and must not build a stream at all
+        stream = Prefetcher(
+            itertools.islice(numbered_batches(),
+                             max(total_steps - start_step, 0)),
+            depth=prefetch_depth, place_fn=place_step, lookahead=1)
+        metrics = {}
+        epoch = 0
+        compiled_step = None       # AOT-compiled at the first step
+        peak_hbm = {"mb": 0.0}     # from the compiled step's memory analysis
+        profile_dir = getattr(args, "profile_dir", "")
+        prof_start = start_step + getattr(args, "profile_start", 10)
+        prof_end = prof_start + getattr(args, "profile_steps", 5)
+        prof_active = False
+
+        def maybe_profile(step):
+            nonlocal prof_active
+            if not profile_dir:
+                return
+            try:
+                if step == prof_start and not prof_active:
+                    jax.profiler.start_trace(profile_dir)
+                    prof_active = True
+                elif step >= prof_end and prof_active:
+                    if metrics:
+                        jax.device_get(metrics["loss"])  # drain queued work
+                    jax.profiler.stop_trace()
+                    prof_active = False
+                    log.info(f"profiler trace -> {profile_dir}")
+            except Exception as e:  # profiling must never kill training
+                log.warning(f"profiler: {e}")
+                prof_active = False
+
+        # Per-step metrics stay on device; they are buffered and pulled to host
+        # in ONE device_get per log boundary. An unconditional per-step
+        # float(loss) would sync the dispatch queue every step and serialize
+        # the pipeline (the reference has no such concern: it is synchronous
+        # CPU code; on TPU async dispatch is the throughput lever).
+        buffered = []  # [(step, epoch, tokens, device_metrics), ...]
+        t_interval = time.perf_counter()
+        slept_ms = 0.0  # governor sleep inside the interval, excluded from dt
+        waited_ms = 0.0  # host-wait: step loop blocked on the input pipeline
+        # flush cadence: the log interval; if step logging is off but a CSV was
+        # requested, flush every 50 steps so rows survive a crash; 1000-step
+        # hard cap bounds the device-metrics buffer in all cases.
+        flush_every = (min(args.log_interval, 1000) if args.log_interval
+                       else (50 if metrics_csv else 1000))
+
+        def flush_metrics(emit_log=True):
+            """One host sync for everything buffered since the last flush —
+            the telemetry zero-sync invariant: the on-device health scalars
+            (param_norm/update_ratio/nonfinite_count) ride the SAME
+            device_get as loss/grad_norm/lr, so observability adds no syncs.
+            Rows in a flush share the interval-averaged step_time_ms (per-step
+            wall time under async dispatch measures only dispatch latency, so
+            the average over a synced interval is the honest number) and
+            host_wait_ms — the interval-averaged time the step loop spent
+            BLOCKED pulling the next batch from the input pipeline (queue
+            wait + lookahead placement; with the producer keeping up this is
+            ~0, which is the observable proof the prefetch overlap works —
+            the host/device breakdown, not an assumption). One step_stats
+            telemetry event per flush; the host-side spike detector sees
+            every per-step loss and emits `anomaly` events instead of
+            silently training through divergence."""
+            nonlocal t_interval, slept_ms, waited_ms
+            if not buffered:
+                return
+            fetched = jax.device_get([m for _, _, _, m in buffered])
+            dt_ms = ((time.perf_counter() - t_interval) * 1000 - slept_ms) \
+                / len(buffered)
+            wait_ms = waited_ms / len(buffered)
+            hbm = live_hbm_mb() or peak_hbm["mb"]
+            mfu = mfu_from(flops_per_step, dt_ms / 1000, peak_flops)
+            for (s, ep, toks, _), m in zip(buffered, fetched):
+                loss = float(m["loss"])
+                avg = ema.update(loss)
+                anom = spikes.update(loss)
+                if anom is not None:
+                    tel.emit("anomaly", step=s + 1, loss=loss, ema=avg,
+                             **anom)
+                    log.warning(
+                        f"anomaly @ step {s + 1}: {anom['kind']} "
+                        f"loss={loss:.4f}"
+                        + (f" z={anom['zscore']}" if anom["zscore"] else ""))
+                if metrics_csv:
+                    metrics_csv.log(epoch=ep, step=s + 1, loss=loss,
+                                    avg_loss=avg, lr=float(m["lr"]),
+                                    grad_norm=float(m["grad_norm"]),
+                                    step_time_ms=dt_ms, host_wait_ms=wait_ms,
+                                    tok_s=toks / (dt_ms / 1000), mfu=mfu,
+                                    hbm_mb=hbm)
+            s, ep, toks, _ = buffered[-1]
+            m = fetched[-1]
+            opt_f = lambda k: (float(m[k]) if k in m else None)
+            tel.emit(
+                "step_stats", step=s + 1, loss=float(m["loss"]),
+                ema=float(ema.value), lr=float(m["lr"]),
+                grad_norm=float(m["grad_norm"]), step_time_ms=dt_ms,
+                host_wait_ms=wait_ms, slept_ms=slept_ms,
+                tok_s=toks / (dt_ms / 1000), mfu=mfu,
+                param_norm=opt_f("param_norm"),
+                update_ratio=opt_f("update_ratio"),
+                nonfinite_count=(int(m["nonfinite_count"])
+                                 if "nonfinite_count" in m else None),
+                hbm_mb=hbm, queue_depth=stream.queue_depth())
+            if emit_log and args.log_interval:
+                log.info(
+                    f"step {s + 1}/{total_steps} loss={float(m['loss']):.4f} "
+                    f"ema={ema.value:.4f} "
+                    f"ppl={perplexity_from_loss(float(m['loss'])):.2f} "
+                    f"grad_norm={float(m['grad_norm']):.3f} "
+                    f"lr={float(m['lr']):.2e} "
+                    f"{toks / (dt_ms / 1000):.0f} tok/s "
+                    + (f"mfu={mfu:.3f} " if mfu is not None else "")
+                    + f"host_wait={wait_ms:.1f}ms")
+            buffered.clear()
+            slept_ms = 0.0
+            waited_ms = 0.0
+            t_interval = time.perf_counter()
+
+        try:
+            for step in range(start_step, total_steps):
+                # the prefetched stream yields batches already placed (and
+                # dropout-keyed); this next() is the step loop's only input
+                # dependency, and the time it blocks is the host/device
+                # breakdown's host_wait_ms
+                t_wait = time.perf_counter()
+                step_i, epoch, batch = next(stream)
+                waited_ms += (time.perf_counter() - t_wait) * 1000
+                assert step_i == step  # strict order preservation
+                if compiled_step is None:
+                    # AOT compile once: the SAME executable serves every step
+                    # (shapes are static), and its memory analysis gives peak
+                    # HBM for free — no second trace/compile on the jit cache
+                    # path.
+                    t_comp = time.perf_counter()
+                    compiled_step = step_fn.lower(
+                        trainable, frozen, opt_state, batch,
+                        jnp.int32(step)).compile()
+                    peak_hbm["mb"] = compiled_peak_mb(compiled_step)
+                    xla_flops = compiled_flops(compiled_step)
+                    tel.emit("compile", step=step,
+                             wall_s=round(time.perf_counter() - t_comp, 3),
+                             flops=xla_flops or None,
+                             peak_hbm_mb=peak_hbm["mb"] or None)
+                    if peak_hbm["mb"]:
+                        log.info(f"compiled step peak HBM: "
+                                 f"{peak_hbm['mb']:.0f} MB")
+                maybe_profile(step)
+                trainable, opt_state, metrics = compiled_step(
+                    trainable, frozen, opt_state, batch, jnp.int32(step))
+                toks = batch["input_ids"].shape[0] * batch["input_ids"].shape[1]
+                buffered.append((step, epoch, toks, metrics))
+                log_boundary = bool(args.log_interval) \
+                    and (step + 1) % args.log_interval == 0
+                if log_boundary or (step + 1) % flush_every == 0:
+                    # capped flushes (flush_every < log_interval) only write
+                    # CSV rows; the log line fires exactly on the requested
+                    # cadence
+                    flush_metrics(emit_log=log_boundary)
+
+                if (args.eval_interval and valid_ds is not None
+                        and (step + 1) % args.eval_interval == 0):
+                    flush_metrics(emit_log=False)  # off-cadence boundary flush
+                    ev = evaluate(eval_step, trainable, frozen, valid_ds,
+                                  args.eval_batches, mesh=eval_mesh,
+                                  sequence_parallel=eval_sp,
+                                  prefetch=prefetch_depth)
+                    log.info(f"eval @ step {step + 1}: loss={ev['loss']:.4f} "
+                             f"ppl={ev['ppl']:.2f} ({ev['tokens']} tokens)")
+                    if eval_jsonl:
+                        eval_jsonl.write({"type": "eval", "step": step + 1,
+                                          "loss": ev["loss"], "ppl": ev["ppl"],
+                                          "tokens": ev["tokens"],
+                                          "time": time.time() - t_start})
+                    tel.emit("eval", step=step + 1, loss=ev["loss"],
+                             ppl=ev["ppl"], tokens=ev["tokens"])
+                    t_interval = time.perf_counter()  # eval time ≠ step time
+
+                if args.save_every and save_hook and (step + 1) % \
+                        args.save_every == 0 and (step + 1) < total_steps:
+                    flush_metrics(emit_log=False)  # off-cadence boundary flush
+                    t_save = time.perf_counter()
+                    save_hook(step + 1, trainable, opt_state, final=False)
+                    tel.emit("checkpoint", step=step + 1, final=False,
+                             wall_s=round(time.perf_counter() - t_save, 3))
+                    t_interval = time.perf_counter()  # save time ≠ step time
+
+                slept_ms += governor.throttle(step)
+                done_steps = step + 1 - start_step
+        except BaseException as e:
+            # the stream records HOW the run ended before the exception
+            # propagates — a crashed run's tail is run_start..last flush +
+            # run_end{exit: <type>}, which is what post-mortems need
+            tel.emit("run_end", steps=done_steps,
+                     wall_s=round(time.time() - t_start, 3),
+                     exit=type(e).__name__)
+            tel.close()
+            raise
+        finally:
+            # stop the producer thread even when the consumer dies mid-epoch
+            # (compiled-step failure, KeyboardInterrupt): no leaked threads,
+            # and the original exception propagates untouched
+            stream.close()
+            # profiler-leak fix: a run whose total_steps end (or whose
+            # exception) lands inside the profiling window used to leave the
+            # trace open — stop_trace() was only reachable from inside the
+            # step loop. Closing here makes the trace land on EVERY exit
+            # path (regression: tests/test_cli.py short-run profile test).
+            if prof_active:
+                maybe_profile(prof_end)
+
+        # the post-loop tail (final flush/eval/save) carries the same
+        # run_end-on-exception contract as the loop: a disk-full save or a
+        # lost-worker collective here must still leave run_end{exit: <type>}
+        try:
+            flush_metrics()
+            if valid_ds is not None and args.eval_interval:
                 ev = evaluate(eval_step, trainable, frozen, valid_ds,
                               args.eval_batches, mesh=eval_mesh,
                               sequence_parallel=eval_sp,
                               prefetch=prefetch_depth)
-                log.info(f"eval @ step {step + 1}: loss={ev['loss']:.4f} "
-                         f"ppl={ev['ppl']:.2f} ({ev['tokens']} tokens)")
+                log.info(f"final eval: loss={ev['loss']:.4f} "
+                         f"ppl={ev['ppl']:.2f}")
                 if eval_jsonl:
-                    eval_jsonl.write({"type": "eval", "step": step + 1,
+                    eval_jsonl.write({"type": "final_eval",
+                                      "step": total_steps,
                                       "loss": ev["loss"], "ppl": ev["ppl"],
-                                      "tokens": ev["tokens"],
-                                      "time": time.time() - t_start})
-                t_interval = time.perf_counter()  # eval time ≠ step time
-
-            if args.save_every and save_hook and (step + 1) % \
-                    args.save_every == 0 and (step + 1) < total_steps:
-                flush_metrics(emit_log=False)  # off-cadence boundary flush
-                save_hook(step + 1, trainable, opt_state, final=False)
-                t_interval = time.perf_counter()  # save time ≠ step time
-
-            slept_ms += governor.throttle(step)
-    finally:
-        # stop the producer thread even when the consumer dies mid-epoch
-        # (compiled-step failure, KeyboardInterrupt): no leaked threads,
-        # and the original exception propagates untouched
-        stream.close()
-
-    if prof_active:
-        maybe_profile(prof_end)  # close an unfinished trace
-    flush_metrics()
-    if valid_ds is not None and args.eval_interval:
-        ev = evaluate(eval_step, trainable, frozen, valid_ds,
-                      args.eval_batches, mesh=eval_mesh,
-                      sequence_parallel=eval_sp, prefetch=prefetch_depth)
-        log.info(f"final eval: loss={ev['loss']:.4f} ppl={ev['ppl']:.2f}")
-        if eval_jsonl:
-            eval_jsonl.write({"type": "final_eval", "step": total_steps,
-                              "loss": ev["loss"], "ppl": ev["ppl"],
-                              "tokens": ev["tokens"]})
-    if save_hook:
-        save_hook(total_steps, trainable, opt_state, final=True)
-    live = live_hbm_mb()
-    log.info(f"peak HBM: {peak_hbm['mb']:.0f} MB (compiled estimate)"
-             + (f", {live:.0f} MB live" if live else ""))
-    if metrics_csv:
-        metrics_csv.close()
-    return trainable, opt_state, metrics
+                                      "tokens": ev["tokens"]})
+                tel.emit("eval", step=total_steps, loss=ev["loss"],
+                         ppl=ev["ppl"], tokens=ev["tokens"])
+            if save_hook:
+                t_save = time.perf_counter()
+                save_hook(total_steps, trainable, opt_state, final=True)
+                tel.emit("checkpoint", step=total_steps, final=True,
+                         wall_s=round(time.perf_counter() - t_save, 3))
+        except BaseException as e:
+            tel.emit("run_end", steps=done_steps,
+                     wall_s=round(time.time() - t_start, 3),
+                     exit=type(e).__name__)
+            tel.close()
+            raise
+        live = live_hbm_mb()
+        log.info(f"peak HBM: {peak_hbm['mb']:.0f} MB (compiled estimate)"
+                 + (f", {live:.0f} MB live" if live else ""))
+        if metrics_csv:
+            metrics_csv.close()
+        tel.emit("run_end", steps=total_steps - start_step,
+                 wall_s=round(time.time() - t_start, 3), exit="ok")
+        tel.close()
+        return trainable, opt_state, metrics
+    except BaseException as e:
+        tel.emit("run_end", steps=done_steps,
+                 wall_s=round(time.time() - t_start, 3),
+                 exit=type(e).__name__)
+        tel.close()
+        raise
 
 
 def setup_frozen_params(args, params, mesh):

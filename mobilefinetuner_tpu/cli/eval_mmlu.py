@@ -60,6 +60,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "self-describing")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
                    default="float32")
+    p.add_argument("--telemetry_out", default="",
+                   help="JSONL run-telemetry stream (core/telemetry.py)")
     p.add_argument("--eval_batch", type=int, default=16,
                    help="items per forward (bucketed batching; the "
                         "reference runs per-item — on the MXU that "
@@ -124,6 +126,13 @@ def make_batched_logits_fn(hidden_fn, head_key, compute_dtype, params,
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    import time as _time
+    from mobilefinetuner_tpu.core.telemetry import Telemetry, run_manifest
+    from mobilefinetuner_tpu.parallel.distributed import is_coordinator
+    tel = Telemetry(getattr(args, "telemetry_out", ""),
+                    enabled=is_coordinator())
+    tel.emit("run_start", **run_manifest(vars(args)))
+    t0 = _time.time()
     (hidden_fn, head_key, compute_dtype, tok, letter_encode, max_len,
      params, lora) = setup_family(args)
 
@@ -175,6 +184,15 @@ def main(argv=None) -> int:
     log.info(f"macro={result.macro:.4f} micro={result.micro:.4f}")
     if args.out:
         JSONLWriter(args.out).write(report)
+    # an accuracy eval is not NLL-shaped: loss/ppl are null (the schema
+    # allows it) and the real result rides as accuracy fields, which
+    # telemetry_report renders
+    tel.emit("eval", step=result.total, loss=None, ppl=None,
+             tokens=result.total, macro_accuracy=report["macro_accuracy"],
+             micro_accuracy=report["micro_accuracy"])
+    tel.emit("run_end", steps=result.total,
+             wall_s=round(_time.time() - t0, 3), exit="ok")
+    tel.close()
     print(json.dumps({"macro_accuracy": report["macro_accuracy"],
                       "micro_accuracy": report["micro_accuracy"],
                       "total_items": result.total,

@@ -70,6 +70,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="async input pipeline depth (background batch "
                         "producer + device-placement lookahead, "
                         "data/prefetch.py); 0 = synchronous")
+    p.add_argument("--telemetry_out", default="",
+                   help="JSONL run-telemetry stream (core/telemetry.py): "
+                        "run_start manifest + eval progress + run_end")
     return p
 
 
@@ -125,6 +128,10 @@ def main(argv=None) -> int:
                           eos_id, pad_id=pad_id)
 
     jsonl = JSONLWriter(args.out) if args.out else None
+    from mobilefinetuner_tpu.core.telemetry import Telemetry, run_manifest
+    from mobilefinetuner_tpu.parallel.distributed import is_coordinator
+    tel = Telemetry(args.telemetry_out, enabled=is_coordinator())
+    tel.emit("run_start", **run_manifest(vars(args)))
     # device-side accumulation: per-batch float(s)/int(c) forced a full
     # device sync per eval step — the sums stay on device (tiny adds on
     # the async dispatch queue) and come to host only at progress-log
@@ -154,6 +161,8 @@ def main(argv=None) -> int:
                     jsonl.write({"type": "progress", "batch": n + 1,
                                  "nll": mean,
                                  "ppl": perplexity_from_loss(mean)})
+                tel.emit("eval", step=n + 1, loss=mean,
+                         ppl=perplexity_from_loss(mean), tokens=int(k))
     if n_done:
         total, count = jax.device_get((total, count))
     total, count = (float(total), int(count)) if n_done else (0.0, 0)
@@ -167,6 +176,10 @@ def main(argv=None) -> int:
     log.info(f"{args.split} ppl={ppl:.3f} nll={mean:.4f} ({count} tokens)")
     if jsonl:
         jsonl.write(record)
+    tel.emit("eval", step=n_done, loss=mean, ppl=ppl, tokens=count)
+    tel.emit("run_end", steps=n_done,
+             wall_s=round(time.time() - t0, 3), exit="ok")
+    tel.close()
     print(json.dumps(record))
     return 0
 

@@ -209,12 +209,21 @@ def main(argv=None) -> int:
                                 tc.adam())
         log.info(f"saved full model -> {path}")
 
+    # in-loop MFU from the shared estimator (core/telemetry.py)
+    from mobilefinetuner_tpu.core.telemetry import transformer_flops
+    flops = transformer_flops(
+        sum(int(x.size) for x in jax.tree.leaves(params)), 0,
+        args.batch_size * tc.grad_accum_steps, args.seq_len,
+        config.num_hidden_layers, config.num_attention_heads,
+        config.head_dim, full_ft=True)
+
     common.run_training(
         args, trainable=params, frozen=None, loss_fn=loss_fn,
         nll_fn=nll_fn, train_ds=train_ds, valid_ds=valid_ds,
         total_steps=total_steps, tc=tc, mask=None, start_step=start_step,
         opt_state=opt_state, save_hook=save_hook, mesh=mesh,
-        replicate_trainable=False, step_builder=step_builder)
+        replicate_trainable=False, step_builder=step_builder,
+        flops_per_step=flops)
     return 0
 
 

@@ -132,12 +132,20 @@ def main(argv=None) -> int:
         adam_mod.save_state(path + ".opt", jax.device_get(opt_st), tc.adam())
         log.info(f"saved full model -> {path}")
 
+    # in-loop MFU from the shared estimator (core/telemetry.py)
+    from mobilefinetuner_tpu.core.telemetry import transformer_flops
+    flops = transformer_flops(
+        gpt2.param_count(params), 0,
+        args.batch_size * tc.grad_accum_steps, args.seq_len,
+        config.n_layer, config.n_head, config.head_dim, full_ft=True)
+
     common.run_training(
         args, trainable=params, frozen=None, loss_fn=loss_fn,
         nll_fn=nll_fn, train_ds=train_ds, valid_ds=valid_ds,
         total_steps=total_steps, tc=tc, mask=None, start_step=start_step,
         opt_state=opt_state, save_hook=save_hook, mesh=mesh,
-        replicate_trainable=False, dropout_rng=base_rng)
+        replicate_trainable=False, dropout_rng=base_rng,
+        flops_per_step=flops)
     return 0
 
 

@@ -208,11 +208,21 @@ def main(argv=None) -> int:
                                 base_model_name=args.pretrained_dir)
             log.info(f"PEFT export -> {args.peft_export_dir}")
 
+    # in-loop MFU: the SAME analytic estimator as bench.py's MFU column
+    # (core/telemetry.transformer_flops), per GLOBAL optimizer step
+    from mobilefinetuner_tpu.core.telemetry import transformer_flops
+    flops = transformer_flops(
+        sum(int(x.size) for x in jax.tree.leaves(lora)),
+        gpt2.param_count(params), args.batch_size * tc.grad_accum_steps,
+        args.seq_len, config.n_layer, config.n_head, config.head_dim,
+        full_ft=False)
+
     common.run_training(
         args, trainable=lora, frozen=params, loss_fn=loss_fn, nll_fn=nll_fn,
         train_ds=train_ds, valid_ds=valid_ds, total_steps=total_steps,
         tc=tc, mask=mask, start_step=start_step, opt_state=opt_state,
-        save_hook=save_hook, mesh=mesh, dropout_rng=base_rng)
+        save_hook=save_hook, mesh=mesh, dropout_rng=base_rng,
+        flops_per_step=flops)
     return 0
 
 

@@ -219,11 +219,21 @@ def main(argv=None) -> int:
                                 jax.device_get(lora_t), spec, "gemma",
                                 base_model_name=args.model_dir)
 
+    # in-loop MFU from the shared estimator (core/telemetry.py)
+    from mobilefinetuner_tpu.core.telemetry import transformer_flops
+    flops = transformer_flops(
+        sum(int(x.size) for x in jax.tree.leaves(lora)),
+        sum(int(x.size) for x in jax.tree.leaves(params)),
+        args.batch_size * tc.grad_accum_steps, args.seq_len,
+        config.num_hidden_layers, config.num_attention_heads,
+        config.head_dim, full_ft=False)
+
     common.run_training(
         args, trainable=lora, frozen=params, loss_fn=loss_fn, nll_fn=nll_fn,
         train_ds=train_ds, valid_ds=valid_ds, total_steps=total_steps,
         tc=tc, mask=mask, start_step=start_step, opt_state=opt_state,
-        save_hook=save_hook, mesh=mesh, dropout_rng=base_rng)
+        save_hook=save_hook, mesh=mesh, dropout_rng=base_rng,
+        flops_per_step=flops)
     return 0
 
 

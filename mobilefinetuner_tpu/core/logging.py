@@ -48,17 +48,25 @@ class MetricsLogger:
     """CSV training-metrics sink, one row per logged step.
 
     Columns mirror the reference MetricsLogger (logger.h:131-190) plus
-    two TPU-native observability columns: hbm_mb — the analog of the
-    reference's per-interval memory prints (main.cpp:639-642): live
-    device bytes-in-use when the platform exposes memory_stats(), else
-    the compiled peak estimate the caller provides — and host_wait_ms,
-    the interval-averaged time the step loop blocked pulling the next
-    batch from the input pipeline (the host share of the host/device
-    step-time breakdown; ~0 when the async prefetcher keeps up).
+    the TPU-native observability columns: grad_norm (pre-clip global
+    norm — printed in the log line since round 0 but only now persisted);
+    hbm_mb — the analog of the reference's per-interval memory prints
+    (main.cpp:639-642): live device bytes-in-use when the platform
+    exposes memory_stats(), else the compiled peak estimate the caller
+    provides; host_wait_ms, the interval-averaged time the step loop
+    blocked pulling the next batch from the input pipeline (the host
+    share of the host/device step-time breakdown; ~0 when the async
+    prefetcher keeps up); tok_s, interval tokens/sec; and mfu, the
+    model-FLOP utilization from the shared estimator
+    (core/telemetry.transformer_flops — blank when the chip's peak is
+    unknown, e.g. CPU). A resumed pre-change CSV is rotated to .old by
+    the header-mismatch check below; tools/plot_loss.py reads both
+    schemas.
     """
 
     COLUMNS = ["timestamp", "epoch", "step", "loss", "avg_loss", "lr",
-               "step_time_ms", "host_wait_ms", "hbm_mb"]
+               "grad_norm", "step_time_ms", "host_wait_ms", "tok_s",
+               "mfu", "hbm_mb"]
 
     def __init__(self, path: str):
         self.path = path
@@ -80,10 +88,13 @@ class MetricsLogger:
 
     def log(self, epoch: int, step: int, loss: float, avg_loss: float,
             lr: float, step_time_ms: float, host_wait_ms: float = 0.0,
-            hbm_mb: float = 0.0):
+            hbm_mb: float = 0.0, grad_norm: float = 0.0,
+            tok_s: float = 0.0, mfu=None):
         self._w.writerow([f"{time.time():.3f}", epoch, step, f"{loss:.6f}",
                           f"{avg_loss:.6f}", f"{lr:.8f}",
-                          f"{step_time_ms:.2f}", f"{host_wait_ms:.2f}",
+                          f"{grad_norm:.4f}", f"{step_time_ms:.2f}",
+                          f"{host_wait_ms:.2f}", f"{tok_s:.1f}",
+                          "" if mfu is None else f"{mfu:.4f}",
                           f"{hbm_mb:.1f}"])
         self._f.flush()
 

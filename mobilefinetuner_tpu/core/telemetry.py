@@ -1,0 +1,391 @@
+"""Unified run-telemetry: append-only JSONL event stream, loss-spike
+detection, and the shared FLOP/MFU accounting.
+
+The reference ships a real observability stack for its mobile loop —
+leveled Logger, CSV MetricsLogger, the RSS/performance monitors
+(performance_monitor.h), and the energy telemetry feeding the throttler
+(power_monitor.cpp) — but none of it is machine-readable per RUN: there
+is no record of why a step was slow, whether the run stayed healthy, or
+what fraction of peak FLOPs it achieved. This module is the TPU
+rebuild's answer: one run-scoped, crash-durable JSONL stream that every
+training/eval entry point writes through, with a fixed event taxonomy
+(`EVENT_SCHEMA`) that tools/telemetry_report.py and
+tests/test_telemetry.py both validate against, so the contract cannot
+drift from the implementation.
+
+Design rules (DESIGN.md §13):
+  - coordinator-only sink: under multi-host every process computes the
+    same metrics, but only process 0 writes (same rule as the CSV/JSONL
+    sinks in cli/common.run_training);
+  - crash-durable: every event is written and flushed individually, so
+    a killed run keeps everything up to its last completed flush; a
+    resumed run APPENDS to the same stream, continuing the monotonic
+    `seq` from the last valid line (a truncated tail line — the process
+    died mid-write — is skipped, not fatal);
+  - zero-sync invariant: nothing here touches the device. On-device
+    health metrics (train/trainer.py param_norm, update_ratio,
+    nonfinite_count) ride the step loop's existing buffered-metrics
+    device_get; telemetry only formats what that single fetch returned.
+
+MFU accounting lives here — `transformer_flops` was lifted OUT of
+bench.py (which now imports it) so the benchmark's MFU column and the
+in-loop `step_stats.mfu` agree by construction
+(tests/test_bench_contract.py pins the identity).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+# --------------------------- event taxonomy ---------------------------------
+
+_NUM = (int, float)
+_OPT_NUM = (int, float, type(None))
+_OPT_STR = (str, type(None))
+
+# Per-event required payload fields and their allowed types. Every event
+# additionally carries the envelope: event (str), seq (int, monotonic per
+# stream), t (float unix time). Extra fields are ALLOWED (the schema is a
+# floor, not a ceiling) so events can grow without breaking old readers.
+EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
+    # one per run, always the stream's first event of that run
+    "run_start": {
+        "jax_version": (str,),
+        "mesh_shape": (dict, type(None)),
+        "process_count": (int,),
+        "process_index": (int,),
+        "device_kind": (str,),
+        "device_count": (int,),
+        "config": (dict,),
+    },
+    # one per compiled executable (wall time + XLA's own FLOP count +
+    # compiled-peak HBM from the memory analysis)
+    "compile": {
+        "step": (int,),
+        "wall_s": _NUM,
+        "flops": _OPT_NUM,          # compiled.cost_analysis(); None if n/a
+        "peak_hbm_mb": _OPT_NUM,
+    },
+    # periodic, one per metrics flush (interval-averaged timings; the
+    # loss/health fields are the interval's LAST step). loss/ema are
+    # null exactly when the value was non-finite (strict-JSON rule).
+    "step_stats": {
+        "step": (int,),
+        "loss": _OPT_NUM,
+        "ema": _OPT_NUM,
+        "lr": _NUM,
+        "grad_norm": _OPT_NUM,
+        "step_time_ms": _NUM,
+        "host_wait_ms": _NUM,
+        "slept_ms": _OPT_NUM,       # governor sleep inside the interval
+        "tok_s": _NUM,
+        "mfu": _OPT_NUM,            # None when peak FLOPs unknown (CPU)
+        "param_norm": _OPT_NUM,     # None on step builders without the
+        "update_ratio": _OPT_NUM,   # on-device health metrics
+        "nonfinite_count": _OPT_NUM,
+        "hbm_mb": _NUM,
+        "queue_depth": _OPT_NUM,    # input-pipeline gauge (None: no stream)
+    },
+    # governor throttle decision (system/governor.py event_sink)
+    "throttle": {
+        "step": (int,),
+        "sleep_ms": _NUM,
+        "battery": _OPT_NUM,
+        "temp": _OPT_NUM,
+        "source": (str,),           # "schedule" | "telemetry"
+    },
+    # host-side loss-spike / divergence detector fired (loss is null
+    # exactly for kind=nonfinite_loss — strict-JSON rule)
+    "anomaly": {
+        "step": (int,),
+        "kind": (str,),             # "loss_spike" | "nonfinite_loss"
+        "loss": _OPT_NUM,
+        "ema": _OPT_NUM,
+        "zscore": _OPT_NUM,
+    },
+    # loss/ppl are null for evals that aren't NLL-shaped (eval_mmlu
+    # reports macro_accuracy/micro_accuracy as extra fields instead)
+    "eval": {
+        "step": (int,),
+        "loss": _OPT_NUM,
+        "ppl": _OPT_NUM,
+        "tokens": (int,),
+    },
+    "checkpoint": {
+        "step": (int,),
+        "final": (bool,),
+        "wall_s": _NUM,
+    },
+    # one per run on orderly exit; exit != "ok" names the exception type
+    "run_end": {
+        "steps": (int,),
+        "wall_s": _NUM,
+        "exit": (str,),
+    },
+}
+
+
+def validate_event(rec: Any) -> Optional[str]:
+    """None if `rec` satisfies the contract, else a human-readable reason.
+    Shared by tests/test_telemetry.py and tools/telemetry_report.py so the
+    validator cannot fork from the schema."""
+    if not isinstance(rec, dict):
+        return f"not an object: {type(rec).__name__}"
+    ev = rec.get("event")
+    if ev not in EVENT_SCHEMA:
+        return f"unknown event type: {ev!r}"
+    if not isinstance(rec.get("seq"), int) or rec["seq"] < 0:
+        return f"{ev}: bad seq {rec.get('seq')!r}"
+    if not isinstance(rec.get("t"), (int, float)):
+        return f"{ev}: bad t {rec.get('t')!r}"
+    for field, types in EVENT_SCHEMA[ev].items():
+        if field not in rec:
+            return f"{ev}: missing field {field!r}"
+        v = rec[field]
+        # bool is an int subclass; reject it where a number is expected
+        if isinstance(v, bool) and bool not in types:
+            return f"{ev}.{field}: bool where {types} expected"
+        if not isinstance(v, types):
+            return f"{ev}.{field}: {type(v).__name__} not in {types}"
+    return None
+
+
+# --------------------------- the JSONL sink ---------------------------------
+
+def _last_seq(path: str) -> int:
+    """Highest seq among the file's valid JSONL lines (-1 when none).
+    Scans the whole file: it is read once at open, and a telemetry stream
+    is small (one step_stats per flush, not per step)."""
+    last = -1
+    try:
+        with open(path, "rb") as f:
+            for raw in f:
+                try:
+                    rec = json.loads(raw)
+                    s = rec.get("seq")
+                    if isinstance(s, int):
+                        last = max(last, s)
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    continue  # truncated tail line from a crashed writer
+    except OSError:
+        return -1
+    return last
+
+
+def _json_finite(v):
+    """Replace non-finite floats (recursively) with None so every
+    emitted line is strict RFC 8259 JSON."""
+    if isinstance(v, float) and not math.isfinite(v):
+        return None
+    if isinstance(v, dict):
+        return {k: _json_finite(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_finite(x) for x in v]
+    return v
+
+
+class Telemetry:
+    """Append-only JSONL event stream, one record per `emit` call.
+
+    A falsy `path` (or enabled=False — how non-coordinator processes are
+    muted) makes every method a no-op, so call sites never branch.
+    Appending to an existing file continues its seq numbering — the
+    crash/resume contract: one stream per run directory, ordered across
+    process restarts.
+    """
+
+    def __init__(self, path: str = "", enabled: bool = True):
+        self.path = path
+        self.enabled = bool(path) and enabled
+        self._f = None
+        self._seq = 0
+        if self.enabled:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            if os.path.exists(path):
+                self._seq = _last_seq(path) + 1
+            self._f = open(path, "a", encoding="utf-8")
+            # a killed writer can leave a partial line with NO trailing
+            # newline; terminate it so this run's first event starts a
+            # fresh line instead of gluing itself onto the corpse
+            if self._f.tell() > 0:
+                with open(path, "rb") as rf:
+                    rf.seek(-1, os.SEEK_END)
+                    if rf.read(1) != b"\n":
+                        self._f.write("\n")
+                        self._f.flush()
+
+    def emit(self, event: str, **fields) -> Optional[dict]:
+        """Append one event; returns the record (None when disabled).
+        Per-event flush: the stream survives a SIGKILL mid-run.
+        Non-finite floats are serialized as null — json.dumps' default
+        NaN/Infinity literals are invalid RFC 8259 and would break strict
+        consumers (jq, JSON.parse) on exactly the divergence records the
+        stream exists to capture; the `anomaly` event's kind field
+        carries the non-finiteness."""
+        if not self.enabled or self._f is None:
+            return None
+        rec = {"event": event, "seq": self._seq, "t": time.time(),
+               **{k: _json_finite(v) for k, v in fields.items()}}
+        self._seq += 1
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+        return rec
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        self.enabled = False
+
+    def __enter__(self) -> "Telemetry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def run_manifest(config: dict, mesh=None) -> dict:
+    """The run_start payload: everything needed to interpret the rest of
+    the stream (flags, jax version, topology). `config` must be
+    JSON-able (argparse vars() is)."""
+    import jax
+    return {
+        "jax_version": jax.__version__,
+        "mesh_shape": dict(mesh.shape) if mesh is not None else None,
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+        "device_kind": jax.devices()[0].device_kind,
+        "device_count": len(jax.devices()),
+        "config": {k: v for k, v in sorted(config.items())
+                   if isinstance(v, (str, int, float, bool, type(None)))},
+    }
+
+
+# --------------------------- loss-spike detector ----------------------------
+
+@dataclasses.dataclass
+class SpikeConfig:
+    """EMA + z-score divergence detector knobs (--spike_* flags).
+    zscore <= 0 disables the detector entirely."""
+    zscore: float = 8.0    # fire when (loss - ema) / std exceeds this
+    beta: float = 0.98     # EMA decay for mean AND variance
+    warmup: int = 20       # observations before the detector arms
+
+
+class SpikeDetector:
+    """Host-side loss-spike detector over the flushed per-step losses.
+
+    Keeps an EMA of the loss and an EMA of squared deviation; a step
+    whose z-score exceeds the threshold (after warmup) is an anomaly —
+    the run keeps training (policy belongs to the operator, not the
+    loop) but the event stream records exactly when it went wrong
+    instead of silently training through divergence. A non-finite loss
+    is always anomalous, warmup or not.
+    """
+
+    def __init__(self, config: Optional[SpikeConfig] = None):
+        self.config = config or SpikeConfig()
+        self.mean: Optional[float] = None
+        self.var: float = 0.0
+        self.count: int = 0
+        self._nonfinite: bool = False  # inside a non-finite run?
+
+    def update(self, loss: float) -> Optional[dict]:
+        """Feed one per-step loss; returns {kind, zscore} when anomalous,
+        else None. A spiking sample is WINSORIZED into the EMA (clamped
+        to mean + zscore·std) rather than excluded or taken raw: raw
+        inclusion would let one spike inflate the variance and mask the
+        next, full exclusion would mean a persistent level-shift (e.g. a
+        LR bump settling loss on a new plateau) fires on every step
+        forever — clamped updates walk the EMA toward the new level, so
+        the detector re-arms after the transition."""
+        c = self.config
+        if c.zscore <= 0:
+            return None
+        if not math.isfinite(loss):
+            # NaN is absorbing (every later loss stays NaN): fire on the
+            # TRANSITION only, or a 100k-step diverged run would emit one
+            # anomaly line per remaining step — the same stream-sizing
+            # rule the throttle events follow
+            if self._nonfinite:
+                return None
+            self._nonfinite = True
+            return {"kind": "nonfinite_loss", "zscore": None}
+        self._nonfinite = False
+        if self.mean is None:
+            self.mean, self.count = loss, 1
+            return None
+        dev = loss - self.mean
+        std = math.sqrt(self.var)
+        z = dev / std if std > 0 else 0.0
+        armed = self.count >= c.warmup
+        out = None
+        if armed and std > 0 and z > c.zscore:
+            out = {"kind": "loss_spike", "zscore": round(z, 2)}
+            loss = self.mean + c.zscore * std  # winsorize
+            dev = loss - self.mean
+        self.mean = c.beta * self.mean + (1 - c.beta) * loss
+        self.var = c.beta * self.var + (1 - c.beta) * dev * dev
+        self.count += 1
+        return out
+
+
+# --------------------------- FLOP / MFU accounting --------------------------
+
+def transformer_flops(n_params_active, n_params_frozen, B, S, n_layer,
+                      n_head, head_dim, full_ft):
+    """FLOPs per optimizer step (forward+backward), standard estimate:
+    matmul fwd = 2*N*T; backward dx = 2*N*T always (the loss gradient
+    flows through frozen weights to reach LoRA/embedding sites), dW only
+    for trained weights; + attention 2*2*B*H*S^2*D fwd, doubled in bwd.
+
+    Lifted out of bench.py so the benchmark MFU column and the training
+    loop's step_stats.mfu use the SAME estimator by construction
+    (tests/test_bench_contract.py pins `bench.transformer_flops is
+    telemetry.transformer_flops`)."""
+    T = B * S
+    N = n_params_active + n_params_frozen
+    fwd = 2 * N * T
+    bwd = 2 * N * T + 2 * (n_params_active if not full_ft else N) * T
+    attn = 4 * B * n_layer * n_head * S * S * head_dim
+    return fwd + bwd + 3 * attn
+
+
+# bf16 dense peak FLOP/s per chip, by device_kind substring (public specs).
+# Matched longest-substring-first so "v5 lite" wins over "v5".
+DEVICE_PEAK_FLOPS = {
+    "v5 lite": 197e12, "v5litepod": 197e12, "v5e": 197e12,
+    "v6 lite": 918e12, "v6e": 918e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def device_peak_flops(device_kind: Optional[str] = None) -> float:
+    """Peak bf16 FLOP/s for this chip; 0.0 when unknown (e.g. CPU — MFU
+    is then reported as None rather than against a made-up peak)."""
+    if device_kind is None:
+        import jax
+        device_kind = jax.devices()[0].device_kind
+    kind = device_kind.lower()
+    for sub in sorted(DEVICE_PEAK_FLOPS, key=len, reverse=True):
+        if sub in kind:
+            return DEVICE_PEAK_FLOPS[sub]
+    return 0.0
+
+
+def mfu_from(flops_per_step: Optional[float], step_time_s: float,
+             peak_flops: float) -> Optional[float]:
+    """Model FLOP utilization for one step; None when either side of the
+    ratio is unknown (no analytic estimate, or no known peak)."""
+    if not flops_per_step or step_time_s <= 0 or peak_flops <= 0:
+        return None
+    return flops_per_step / step_time_s / peak_flops

@@ -41,11 +41,37 @@ def shaped_all_gathers(compiled, shape, dtypes=("f32", "bf16")) -> list:
             if "all-gather" in ln and any(n in ln for n in needles)]
 
 
-def live_hbm_mb() -> float:
-    """Device bytes-in-use, when the platform exposes memory_stats()
-    (the tunneled TPU platform does not; CPU and direct TPU do)."""
+def live_hbm_mb(devices=None) -> float:
+    """MAX device bytes-in-use across the local devices, when the
+    platform exposes memory_stats() (the tunneled TPU platform does not;
+    CPU and direct TPU do). The max — not device 0 — because shards can
+    be imbalanced (e.g. a vocab-parallel embed remainder landing on one
+    chip) and the binding constraint is the fullest device.
+    `devices`: override for tests; defaults to jax.local_devices()."""
+    if devices is None:
+        try:
+            devices = jax.local_devices()
+        except Exception:
+            return 0.0
+    peak = 0.0
+    for d in devices:
+        try:
+            stats = d.memory_stats() or {}
+            peak = max(peak, stats.get("bytes_in_use", 0) / 2 ** 20)
+        except Exception:
+            continue  # a device without stats must not zero the others
+    return peak
+
+
+def compiled_flops(compiled) -> float:
+    """XLA's own FLOP count for a compiled executable, from
+    cost_analysis() — 0.0 when the backend does not report it. Absorbs
+    the API's version skew (list-of-dicts per device on older jax, a
+    flat dict on newer)."""
     try:
-        stats = jax.local_devices()[0].memory_stats() or {}
-        return stats.get("bytes_in_use", 0) / 2 ** 20
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return float(ca.get("flops", 0.0)) if hasattr(ca, "get") else 0.0
     except Exception:
         return 0.0

@@ -85,8 +85,9 @@ class Prefetcher:
         self._closed = False
         self._error: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
+        self._q = None
         if depth > 0:
-            self._q: queue.Queue = queue.Queue(maxsize=depth)
+            self._q = queue.Queue(maxsize=depth)
             self._stop = threading.Event()
             self._thread = threading.Thread(
                 target=self._produce, args=(source,),
@@ -157,6 +158,16 @@ class Prefetcher:
         if err is not None:
             raise err
         raise StopIteration
+
+    def queue_depth(self) -> int:
+        """Instantaneous gauge: host batches buffered ahead of the
+        consumer (producer queue + placed lookahead buffer). A healthy
+        pipeline sits near its depth; a gauge stuck at 0 means the
+        producer is the bottleneck — the telemetry step_stats field that
+        tells a host-bound run from a device-bound one without a
+        profiler. 0 on the synchronous (depth=0) path."""
+        q = self._q.qsize() if self._q is not None else 0
+        return q + len(self._buf)
 
     # -- lifecycle -----------------------------------------------------------
 

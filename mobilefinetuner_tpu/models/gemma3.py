@@ -112,76 +112,79 @@ def _block(c: Gemma3TextConfig, bp, x, padding_mask, masks, ropes,
 
     a = bp["attn"]
 
-    # --- attention, sandwich-normed
-    h = rms_norm(x, bp["input_ln"], eps)
-    q = lora(h @ a["q_w"], h, "q_proj", 0)
-    k = lora(h @ a["k_w"], h, "k_proj", 1)
-    v = lora(h @ a["v_w"], h, "v_proj", 2)
-    q = q.reshape(B, S, nq, D).transpose(0, 2, 1, 3)
-    k = k.reshape(B, S, nkv, D).transpose(0, 2, 1, 3)
-    v = v.reshape(B, S, nkv, D).transpose(0, 2, 1, 3)
-    q = rms_norm(q, a["q_norm"], eps)
-    k = rms_norm(k, a["k_norm"], eps)
-    cos = jnp.where(is_global[i], ropes["cos_g"], ropes["cos_l"])
-    sin = jnp.where(is_global[i], ropes["sin_g"], ropes["sin_l"])
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
-    kv_out = (k, v) if collect_kv else None
-    scale = c.query_pre_attn_scalar ** -0.5
-    impl = c.attention_impl
-    if impl == "auto":
-        # resolved here (not inside attention()) because the flash path
-        # needs the flag-based branch below instead of mask matrices
-        from mobilefinetuner_tpu.ops.attention import resolve_impl
-        impl = resolve_impl(S, D)
-    if cp_mesh is not None:
-        # sequence-parallel: ring attention over the mesh axis; the
-        # global/local choice is a traced bool under the layer scan, so
-        # branch with lax.cond like the flash path
-        from mobilefinetuner_tpu.parallel.ring_attention import \
-            ring_attention
-        ctx = jax.lax.cond(
-            is_global[i],
-            lambda ops: ring_attention(*ops, cp_mesh, axis=cp_axis,
-                                       scale=scale, is_causal=True,
-                                       padding_mask=padding_mask),
-            lambda ops: ring_attention(*ops, cp_mesh, axis=cp_axis,
-                                       scale=scale, is_causal=True,
-                                       sliding_window=c.sliding_window,
-                                       padding_mask=padding_mask),
-            (q, k, v))
-    elif impl == "flash":
-        # The Pallas kernel takes causal/sliding-window as STATIC config,
-        # not a mask matrix; under the layer scan the global/local choice is
-        # a traced bool, so branch with lax.cond (each branch compiles its
-        # own kernel variant).
-        ctx = jax.lax.cond(
-            is_global[i],
-            lambda ops: attention(*ops, impl="flash", scale=scale,
-                                  is_causal=True,
-                                  padding_mask=padding_mask),
-            lambda ops: attention(*ops, impl="flash", scale=scale,
-                                  is_causal=True,
-                                  sliding_window=c.sliding_window,
-                                  padding_mask=padding_mask),
-            (q, k, v))
-    else:
-        mask = jnp.where(is_global[i], masks["global"], masks["local"])
-        ctx = attention(q, k, v, impl=impl, scale=scale,
-                        is_causal=False, attn_mask=mask,
-                        padding_mask=padding_mask)
-    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, nq * D)
-    attn_out = lora(ctx @ a["o_w"], ctx, "o_proj", 3)
-    attn_out = rms_norm(attn_out, bp["post_attn_ln"], eps)
-    x = x + attn_out
+    # --- attention, sandwich-normed (named scopes label the phase in
+    # profiler traces and compiled-HLO op metadata, DESIGN.md §13)
+    with jax.named_scope("attention"):
+        h = rms_norm(x, bp["input_ln"], eps)
+        q = lora(h @ a["q_w"], h, "q_proj", 0)
+        k = lora(h @ a["k_w"], h, "k_proj", 1)
+        v = lora(h @ a["v_w"], h, "v_proj", 2)
+        q = q.reshape(B, S, nq, D).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, nkv, D).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, nkv, D).transpose(0, 2, 1, 3)
+        q = rms_norm(q, a["q_norm"], eps)
+        k = rms_norm(k, a["k_norm"], eps)
+        cos = jnp.where(is_global[i], ropes["cos_g"], ropes["cos_l"])
+        sin = jnp.where(is_global[i], ropes["sin_g"], ropes["sin_l"])
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kv_out = (k, v) if collect_kv else None
+        scale = c.query_pre_attn_scalar ** -0.5
+        impl = c.attention_impl
+        if impl == "auto":
+            # resolved here (not inside attention()) because the flash
+            # path needs the flag-based branch below instead of masks
+            from mobilefinetuner_tpu.ops.attention import resolve_impl
+            impl = resolve_impl(S, D)
+        if cp_mesh is not None:
+            # sequence-parallel: ring attention over the mesh axis; the
+            # global/local choice is a traced bool under the layer scan,
+            # so branch with lax.cond like the flash path
+            from mobilefinetuner_tpu.parallel.ring_attention import \
+                ring_attention
+            ctx = jax.lax.cond(
+                is_global[i],
+                lambda ops: ring_attention(*ops, cp_mesh, axis=cp_axis,
+                                           scale=scale, is_causal=True,
+                                           padding_mask=padding_mask),
+                lambda ops: ring_attention(*ops, cp_mesh, axis=cp_axis,
+                                           scale=scale, is_causal=True,
+                                           sliding_window=c.sliding_window,
+                                           padding_mask=padding_mask),
+                (q, k, v))
+        elif impl == "flash":
+            # The Pallas kernel takes causal/sliding-window as STATIC
+            # config, not a mask matrix; under the layer scan the
+            # global/local choice is a traced bool, so branch with
+            # lax.cond (each branch compiles its own kernel variant).
+            ctx = jax.lax.cond(
+                is_global[i],
+                lambda ops: attention(*ops, impl="flash", scale=scale,
+                                      is_causal=True,
+                                      padding_mask=padding_mask),
+                lambda ops: attention(*ops, impl="flash", scale=scale,
+                                      is_causal=True,
+                                      sliding_window=c.sliding_window,
+                                      padding_mask=padding_mask),
+                (q, k, v))
+        else:
+            mask = jnp.where(is_global[i], masks["global"], masks["local"])
+            ctx = attention(q, k, v, impl=impl, scale=scale,
+                            is_causal=False, attn_mask=mask,
+                            padding_mask=padding_mask)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, nq * D)
+        attn_out = lora(ctx @ a["o_w"], ctx, "o_proj", 3)
+        attn_out = rms_norm(attn_out, bp["post_attn_ln"], eps)
+        x = x + attn_out
 
     # --- MLP, sandwich-normed
-    h = rms_norm(x, bp["pre_ffn_ln"], eps)
-    gate = lora(h @ bp["mlp"]["gate_w"], h, "gate_proj", 4)
-    up = lora(h @ bp["mlp"]["up_w"], h, "up_proj", 5)
-    act = gelu_tanh(gate) * up
-    down = lora(act @ bp["mlp"]["down_w"], act, "down_proj", 6)
-    down = rms_norm(down, bp["post_ffn_ln"], eps)
+    with jax.named_scope("mlp"):
+        h = rms_norm(x, bp["pre_ffn_ln"], eps)
+        gate = lora(h @ bp["mlp"]["gate_w"], h, "gate_proj", 4)
+        up = lora(h @ bp["mlp"]["up_w"], h, "up_proj", 5)
+        act = gelu_tanh(gate) * up
+        down = lora(act @ bp["mlp"]["down_w"], act, "down_proj", 6)
+        down = rms_norm(down, bp["post_ffn_ln"], eps)
     if collect_kv:
         return x + down, kv_out
     return x + down
@@ -210,20 +213,21 @@ def hidden_states(config: Gemma3TextConfig, params, input_ids,
     if offload is not None:
         params, block_stream = resolve_offload(params, offload)
     stream = block_stream
-    if (cp_mesh is not None and cp_axis in cp_mesh.axis_names
-            and c.vocab_size % cp_mesh.shape[cp_axis] == 0
-            and S % cp_mesh.shape[cp_axis] == 0):
-        # sequence-parallel + V-sharded tied table: the structural
-        # vocab-parallel lookup — GSPMD left alone all-gathers the full
-        # table here at large mesh sizes (ops/loss.vp_embed_lookup)
-        from mobilefinetuner_tpu.ops.loss import vp_embed_lookup
-        x = vp_embed_lookup(params["embed"], input_ids, cp_mesh,
-                            vocab_axis=cp_axis).astype(compute_dtype)
-    else:
-        x = params["embed"][input_ids].astype(compute_dtype)
-    # sqrt(hidden) embedding scaling, computed in the embed dtype as HF does
-    normalizer = jnp.asarray(c.hidden_size ** 0.5, compute_dtype)
-    x = x * normalizer
+    with jax.named_scope("embed"):
+        if (cp_mesh is not None and cp_axis in cp_mesh.axis_names
+                and c.vocab_size % cp_mesh.shape[cp_axis] == 0
+                and S % cp_mesh.shape[cp_axis] == 0):
+            # sequence-parallel + V-sharded tied table: the structural
+            # vocab-parallel lookup — GSPMD left alone all-gathers the
+            # full table here at large mesh sizes (ops/loss.vp_embed_lookup)
+            from mobilefinetuner_tpu.ops.loss import vp_embed_lookup
+            x = vp_embed_lookup(params["embed"], input_ids, cp_mesh,
+                                vocab_axis=cp_axis).astype(compute_dtype)
+        else:
+            x = params["embed"][input_ids].astype(compute_dtype)
+        # sqrt(hidden) embedding scaling, in the embed dtype as HF does
+        normalizer = jnp.asarray(c.hidden_size ** 0.5, compute_dtype)
+        x = x * normalizer
 
     if attention_mask is not None:
         # mask-derived positions (HF convention) so left-padded batches get

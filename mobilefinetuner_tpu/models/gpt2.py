@@ -105,49 +105,54 @@ def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx,
                           None if rng is None
                           else jax.random.fold_in(rng, site))
 
-    h = layer_norm(x, bp["ln_1"]["g"], bp["ln_1"]["b"], eps)
-    qkv = h @ bp["attn"]["qkv_w"] + bp["attn"]["qkv_b"]
-    qkv = lora(qkv, h, "attn_qkv", 0)
-    # split-QKV adapters hit only their column range of the fused c_attn
-    # output (reference: lora_injector.h:169-191 col_offset/col_size)
-    if lora_b is not None:
-        from mobilefinetuner_tpu.lora.lora import GPT2_SPLIT_QKV_SLOTS
-        for name, slot in GPT2_SPLIT_QKV_SLOTS.items():
-            if name in lora_b:
-                sl = (Ellipsis, slice(slot * E, (slot + 1) * E))
-                qkv = qkv.at[sl].set(lora(qkv[sl], h, name, 4 + slot))
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    to_heads = lambda t: t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
-    kv_out = (to_heads(k), to_heads(v)) if collect_kv else None
-    attn_rng = (None if rng is None or config.attn_pdrop <= 0.0
-                else jax.random.fold_in(rng, 9))
-    if cp_mesh is not None:
-        from mobilefinetuner_tpu.parallel.ring_attention import \
-            ring_attention
-        ctx = ring_attention(to_heads(q), to_heads(k), to_heads(v),
-                             cp_mesh, axis=cp_axis, is_causal=True,
-                             padding_mask=padding_mask)
-    else:
-        ctx = attention(to_heads(q), to_heads(k), to_heads(v),
-                        impl=config.attention_impl, is_causal=True,
-                        padding_mask=padding_mask,
-                        attn_dropout=config.attn_pdrop,
-                        attn_dropout_rng=attn_rng)
-    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, E)
-    proj = ctx @ bp["attn"]["proj_w"] + bp["attn"]["proj_b"]
-    proj = lora(proj, ctx, "attn_proj", 1)
-    proj = _dropout(proj, config.resid_pdrop,
-                    None if rng is None else jax.random.fold_in(rng, 7))
-    x = x + proj
+    # named scopes label the phase in profiler traces AND compiled-HLO
+    # op metadata (asserted by tests/test_telemetry.py; DESIGN.md §13)
+    with jax.named_scope("attention"):
+        h = layer_norm(x, bp["ln_1"]["g"], bp["ln_1"]["b"], eps)
+        qkv = h @ bp["attn"]["qkv_w"] + bp["attn"]["qkv_b"]
+        qkv = lora(qkv, h, "attn_qkv", 0)
+        # split-QKV adapters hit only their column range of the fused
+        # c_attn output (reference: lora_injector.h:169-191
+        # col_offset/col_size)
+        if lora_b is not None:
+            from mobilefinetuner_tpu.lora.lora import GPT2_SPLIT_QKV_SLOTS
+            for name, slot in GPT2_SPLIT_QKV_SLOTS.items():
+                if name in lora_b:
+                    sl = (Ellipsis, slice(slot * E, (slot + 1) * E))
+                    qkv = qkv.at[sl].set(lora(qkv[sl], h, name, 4 + slot))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        kv_out = (to_heads(k), to_heads(v)) if collect_kv else None
+        attn_rng = (None if rng is None or config.attn_pdrop <= 0.0
+                    else jax.random.fold_in(rng, 9))
+        if cp_mesh is not None:
+            from mobilefinetuner_tpu.parallel.ring_attention import \
+                ring_attention
+            ctx = ring_attention(to_heads(q), to_heads(k), to_heads(v),
+                                 cp_mesh, axis=cp_axis, is_causal=True,
+                                 padding_mask=padding_mask)
+        else:
+            ctx = attention(to_heads(q), to_heads(k), to_heads(v),
+                            impl=config.attention_impl, is_causal=True,
+                            padding_mask=padding_mask,
+                            attn_dropout=config.attn_pdrop,
+                            attn_dropout_rng=attn_rng)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, E)
+        proj = ctx @ bp["attn"]["proj_w"] + bp["attn"]["proj_b"]
+        proj = lora(proj, ctx, "attn_proj", 1)
+        proj = _dropout(proj, config.resid_pdrop,
+                        None if rng is None else jax.random.fold_in(rng, 7))
+        x = x + proj
 
-    h = layer_norm(x, bp["ln_2"]["g"], bp["ln_2"]["b"], eps)
-    fc = h @ bp["mlp"]["fc_w"] + bp["mlp"]["fc_b"]
-    fc = lora(fc, h, "mlp_fc_in", 2)
-    act = gelu_new(fc)
-    out = act @ bp["mlp"]["proj_w"] + bp["mlp"]["proj_b"]
-    out = lora(out, act, "mlp_fc_out", 3)
-    out = _dropout(out, config.resid_pdrop,
-                   None if rng is None else jax.random.fold_in(rng, 8))
+    with jax.named_scope("mlp"):
+        h = layer_norm(x, bp["ln_2"]["g"], bp["ln_2"]["b"], eps)
+        fc = h @ bp["mlp"]["fc_w"] + bp["mlp"]["fc_b"]
+        fc = lora(fc, h, "mlp_fc_in", 2)
+        act = gelu_new(fc)
+        out = act @ bp["mlp"]["proj_w"] + bp["mlp"]["proj_b"]
+        out = lora(out, act, "mlp_fc_out", 3)
+        out = _dropout(out, config.resid_pdrop,
+                       None if rng is None else jax.random.fold_in(rng, 8))
     if collect_kv:
         return x + out, kv_out
     return x + out
@@ -181,19 +186,21 @@ def hidden_states(config: GPT2Config, params, input_ids,
     if offload is not None:
         params, block_stream = resolve_offload(params, offload)
     stream = block_stream
-    if attention_mask is not None:
-        # HF convention: position ids count only unmasked tokens, so
-        # left-padded batches line up with HF GPT-2 exactly.
-        positions = jnp.clip(
-            jnp.cumsum(attention_mask.astype(jnp.int32), axis=-1) - 1, 0)
-        pos_emb = params["wpe"][positions]
-    else:
-        pos_emb = params["wpe"][:S][None, :, :]
-    x = params["wte"][input_ids] + pos_emb
-    x = x.astype(compute_dtype)
-    x = _dropout(x, config.embd_pdrop,
-                 None if dropout_rng is None
-                 else jax.random.fold_in(dropout_rng, 1000))
+    with jax.named_scope("embed"):
+        if attention_mask is not None:
+            # HF convention: position ids count only unmasked tokens, so
+            # left-padded batches line up with HF GPT-2 exactly.
+            positions = jnp.clip(
+                jnp.cumsum(attention_mask.astype(jnp.int32), axis=-1) - 1,
+                0)
+            pos_emb = params["wpe"][positions]
+        else:
+            pos_emb = params["wpe"][:S][None, :, :]
+        x = params["wte"][input_ids] + pos_emb
+        x = x.astype(compute_dtype)
+        x = _dropout(x, config.embd_pdrop,
+                     None if dropout_rng is None
+                     else jax.random.fold_in(dropout_rng, 1000))
     padding_mask = attention_mask
     from mobilefinetuner_tpu.parallel.offload import layer_slicer
     slice_layer = layer_slicer(params["blocks"], stream, compute_dtype)

@@ -229,7 +229,7 @@ def _fwd(q, k, v, padding_mask, seed, *, scale, causal, window, block_q,
     kernel = functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
                                block_k=block_k, causal=causal,
                                window=window, S=S, p_drop=p_drop)
-    out, lse = pl.pallas_call(
+    call = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -256,7 +256,11 @@ def _fwd(q, k, v, padding_mask, seed, *, scale, causal, window, block_q,
             jax.ShapeDtypeStruct((B, Hq, S, 1), jnp.float32),
         ],
         **tpu_call_params("parallel", "parallel", "parallel"),
-    )(q, k, v, pad3, seed)
+    )
+    # semantic trace annotation: the kernel shows up as attention/flash_fwd
+    # in profiler traces and HLO metadata (DESIGN.md §13)
+    with jax.named_scope("attention"), jax.named_scope("flash_fwd"):
+        out, lse = call(q, k, v, pad3, seed)
     return out, lse
 
 
@@ -509,7 +513,7 @@ def _bwd_merged(scale, causal, window, block_q, block_k, p_drop, q, k, v,
     kernel = functools.partial(
         _dkvq_kernel, scale=scale, block_q=block_q, block_k=block_k,
         causal=causal, window=window, S=S, p_drop=p_drop)
-    dq, dk_p, dv_p = pl.pallas_call(
+    call = pl.pallas_call(
         kernel,
         grid=(B, Hq, S // block_k),
         in_specs=[
@@ -546,7 +550,9 @@ def _bwd_merged(scale, causal, window, block_q, block_k, p_drop, q, k, v,
         ],
         scratch_shapes=[pltpu.VMEM((S, D), jnp.float32)],
         **tpu_call_params("parallel", "parallel", "arbitrary"),
-    )(q, k, v, pad3, seed, lse, delta, do)
+    )
+    with jax.named_scope("attention"), jax.named_scope("flash_bwd_merged"):
+        dq, dk_p, dv_p = call(q, k, v, pad3, seed, lse, delta, do)
     if G > 1:
         dk = dk_p.reshape(B, Hkv, G, S, D).sum(axis=2)
         dv = dv_p.reshape(B, Hkv, G, S, D).sum(axis=2)
@@ -584,7 +590,7 @@ def _bwd(scale, causal, window, block_q, block_k, res, g, dlse=None,
     dq_kernel = functools.partial(
         _dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
         causal=causal, window=window, S=S, p_drop=p_drop)
-    dq = pl.pallas_call(
+    dq_call = pl.pallas_call(
         dq_kernel,
         grid=(B, Hq, S // block_q),
         in_specs=[
@@ -611,14 +617,16 @@ def _bwd(scale, causal, window, block_q, block_k, res, g, dlse=None,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, Hq, S, D), q.dtype),
         **tpu_call_params("parallel", "parallel", "parallel"),
-    )(q, k, v, pad3, seed, lse, delta, do)
+    )
+    with jax.named_scope("attention"), jax.named_scope("flash_bwd_dq"):
+        dq = dq_call(q, k, v, pad3, seed, lse, delta, do)
 
     dkv_kernel = functools.partial(
         _dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
         causal=causal, window=window, S=S, G=G, p_drop=p_drop)
     # head dim innermost: a kv-head's G q-heads hit the same dk/dv block on
     # consecutive steps (safe accumulate); fully parallel when G == 1
-    dk, dv = pl.pallas_call(
+    dkv_call = pl.pallas_call(
         dkv_kernel,
         grid=(B, S // block_k, Hq),
         in_specs=[
@@ -654,7 +662,9 @@ def _bwd(scale, causal, window, block_q, block_k, res, g, dlse=None,
         ],
         **tpu_call_params("parallel", "parallel",
                           "parallel" if G == 1 else "arbitrary"),
-    )(q, k, v, pad3, seed, lse, delta, do)
+    )
+    with jax.named_scope("attention"), jax.named_scope("flash_bwd_dkv"):
+        dk, dv = dkv_call(q, k, v, pad3, seed, lse, delta, do)
     return dq, dk.astype(k.dtype), dv.astype(v.dtype), None, None
 
 

@@ -135,7 +135,7 @@ def _fwd(h2, w, labels2):
     bv = _pick_block_v_or_raise(V, R, H, h2.dtype.itemsize)
     n = V // bv
     kernel = functools.partial(_fwd_kernel, block_v=bv, n_tiles=n)
-    lse, gold = pl.pallas_call(
+    call = pl.pallas_call(
         kernel,
         grid=(n,),
         in_specs=[
@@ -162,7 +162,10 @@ def _fwd(h2, w, labels2):
             pltpu.VMEM((R, 1), jnp.float32),
         ],
         **tpu_call_params("arbitrary"),
-    )(h2, w, labels2)
+    )
+    # phase label for profiler traces / HLO metadata (DESIGN.md §13)
+    with jax.named_scope("loss"), jax.named_scope("fused_ce_fwd"):
+        lse, gold = call(h2, w, labels2)
     return lse[:, 0], gold[:, 0]
 
 
@@ -222,7 +225,7 @@ def _bwd_dh(h2, w, labels2, lse2, dlse2, dgold2):
     n = V // bv
     kernel = functools.partial(_dh_kernel, block_v=bv, n_tiles=n)
     row = lambda vi: (0, 0)
-    return pl.pallas_call(
+    call = pl.pallas_call(
         kernel,
         grid=(n,),
         in_specs=[
@@ -238,7 +241,9 @@ def _bwd_dh(h2, w, labels2, lse2, dlse2, dgold2):
         out_shape=jax.ShapeDtypeStruct((R, H), jnp.float32),
         scratch_shapes=[pltpu.VMEM((R, H), jnp.float32)],
         **tpu_call_params("arbitrary"),
-    )(h2, w, labels2, lse2, dlse2, dgold2)
+    )
+    with jax.named_scope("loss"), jax.named_scope("fused_ce_bwd_dh"):
+        return call(h2, w, labels2, lse2, dlse2, dgold2)
 
 
 def _bwd_dw(h2, w, labels2, lse2, dlse2, dgold2):
@@ -248,7 +253,7 @@ def _bwd_dw(h2, w, labels2, lse2, dlse2, dgold2):
     n = V // bv
     kernel = functools.partial(_dw_kernel, block_v=bv)
     row = lambda vi: (0, 0)
-    return pl.pallas_call(
+    call = pl.pallas_call(
         kernel,
         grid=(n,),
         in_specs=[
@@ -264,7 +269,9 @@ def _bwd_dw(h2, w, labels2, lse2, dlse2, dgold2):
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((V, H), jnp.float32),
         **tpu_call_params("arbitrary"),
-    )(h2, w, labels2, lse2, dlse2, dgold2)
+    )
+    with jax.named_scope("loss"), jax.named_scope("fused_ce_bwd_dw"):
+        return call(h2, w, labels2, lse2, dlse2, dgold2)
 
 
 # ------------------------------ public entry --------------------------------

@@ -59,13 +59,21 @@ def init_state(params, config: AdamConfig,
 
 
 def adam_update(grads, state: dict, params, config: AdamConfig,
-                lr: jnp.ndarray, mask: Optional[Any] = None
-                ) -> Tuple[Any, dict]:
-    """One Adam step: returns (new_params, new_state).
+                lr: jnp.ndarray, mask: Optional[Any] = None,
+                with_norms: bool = False):
+    """One Adam step: returns (new_params, new_state), or with
+    with_norms=True (new_params, new_state, (update_norm, param_norm)).
 
     lr is a traced scalar so LR schedules don't retrigger compilation.
     mask: pytree of bools — False leaves pass through unchanged (used to
     freeze LoRA "scale" leaves and any non-trainable params).
+    with_norms: also return the global L2 norm of the applied update
+    Δw = -lr·(m̂/(√v̂+ε) [+ wd·w]) and of the PRE-update trainable
+    params, both accumulated INSIDE the per-leaf update where the delta
+    already exists — a post-hoc `new_params - params` would keep the
+    donated pre-update tree alive past the in-place update and cost a
+    params-sized peak-HBM bump on full fine-tunes. Only masked-True
+    (trainable) leaves contribute.
     """
     step = state["step"] + 1
     b1, b2 = config.beta1, config.beta2
@@ -74,7 +82,7 @@ def adam_update(grads, state: dict, params, config: AdamConfig,
 
     def leaf_update(p, g, m, v, vh, do):
         if not do:
-            return p, m, v, vh
+            return p, m, v, vh, None, None
         g = g.astype(jnp.float32)
         pf = p.astype(jnp.float32)
         if config.coupled_weight_decay and config.weight_decay:
@@ -91,7 +99,10 @@ def adam_update(grads, state: dict, params, config: AdamConfig,
         upd = m_hat / denom
         if not config.coupled_weight_decay and config.weight_decay:
             upd = upd + config.weight_decay * pf
-        return (pf - lr * upd).astype(p.dtype), m2, v2, vh2
+        delta = lr * upd
+        usq = jnp.sum(delta * delta) if with_norms else None
+        psq = jnp.sum(pf * pf) if with_norms else None
+        return (pf - delta).astype(p.dtype), m2, v2, vh2, usq, psq
 
     leaves_p, treedef = jax.tree.flatten(params)
     leaves_g = treedef.flatten_up_to(grads)
@@ -111,6 +122,14 @@ def adam_update(grads, state: dict, params, config: AdamConfig,
                  "v": treedef.unflatten([o[2] for o in out])}
     if config.amsgrad:
         new_state["v_hat"] = treedef.unflatten([o[3] for o in out])
+    if with_norms:
+        usq = [o[4] for o in out if o[4] is not None]
+        psq = [o[5] for o in out if o[5] is not None]
+        upd_norm = jnp.sqrt(jnp.sum(jnp.stack(usq))) if usq \
+            else jnp.float32(0.0)
+        w_norm = jnp.sqrt(jnp.sum(jnp.stack(psq))) if psq \
+            else jnp.float32(0.0)
+        return new_p, new_state, (upd_norm, w_norm)
     return new_p, new_state
 
 

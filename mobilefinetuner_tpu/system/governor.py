@@ -92,17 +92,36 @@ class StepGovernor:
 
     Telemetry readers default to the manual injections in the config; a real
     platform can pass `battery_fn` / `temp_fn` callables.
+
+    `event_sink`: optional callable(dict); a throttle() that actually
+    sleeps reports {step, sleep_ms, battery, temp, source} through it —
+    the run-telemetry `throttle` event (core/telemetry.py), so duty-cycle
+    decisions that silently stretch step time become visible in the
+    event stream instead of looking like a slow device. Events fire on
+    DECISION CHANGES (a different sleep_ms or source than the last
+    emitted), not per sleeping step: a steady `--pm_schedule "0-:100"`
+    run emits ONE event, not one per step — the event stream stays
+    small (telemetry's own sizing rule), while the per-interval sleep
+    TOTAL rides in step_stats.slept_ms.
     """
 
     def __init__(self, config: GovernorConfig,
                  battery_fn: Optional[Callable[[], float]] = None,
-                 temp_fn: Optional[Callable[[], float]] = None):
+                 temp_fn: Optional[Callable[[], float]] = None,
+                 event_sink: Optional[Callable[[dict], object]] = None):
         self.config = config
         self._schedule = parse_schedule(config.schedule)
         self._battery_fn = battery_fn
         self._temp_fn = temp_fn
+        self._event_sink = event_sink
         self._cached_sleep_ms = 0.0
         self._last_check_step: Optional[int] = None
+        # last SAMPLED sensor values (set by _telemetry_sleep_ms) — the
+        # throttle event reports these instead of re-reading possibly
+        # expensive sensor callables outside the check cadence
+        self._last_battery: Optional[float] = None
+        self._last_temp: Optional[float] = None
+        self._last_emitted = None  # (sleep_ms, source) of the last event
 
     # -- telemetry ----------------------------------------------------------
     def set_manual_telemetry(self, battery: Optional[float] = None,
@@ -125,9 +144,24 @@ class StepGovernor:
         return self._temp_fn() if self._temp_fn else None
 
     # -- policy -------------------------------------------------------------
+    def _sensor_snapshot(self):
+        """(battery, temp) for event payloads WITHOUT touching the sensor
+        callables: manual injections are free to read; fn-backed sensors
+        report their last sample from the check cadence (None before the
+        first check) — the event must not defeat check_interval_steps'
+        rate limiting."""
+        batt = (self.config.manual_battery
+                if self.config.manual_battery is not None
+                else self._last_battery)
+        temp = (self.config.manual_temp
+                if self.config.manual_temp is not None
+                else self._last_temp)
+        return batt, temp
+
     def _telemetry_sleep_ms(self) -> float:
         c = self.config
         battery, temp = self._read_battery(), self._read_temp()
+        self._last_battery, self._last_temp = battery, temp
         f_batt = (c.freq_batt_low if (battery is not None
                                       and battery < c.battery_threshold)
                   else c.freq_batt_high)
@@ -161,8 +195,21 @@ class StepGovernor:
 
     def throttle(self, step: int):
         """Sleep per policy (trainer call site; gemma_trainer.cpp loop,
-        gpt2_lora_finetune/main.cpp:679-683)."""
+        gpt2_lora_finetune/main.cpp:679-683). A non-zero sleep whose
+        DECISION differs from the last emitted one first reports it AND
+        its inputs through event_sink, so the telemetry stream records
+        why steps are being stretched without growing per-step."""
         ms = self.suggest_sleep_ms(step)
         if ms > 0:
+            if self._event_sink is not None:
+                src = ("schedule"
+                       if any(r.covers(step) for r in self._schedule)
+                       else "telemetry")
+                if (ms, src) != self._last_emitted:
+                    self._last_emitted = (ms, src)
+                    batt, temp = self._sensor_snapshot()
+                    self._event_sink({
+                        "step": step, "sleep_ms": ms, "battery": batt,
+                        "temp": temp, "source": src})
             time.sleep(ms / 1000.0)
         return ms

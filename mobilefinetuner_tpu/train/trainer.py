@@ -79,7 +79,16 @@ def make_train_step(loss_fn: Callable[[Any, Any, dict], tuple],
     [accum*micro_b, ...] and step is the 0-based optimizer step index
     (drives the LR schedule as a traced value — no recompiles).
     metrics = {loss, grad_norm, lr} (scalars, pre-clip global norm as in
-    main.cpp:490-516).
+    main.cpp:490-516) plus the on-device train-health scalars
+    {param_norm, update_ratio, nonfinite_count}: ||w|| over the
+    trainable leaves (pre-update — measured inside the optimizer kernel
+    so the donated tree's lifetime is untouched), the step's relative
+    update size ||Δw||/||w||, and the global count of non-finite
+    gradient elements. All of them are device scalars that
+    ride the step loop's buffered-metrics path (cli/common.run_training
+    pulls the whole buffer in ONE device_get per flush), so health
+    monitoring adds zero per-step host syncs — the telemetry
+    zero-sync invariant (DESIGN.md §13).
     """
     accum = train_cfg.grad_accum_steps
     adam_cfg = train_cfg.adam()
@@ -107,6 +116,11 @@ def make_train_step(loss_fn: Callable[[Any, Any, dict], tuple],
         inv = 1.0 / jnp.maximum(w_sum, 1.0)
         grads = jax.tree.map(lambda g: g * inv, g_sum)
         loss = loss_sum * inv
+        # health: count non-finite grad elements BEFORE clipping (clip
+        # propagates a NaN norm into every element, which would turn one
+        # bad value into "all of them")
+        nonfinite = sum(jnp.sum(~jnp.isfinite(g))
+                        for g in jax.tree.leaves(grads))
         if train_cfg.clip_grad_norm and train_cfg.clip_grad_norm > 0:
             grads, norm = clip_by_global_norm(grads,
                                               train_cfg.clip_grad_norm)
@@ -116,9 +130,19 @@ def make_train_step(loss_fn: Callable[[Any, Any, dict], tuple],
         lr = lr_schedule(step, train_cfg.total_steps, train_cfg.lr,
                          train_cfg.warmup_ratio, train_cfg.schedule,
                          train_cfg.min_lr_ratio)
-        trainable2, opt_state2 = adam_update(grads, opt_state, trainable,
-                                             adam_cfg, lr, mask)
-        metrics = {"loss": loss, "grad_norm": norm, "lr": lr}
+        with jax.named_scope("optimizer"):
+            # ||Δw|| and pre-update ||w|| come from INSIDE the update
+            # (adam_update with_norms), where the delta already exists —
+            # a post-hoc new-minus-old subtraction would keep the donated
+            # pre-update tree alive past the in-place write and cost a
+            # params-sized peak-HBM bump on full fine-tunes.
+            trainable2, opt_state2, (upd_norm, w_norm) = adam_update(
+                grads, opt_state, trainable, adam_cfg, lr, mask,
+                with_norms=True)
+        metrics = {"loss": loss, "grad_norm": norm, "lr": lr,
+                   "param_norm": w_norm,
+                   "update_ratio": upd_norm / jnp.maximum(w_norm, 1e-20),
+                   "nonfinite_count": nonfinite.astype(jnp.int32)}
         return trainable2, opt_state2, metrics
 
     donate_argnums = (0, 2) if donate else ()

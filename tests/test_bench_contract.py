@@ -77,6 +77,15 @@ def test_input_pipeline_row_shape_and_tiny_e2e(bench):
                 if t.name == "batch-producer"]
 
 
+def test_bench_and_telemetry_share_the_flops_estimator():
+    """bench.py's MFU column and the in-loop telemetry MFU must use the
+    SAME transformer_flops function — identity, not equality, so the
+    estimators cannot drift apart."""
+    import bench as b
+    from mobilefinetuner_tpu.core import telemetry
+    assert b.transformer_flops is telemetry.transformer_flops
+
+
 def test_failed_headline_reports_zero_and_exits_nonzero(bench,
                                                         monkeypatch):
     def boom(dtype, steps, **kw):

@@ -131,6 +131,32 @@ def test_profiler_trace_and_hbm_column(gpt2_dir, wiki_dir, tmp_path):
     assert float(rows[0]["hbm_mb"]) > 0
 
 
+def test_profiler_window_past_total_steps_still_stops_trace(
+        gpt2_dir, wiki_dir, tmp_path):
+    """Leak regression: a 2-step run whose profile window
+    (profile_start + profile_steps) extends past total_steps must STILL
+    stop the trace — the stop now lives in the loop's finally block, so
+    every exit path closes it. Symptoms of the leak: no trace files
+    flushed, and the process-global profiler left active (a later
+    start_trace would raise)."""
+    from mobilefinetuner_tpu.cli.gpt2_lora_finetune import main
+    import jax as _jax
+    prof = str(tmp_path / "prof")
+    rc = main(["--pretrained_dir", gpt2_dir, "--data_dir", wiki_dir,
+               "--steps", "2", "--batch_size", "2", "--seq_len", "32",
+               "--lora_out", str(tmp_path / "a.safetensors"),
+               "--profile_dir", prof, "--profile_start", "1",
+               "--profile_steps", "50"])
+    assert rc == 0
+    trace_files = [os.path.join(r, f) for r, _, fs in os.walk(prof)
+                   for f in fs]
+    assert trace_files, "trace leaked: stop_trace never ran"
+    # the global profiler state is clean: a fresh trace can start
+    prof2 = str(tmp_path / "prof2")
+    _jax.profiler.start_trace(prof2)
+    _jax.profiler.stop_trace()
+
+
 def test_gpt2_lora_with_offload_and_governor(gpt2_dir, wiki_dir, tmp_path):
     """shard_* + pm_* flags wired end-to-end (sharded-training smoke,
     scripts/benchmark/test_all_models_sharding.sh analog)."""

@@ -107,3 +107,57 @@ def test_throttle_sleeps(monkeypatch):
     gov = StepGovernor(GovernorConfig(enable=True, schedule="0-:100"))
     gov.throttle(0)
     assert slept == [pytest.approx(0.1)]
+
+
+def test_throttle_emits_telemetry_event(monkeypatch):
+    """Every sleeping throttle() reports {step, sleep_ms, battery, temp,
+    source} through event_sink — the run-telemetry `throttle` event, so
+    duty-cycle decisions stop being invisible step-time stretches."""
+    import mobilefinetuner_tpu.system.governor as G
+    monkeypatch.setattr(G.time, "sleep", lambda s: None)
+    events = []
+    cfg = GovernorConfig(enable=True, schedule="0-4:250",
+                         manual_battery=77.0, manual_temp=31.0)
+    gov = StepGovernor(cfg, event_sink=events.append)
+    gov.throttle(2)
+    assert events == [{"step": 2, "sleep_ms": 250.0, "battery": 77.0,
+                       "temp": 31.0, "source": "schedule"}]
+    # same decision on later steps: NO new event (the stream must not
+    # grow per-step on a steady duty cycle)...
+    gov.throttle(3)
+    gov.throttle(4)
+    assert len(events) == 1
+    # ...but a CHANGED decision emits again: past the schedule range the
+    # telemetry policy takes over (healthy sensors -> 100 ms)
+    gov.throttle(5)
+    assert len(events) == 2
+    assert events[1]["sleep_ms"] == pytest.approx(100.0)
+    assert events[1]["source"] == "telemetry"
+    # uncovered step under the telemetry policy -> source "telemetry"
+    cfg2 = GovernorConfig(enable=True, check_interval_steps=1,
+                          manual_battery=5.0, battery_threshold=20.0,
+                          freq_batt_low=1.0)
+    events2 = []
+    gov2 = StepGovernor(cfg2, event_sink=events2.append)
+    gov2.throttle(0)
+    assert events2[0]["source"] == "telemetry"
+    assert events2[0]["sleep_ms"] == pytest.approx(1000.0)
+    assert events2[0]["battery"] == 5.0
+    # a zero-sleep step emits nothing
+    gov3 = StepGovernor(GovernorConfig(enable=False),
+                        event_sink=events2.append)
+    gov3.throttle(0)
+    assert len(events2) == 1
+
+
+def test_throttle_event_validates_against_telemetry_schema(monkeypatch):
+    from mobilefinetuner_tpu.core.telemetry import validate_event
+    import mobilefinetuner_tpu.system.governor as G
+    monkeypatch.setattr(G.time, "sleep", lambda s: None)
+    recs = []
+    gov = StepGovernor(
+        GovernorConfig(enable=True, schedule="0-:100"),
+        event_sink=lambda p: recs.append(
+            {"event": "throttle", "seq": 0, "t": 0.0, **p}))
+    gov.throttle(3)
+    assert recs and validate_event(recs[0]) is None

@@ -1,0 +1,467 @@
+"""Run-telemetry contract tests (core/telemetry.py, DESIGN.md §13):
+every event type validates against the shared field spec, sequence
+numbers stay monotonic across a simulated crash/resume append, a tiny
+CPU e2e train produces run_start..run_end with the health fields, the
+compiled HLO of BOTH model families carries the named phase scopes, and
+the satellites (spike detector, max-across-devices HBM gauge, CSV
+schema, report tool) hold their contracts."""
+
+import csv
+import json
+import os
+import re
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mobilefinetuner_tpu.core.telemetry import (EVENT_SCHEMA, SpikeConfig,
+                                                SpikeDetector, Telemetry,
+                                                device_peak_flops, mfu_from,
+                                                run_manifest,
+                                                transformer_flops,
+                                                validate_event)
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from fixtures import (write_tiny_gemma3_dir, write_tiny_gpt2_dir,
+                      write_wikitext_dir)
+
+
+def read_events(path):
+    with open(path) as f:
+        return [json.loads(l) for l in f.read().splitlines() if l.strip()]
+
+
+# --------------------------- schema contract --------------------------------
+
+REPRESENTATIVE = {
+    "run_start": dict(jax_version="0.0", mesh_shape={"data": 1},
+                      process_count=1, process_index=0, device_kind="cpu",
+                      device_count=8, config={"steps": 3}),
+    "compile": dict(step=0, wall_s=1.5, flops=1e9, peak_hbm_mb=123.0),
+    "step_stats": dict(step=1, loss=3.2, ema=3.3, lr=1e-4, grad_norm=0.5,
+                       step_time_ms=10.0, host_wait_ms=0.1, slept_ms=0.0,
+                       tok_s=1000.0, mfu=None, param_norm=12.0,
+                       update_ratio=1e-3, nonfinite_count=0,
+                       hbm_mb=100.0, queue_depth=2),
+    "throttle": dict(step=5, sleep_ms=100.0, battery=80.0, temp=30.0,
+                     source="telemetry"),
+    "anomaly": dict(step=7, kind="loss_spike", loss=9.9, ema=3.0,
+                    zscore=8.4),
+    "eval": dict(step=10, loss=3.1, ppl=22.2, tokens=4096),
+    "checkpoint": dict(step=10, final=False, wall_s=0.2),
+    "run_end": dict(steps=10, wall_s=60.0, exit="ok"),
+}
+
+
+def test_every_event_type_has_a_representative_and_validates(tmp_path):
+    """One emit per event type in the taxonomy; each line read back from
+    disk passes the shared validator, seq is 0..n-1 in order."""
+    assert set(REPRESENTATIVE) == set(EVENT_SCHEMA)
+    path = str(tmp_path / "t.jsonl")
+    with Telemetry(path) as tel:
+        for ev, fields in REPRESENTATIVE.items():
+            assert tel.emit(ev, **fields) is not None
+    recs = read_events(path)
+    assert [r["event"] for r in recs] == list(REPRESENTATIVE)
+    for r in recs:
+        assert validate_event(r) is None, (r, validate_event(r))
+    assert [r["seq"] for r in recs] == list(range(len(REPRESENTATIVE)))
+
+
+def test_validator_rejects_bad_events():
+    ok = dict(event="eval", seq=0, t=1.0, step=1, loss=1.0, ppl=2.0,
+              tokens=3)
+    assert validate_event(ok) is None
+    assert validate_event({**ok, "event": "nope"}) is not None
+    assert validate_event({k: v for k, v in ok.items()
+                           if k != "ppl"}) is not None
+    assert validate_event({**ok, "tokens": "many"}) is not None
+    assert validate_event({**ok, "seq": -1}) is not None
+    # bool must not satisfy a numeric field
+    assert validate_event({**ok, "loss": True}) is not None
+    # extra fields are allowed (schema is a floor)
+    assert validate_event({**ok, "extra": {"x": 1}}) is None
+
+
+def test_nonfinite_floats_serialize_as_strict_json(tmp_path):
+    """A diverged run's NaN loss must not produce RFC-8259-invalid
+    `NaN` literals — non-finite floats land as null, and the anomaly
+    kind carries the information."""
+    path = str(tmp_path / "t.jsonl")
+    with Telemetry(path) as tel:
+        tel.emit("anomaly", step=1, kind="nonfinite_loss",
+                 loss=float("nan"), ema=float("inf"), zscore=None)
+    raw = open(path).read()
+    assert "NaN" not in raw and "Infinity" not in raw
+    rec = json.loads(raw)  # strict parse succeeds
+    assert rec["loss"] is None and rec["ema"] is None
+    assert validate_event(rec) is None
+
+
+def test_disabled_telemetry_is_noop(tmp_path):
+    tel = Telemetry("")
+    assert tel.emit("run_end", steps=0, wall_s=0.0, exit="ok") is None
+    tel.close()
+    tel = Telemetry(str(tmp_path / "x.jsonl"), enabled=False)
+    assert tel.emit("run_end", steps=0, wall_s=0.0, exit="ok") is None
+    assert not os.path.exists(tmp_path / "x.jsonl")
+
+
+def test_seq_monotonic_across_crash_resume(tmp_path):
+    """Appending to an existing stream (resumed run) continues the seq
+    numbering — even past a truncated tail line from a killed writer."""
+    path = str(tmp_path / "t.jsonl")
+    with Telemetry(path) as tel:
+        for i in range(3):
+            tel.emit("eval", step=i, loss=1.0, ppl=2.0, tokens=1)
+    # simulate a crash mid-write: a partial JSON line at the tail
+    with open(path, "a") as f:
+        f.write('{"event": "step_stats", "seq": 99, "t"')
+    with Telemetry(path) as tel:
+        tel.emit("eval", step=3, loss=1.0, ppl=2.0, tokens=1)
+        tel.emit("run_end", steps=4, wall_s=1.0, exit="ok")
+    recs = []
+    for line in open(path).read().splitlines():
+        try:
+            recs.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    seqs = [r["seq"] for r in recs]
+    assert seqs == [0, 1, 2, 3, 4]  # resumed past the corrupt line
+    assert all(a < b for a, b in zip(seqs, seqs[1:]))
+
+
+# --------------------------- spike detector ---------------------------------
+
+def test_spike_detector_fires_on_spike_not_noise():
+    det = SpikeDetector(SpikeConfig(zscore=6.0, beta=0.9, warmup=10))
+    rng = np.random.default_rng(0)
+    for i in range(50):
+        assert det.update(3.0 + 0.01 * float(rng.normal())) is None
+    anom = det.update(9.0)  # a real divergence step
+    assert anom is not None and anom["kind"] == "loss_spike"
+    assert anom["zscore"] > 6.0
+    # the spike is winsorized into the EMA: an immediately following
+    # normal loss is NOT anomalous
+    assert det.update(3.0) is None
+
+
+def test_spike_detector_readapts_to_level_shift():
+    """A persistent loss plateau shift fires during the transition but
+    must NOT fire forever — the winsorized EMA walks to the new level."""
+    det = SpikeDetector(SpikeConfig(zscore=4.0, beta=0.9, warmup=5))
+    rng = np.random.default_rng(1)
+    for _ in range(40):
+        det.update(2.0 + 0.01 * float(rng.normal()))
+    fired = [det.update(4.0 + 0.01 * float(rng.normal())) is not None
+             for _ in range(300)]
+    assert any(fired[:50])        # the shift was detected...
+    assert not any(fired[-50:])   # ...and the detector re-armed
+
+
+def test_spike_detector_warmup_and_nonfinite():
+    det = SpikeDetector(SpikeConfig(zscore=6.0, warmup=20))
+    assert det.update(5.0) is None
+    assert det.update(500.0) is None  # wild early loss: still warming up
+    nf = det.update(float("nan"))
+    assert nf is not None and nf["kind"] == "nonfinite_loss"
+    # NaN is absorbing: consecutive non-finite losses fire ONCE (the
+    # transition), not once per step
+    assert det.update(float("inf")) is None
+    assert det.update(float("nan")) is None
+    # a recovery followed by a new divergence fires again
+    assert det.update(5.0) is None
+    assert det.update(float("nan"))["kind"] == "nonfinite_loss"
+    # disabled detector never fires
+    off = SpikeDetector(SpikeConfig(zscore=0.0))
+    assert off.update(float("nan")) is None
+
+
+# --------------------------- MFU accounting ---------------------------------
+
+def test_mfu_helpers():
+    assert device_peak_flops("TPU v5 lite") == 197e12
+    assert device_peak_flops("TPU v5p chip") == 459e12
+    assert device_peak_flops("cpu") == 0.0
+    assert mfu_from(197e12 * 0.5, 1.0, 197e12) == pytest.approx(0.5)
+    assert mfu_from(None, 1.0, 197e12) is None
+    assert mfu_from(1e12, 1.0, 0.0) is None  # unknown peak -> no MFU
+
+
+def test_transformer_flops_scales_linearly_in_tokens():
+    f1 = transformer_flops(1e6, 1e8, 4, 128, 12, 12, 64, full_ft=False)
+    f2 = transformer_flops(1e6, 1e8, 8, 128, 12, 12, 64, full_ft=False)
+    assert f2 > f1 * 1.99  # attention grows superlinearly in S, not B
+
+
+# --------------------------- HBM gauge satellite ----------------------------
+
+class _FakeDev:
+    def __init__(self, bytes_in_use, broken=False):
+        self._b = bytes_in_use
+        self._broken = broken
+
+    def memory_stats(self):
+        if self._broken:
+            raise RuntimeError("no stats on this platform")
+        return {"bytes_in_use": self._b}
+
+
+def test_live_hbm_mb_reports_max_across_devices():
+    """An imbalanced shard (e.g. vocab-parallel embed remainder on one
+    chip) must not be under-reported by reading only device 0."""
+    from mobilefinetuner_tpu.core.xla_stats import live_hbm_mb
+    devs = [_FakeDev(100 * 2 ** 20), _FakeDev(900 * 2 ** 20),
+            _FakeDev(50 * 2 ** 20)]
+    assert live_hbm_mb(devices=devs) == pytest.approx(900.0)
+    # one broken device must not zero the others
+    devs = [_FakeDev(0, broken=True), _FakeDev(300 * 2 ** 20)]
+    assert live_hbm_mb(devices=devs) == pytest.approx(300.0)
+    assert live_hbm_mb(devices=[]) == 0.0
+
+
+# --------------------------- named-scope tracing ----------------------------
+
+def _assert_scopes(txt, scopes):
+    """Each named scope must appear as a path component of some HLO
+    op_name. Autodiff wraps scopes in transform markers — the forward
+    pass carries `jvp(embed)/...`, the backward `transpose(jvp(mlp))/...`
+    — so match the scope delimited by / or parentheses."""
+    names = set(re.findall(r'op_name="([^"]*)"', txt))
+    for s in scopes:
+        pat = re.compile(rf"(^|[/(]){s}([/)]|$)")
+        assert any(pat.search(n) for n in names), \
+            f"scope {s!r} missing from compiled HLO metadata"
+
+
+def test_gpt2_train_step_hlo_scopes_and_health_metrics():
+    """One compiled GPT-2 train step pins BOTH contracts: (a) the
+    embed/attention/mlp/loss/optimizer named scopes survive into the
+    compiled HLO metadata (the semantic trace annotation), and (b) the
+    on-device health metrics come back as DEVICE scalars in the metrics
+    dict (they ride the buffered fetch) with sane values on a healthy
+    step."""
+    from mobilefinetuner_tpu.core.config import GPT2Config
+    from mobilefinetuner_tpu.lora.lora import (LoRASpec, init_lora_gpt2,
+                                               trainable_mask)
+    from mobilefinetuner_tpu.models import gpt2
+    from mobilefinetuner_tpu.ops.loss import lm_cross_entropy_sum
+    from mobilefinetuner_tpu.train.trainer import (TrainConfig,
+                                                   init_optimizer,
+                                                   make_train_step)
+    cfg = GPT2Config.tiny()
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    lora = init_lora_gpt2(cfg, LoRASpec(rank=2, alpha=4.0),
+                          jax.random.PRNGKey(1))
+    mask = trainable_mask(lora)
+    tc = TrainConfig(total_steps=4, lr=1e-3, warmup_ratio=0.0,
+                     schedule="constant")
+
+    def loss_fn(lo, p, mb):
+        logits = gpt2.forward(cfg, p, mb["input_ids"],
+                              attention_mask=mb["attention_mask"], lora=lo)
+        return lm_cross_entropy_sum(logits, mb["labels"])
+
+    rng = np.random.default_rng(0)
+    ids = jnp.array(rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)
+    batch = {"input_ids": ids, "attention_mask": jnp.ones_like(ids),
+             "labels": ids}
+    step = make_train_step(loss_fn, tc, mask=mask, donate=False)
+    opt = init_optimizer(lora, tc, mask)
+    compiled = step.lower(lora, params, opt, batch, jnp.int32(0)).compile()
+    _assert_scopes(compiled.as_text(),
+                   ["embed", "attention", "mlp", "loss", "optimizer"])
+    _, _, m = compiled(lora, params, opt, batch, jnp.int32(0))
+    for k in ("param_norm", "update_ratio", "nonfinite_count"):
+        assert isinstance(m[k], jax.Array), k  # device-resident
+    assert float(m["param_norm"]) > 0
+    assert 0 < float(m["update_ratio"]) < 1.0
+    assert int(m["nonfinite_count"]) == 0
+
+
+def test_gemma_train_step_hlo_carries_named_scopes():
+    """Same contract for the Gemma family (chunked-CE loss path)."""
+    from mobilefinetuner_tpu.core.config import Gemma3TextConfig
+    from mobilefinetuner_tpu.lora.lora import (LoRASpec, init_lora_gemma3,
+                                               trainable_mask)
+    from mobilefinetuner_tpu.models import gemma3
+    from mobilefinetuner_tpu.ops.loss import chunked_lm_cross_entropy_sum
+    from mobilefinetuner_tpu.train.trainer import (TrainConfig,
+                                                   init_optimizer,
+                                                   make_train_step)
+    cfg = Gemma3TextConfig.tiny()
+    params = gemma3.init_params(cfg, jax.random.PRNGKey(0))
+    lora = init_lora_gemma3(cfg, LoRASpec(rank=2, alpha=4.0),
+                            jax.random.PRNGKey(1))
+    mask = trainable_mask(lora)
+    tc = TrainConfig(total_steps=4, lr=1e-3, warmup_ratio=0.0,
+                     schedule="constant")
+
+    def loss_fn(lo, p, mb):
+        hidden = gemma3.hidden_states(
+            cfg, p, mb["input_ids"],
+            attention_mask=mb["attention_mask"], lora=lo)
+        return chunked_lm_cross_entropy_sum(hidden, p["embed"],
+                                            mb["labels"], num_chunks=2)
+
+    ids = jnp.zeros((2, 16), jnp.int32)
+    batch = {"input_ids": ids, "attention_mask": jnp.ones_like(ids),
+             "labels": ids}
+    step = make_train_step(loss_fn, tc, mask=mask, donate=False)
+    opt = init_optimizer(lora, tc, mask)
+    txt = step.lower(lora, params, opt, batch,
+                     jnp.int32(0)).compile().as_text()
+    _assert_scopes(txt, ["embed", "attention", "mlp", "loss", "optimizer"])
+
+
+# --------------------------- CPU e2e acceptance -----------------------------
+
+@pytest.fixture(scope="module")
+def gpt2_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("gpt2tel")
+    write_tiny_gpt2_dir(str(d))
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def wiki_dir(tmp_path_factory):
+    return write_wikitext_dir(str(tmp_path_factory.mktemp("wt2tel")))
+
+
+def test_cpu_e2e_stream_and_report(gpt2_dir, wiki_dir, tmp_path):
+    """The acceptance run: a tiny CPU train with --telemetry_out yields
+    run_start, >=1 compile, >=1 step_stats carrying mfu/tok_s/
+    param_norm/update_ratio/nonfinite_count, an eval, checkpoint events,
+    and run_end — all passing the schema contract, seq strictly
+    monotonic — and both new sinks (CSV schema, report tool) read it."""
+    from mobilefinetuner_tpu.cli.gpt2_lora_finetune import main
+    stream = str(tmp_path / "run.jsonl")
+    csv_path = str(tmp_path / "m.csv")
+    rc = main(["--pretrained_dir", gpt2_dir, "--data_dir", wiki_dir,
+               "--steps", "4", "--batch_size", "2", "--seq_len", "32",
+               "--lora_out", str(tmp_path / "a.safetensors"),
+               "--telemetry_out", stream, "--metrics_csv", csv_path,
+               "--eval_interval", "4", "--eval_batches", "2",
+               "--pm_schedule", "0-0:1", "--log_interval", "2"])
+    assert rc == 0
+    recs = read_events(stream)
+    for r in recs:
+        assert validate_event(r) is None, (r, validate_event(r))
+    seqs = [r["seq"] for r in recs]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    kinds = [r["event"] for r in recs]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    assert kinds.count("compile") >= 1
+    assert kinds.count("step_stats") >= 1
+    assert "throttle" in kinds  # pm_schedule slept on step 0
+    assert "eval" in kinds and "checkpoint" in kinds
+    run_start = recs[0]
+    assert run_start["config"]["steps"] == 4
+    assert run_start["process_count"] == 1
+    ss = [r for r in recs if r["event"] == "step_stats"]
+    for field in ("mfu", "tok_s", "param_norm", "update_ratio",
+                  "nonfinite_count"):
+        assert field in ss[-1]
+    assert ss[-1]["param_norm"] > 0
+    assert ss[-1]["nonfinite_count"] == 0
+    assert ss[-1]["tok_s"] > 0
+    end = recs[-1]
+    assert end["exit"] == "ok" and end["steps"] == 4
+
+    # resume appends to the SAME stream with continued seq
+    rc = main(["--pretrained_dir", gpt2_dir, "--data_dir", wiki_dir,
+               "--steps", "5", "--batch_size", "2", "--seq_len", "32",
+               "--lora_out", str(tmp_path / "a.safetensors"),
+               "--resume_from", str(tmp_path / "a.safetensors"),
+               "--telemetry_out", stream])
+    assert rc == 0
+    recs2 = read_events(stream)
+    seqs2 = [r["seq"] for r in recs2]
+    assert seqs2 == sorted(seqs2) and len(set(seqs2)) == len(seqs2)
+    assert [r["event"] for r in recs2].count("run_start") == 2
+
+    # CSV satellite: grad_norm/tok_s/mfu columns landed
+    with open(csv_path) as f:
+        rows = list(csv.DictReader(f))
+    assert {"grad_norm", "tok_s", "mfu"} <= set(rows[0])
+    assert float(rows[0]["grad_norm"]) > 0
+    assert float(rows[0]["tok_s"]) > 0
+
+    # report tool renders the stream (both modes)
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import telemetry_report
+    assert telemetry_report.main([stream]) == 0
+    events, bad = telemetry_report.load_events(stream)
+    s = telemetry_report.summarize(events, bad)
+    assert s["runs"] == 2 and s["seq_monotonic"]
+    assert s["run_end"]["exit"] == "ok"
+    assert s["step_stats"]["flushes"] >= 1
+    assert s["throttle"]["decisions"] >= 1
+    assert s["throttle"]["total_sleep_ms"] > 0  # from step_stats.slept_ms
+
+
+def test_setup_crash_still_emits_run_end(gpt2_dir, wiki_dir, tmp_path,
+                                         monkeypatch):
+    """A failure BETWEEN run_start and the step loop (step build, device
+    placement) must still terminate the stream with run_end{exit:<type>}
+    — a stream ending at run_start is indistinguishable from a SIGKILL."""
+    from mobilefinetuner_tpu.cli import common
+    from mobilefinetuner_tpu.cli.gpt2_lora_finetune import main
+
+    def boom(*a, **k):
+        raise RuntimeError("simulated setup OOM")
+
+    monkeypatch.setattr(common, "make_train_step", boom)
+    stream = str(tmp_path / "crash.jsonl")
+    with pytest.raises(RuntimeError):
+        main(["--pretrained_dir", gpt2_dir, "--data_dir", wiki_dir,
+              "--steps", "2", "--batch_size", "2", "--seq_len", "32",
+              "--lora_out", str(tmp_path / "a.safetensors"),
+              "--telemetry_out", stream])
+    recs = read_events(stream)
+    assert [r["event"] for r in recs] == ["run_start", "run_end"]
+    assert recs[-1]["exit"] == "RuntimeError"
+    assert recs[-1]["steps"] == 0
+    for r in recs:
+        assert validate_event(r) is None
+
+
+def test_eval_ppl_telemetry_stream(gpt2_dir, wiki_dir, tmp_path, capsys):
+    from mobilefinetuner_tpu.cli.eval_ppl import main
+    stream = str(tmp_path / "eval.jsonl")
+    rc = main(["--pretrained_dir", gpt2_dir, "--data_root", wiki_dir,
+               "--split", "valid", "--seq_len", "32", "--batch_size", "2",
+               "--max_batches", "2", "--telemetry_out", stream])
+    assert rc == 0
+    capsys.readouterr()
+    recs = read_events(stream)
+    for r in recs:
+        assert validate_event(r) is None, (r, validate_event(r))
+    kinds = [r["event"] for r in recs]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    assert "eval" in kinds
+
+
+# --------------------------- plot_loss both schemas -------------------------
+
+def test_plot_loss_reads_both_csv_schemas(tmp_path):
+    old = tmp_path / "old.csv"
+    old.write_text(
+        "timestamp,epoch,step,loss,avg_loss,lr,step_time_ms,hbm_mb\n"
+        "1.0,0,1,3.5,3.5,0.0001,10.0,100\n"
+        "2.0,0,2,3.4,3.45,0.0001,10.0,100\n")
+    new = tmp_path / "new.csv"
+    new.write_text(
+        "timestamp,epoch,step,loss,avg_loss,lr,grad_norm,step_time_ms,"
+        "host_wait_ms,tok_s,mfu,hbm_mb\n"
+        "1.0,0,1,3.5,3.5,0.0001,0.8,10.0,0.1,6400.0,,100\n")
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import plot_loss
+    for p in (old, new):
+        steps, loss, avg, lr = plot_loss.read_metrics(str(p))
+        assert steps and len(steps) == len(loss) == len(avg) == len(lr)

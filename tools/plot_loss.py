@@ -2,10 +2,14 @@
 
 The analog of the reference's loss-curve plotting in scripts/Finetune
 (reference: SURVEY.md §2.9). Reads one or more metrics CSVs written by
---metrics_csv (columns: timestamp,epoch,step,loss,avg_loss,lr,
-step_time_ms,hbm_mb — core/logging.py MetricsLogger) and writes a PNG
-with loss + EMA curves (and LR on a twin axis), one series per file.
-Falls back to a text summary when matplotlib is unavailable.
+--metrics_csv (core/logging.py MetricsLogger) and writes a PNG with
+loss + EMA curves (and LR on a twin axis), one series per file. Falls
+back to a text summary when matplotlib is unavailable.
+
+Tolerates BOTH CSV schemas: the pre-telemetry columns
+(timestamp,epoch,step,loss,avg_loss,lr,step_time_ms[,host_wait_ms],
+hbm_mb) and the current one with grad_norm/tok_s/mfu — rows are read by
+column NAME and missing columns default, so old runs keep plotting.
 
 Usage:
   python tools/plot_loss.py out/metrics.csv [more.csv ...] \

@@ -1,0 +1,211 @@
+"""Render a run-telemetry JSONL stream into a human summary.
+
+Reads the --telemetry_out stream (core/telemetry.py event taxonomy) and
+prints what an operator asks after a run: how fast was it (step-time
+percentiles, tokens/s, MFU trend), where did the time go (host-wait
+fraction, throttle sleeps, compile), and was it healthy (anomalies,
+nonfinite gradients, exit status). Every line is validated against the
+shared EVENT_SCHEMA; invalid lines are counted, not fatal (a crashed
+writer may leave one truncated tail line).
+
+Usage:
+  python tools/telemetry_report.py run.jsonl [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from mobilefinetuner_tpu.core.telemetry import validate_event
+
+
+def percentile(sorted_vals, q):
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return None
+    i = min(int(q / 100.0 * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def load_events(path):
+    """(events, n_invalid): valid events in file order."""
+    events, bad = [], 0
+    with open(path, "rb") as f:
+        for raw in f:
+            if not raw.strip():
+                continue
+            try:
+                rec = json.loads(raw)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                bad += 1
+                continue
+            if validate_event(rec) is None:
+                events.append(rec)
+            else:
+                bad += 1
+    return events, bad
+
+
+def summarize(events, n_invalid=0) -> dict:
+    by = {}
+    for e in events:
+        by.setdefault(e["event"], []).append(e)
+    stats = by.get("step_stats", [])
+    times = sorted(s["step_time_ms"] for s in stats)
+    waits = [s["host_wait_ms"] for s in stats]
+    mfus = [s["mfu"] for s in stats if s.get("mfu") is not None]
+    toks = [s["tok_s"] for s in stats]
+    nonfinite = sum(s.get("nonfinite_count") or 0 for s in stats)
+    runs = by.get("run_start", [])
+    ends = by.get("run_end", [])
+    seqs = [e["seq"] for e in events]
+    out = {
+        "events": len(events),
+        "invalid_lines": n_invalid,
+        "seq_monotonic": all(a < b for a, b in zip(seqs, seqs[1:])),
+        "runs": len(runs),
+        "manifest": (lambda m: {
+            "device_kind": m["device_kind"],
+            "device_count": m["device_count"],
+            "process_count": m["process_count"],
+            "mesh_shape": m["mesh_shape"],
+            "jax_version": m["jax_version"],
+        })(runs[-1]) if runs else None,
+        "compile": [{"step": c["step"], "wall_s": c["wall_s"],
+                     "flops": c.get("flops"),
+                     "peak_hbm_mb": c.get("peak_hbm_mb")}
+                    for c in by.get("compile", [])],
+        "step_stats": {
+            "flushes": len(stats),
+            "last_step": stats[-1]["step"] if stats else None,
+            "step_time_ms": {
+                "p50": percentile(times, 50),
+                "p90": percentile(times, 90),
+                "p99": percentile(times, 99),
+            },
+            # fraction of step time the loop sat blocked on the input
+            # pipeline — the host/device breakdown
+            "host_wait_frac": (sum(waits) / max(sum(times), 1e-9)
+                               if stats else None),
+            "tok_s": {"mean": sum(toks) / len(toks) if toks else None,
+                      "last": toks[-1] if toks else None},
+            "mfu": {"first": mfus[0] if mfus else None,
+                    "last": mfus[-1] if mfus else None,
+                    "mean": sum(mfus) / len(mfus) if mfus else None},
+            "loss": {"first": stats[0]["loss"] if stats else None,
+                     "last": stats[-1]["loss"] if stats else None,
+                     "ema_last": stats[-1]["ema"] if stats else None},
+            "nonfinite_grad_elements": nonfinite,
+        },
+        # throttle events mark DECISION CHANGES; the actual time slept
+        # accumulates per flush interval in step_stats.slept_ms
+        "throttle": {
+            "decisions": len(by.get("throttle", [])),
+            "total_sleep_ms": sum(s.get("slept_ms") or 0 for s in stats),
+        },
+        "anomalies": [{"step": a["step"], "kind": a["kind"],
+                       "loss": a["loss"], "zscore": a.get("zscore")}
+                      for a in by.get("anomaly", [])],
+        "evals": [{"step": e["step"], "loss": e["loss"], "ppl": e["ppl"],
+                   "macro_accuracy": e.get("macro_accuracy")}
+                  for e in by.get("eval", [])],
+        "checkpoints": len(by.get("checkpoint", [])),
+        "run_end": ({"steps": ends[-1]["steps"],
+                     "wall_s": ends[-1]["wall_s"],
+                     "exit": ends[-1]["exit"]} if ends else None),
+    }
+    return out
+
+
+def _fmt(v, nd=2):
+    return "-" if v is None else f"{v:.{nd}f}"
+
+
+def print_summary(s: dict):
+    m = s["manifest"] or {}
+    print(f"telemetry: {s['events']} events"
+          + (f" ({s['invalid_lines']} invalid lines skipped)"
+             if s["invalid_lines"] else "")
+          + ("" if s["seq_monotonic"] else "  [SEQ NOT MONOTONIC]"))
+    if m:
+        print(f"  device: {m['device_count']}x {m['device_kind']}, "
+              f"{m['process_count']} process(es), mesh={m['mesh_shape']}, "
+              f"jax {m['jax_version']}")
+    for c in s["compile"]:
+        fl = (f", {c['flops'] / 1e9:.2f} GFLOP/step"
+              if c.get("flops") else "")
+        hbm = (f", peak {c['peak_hbm_mb']:.0f} MB"
+               if c.get("peak_hbm_mb") else "")
+        print(f"  compile @ step {c['step']}: {c['wall_s']:.1f}s{fl}{hbm}")
+    st = s["step_stats"]
+    if st["flushes"]:
+        t = st["step_time_ms"]
+        print(f"  steps: {st['flushes']} flushes through step "
+              f"{st['last_step']}; step_time p50/p90/p99 = "
+              f"{_fmt(t['p50'])}/{_fmt(t['p90'])}/{_fmt(t['p99'])} ms; "
+              f"host_wait {_fmt(100 * st['host_wait_frac'], 1)}%")
+        print(f"  throughput: {_fmt(st['tok_s']['mean'], 0)} tok/s mean "
+              f"({_fmt(st['tok_s']['last'], 0)} last); "
+              f"mfu first/mean/last = {_fmt(st['mfu']['first'], 3)}/"
+              f"{_fmt(st['mfu']['mean'], 3)}/{_fmt(st['mfu']['last'], 3)}")
+        print(f"  loss: {_fmt(st['loss']['first'], 4)} -> "
+              f"{_fmt(st['loss']['last'], 4)} "
+              f"(ema {_fmt(st['loss']['ema_last'], 4)}); "
+              f"nonfinite grad elements: {st['nonfinite_grad_elements']}")
+    th = s["throttle"]
+    if th["decisions"] or th["total_sleep_ms"]:
+        print(f"  throttle: {th['decisions']} decision(s), "
+              f"{th['total_sleep_ms']:.0f} ms total sleep")
+    if s["anomalies"]:
+        print(f"  ANOMALIES ({len(s['anomalies'])}):")
+        for a in s["anomalies"]:
+            z = f" z={a['zscore']}" if a.get("zscore") else ""
+            print(f"    step {a['step']}: {a['kind']} "
+                  f"loss={_fmt(a['loss'], 4)}{z}")
+    for e in s["evals"]:
+        if e.get("macro_accuracy") is not None:  # accuracy-shaped eval
+            print(f"  eval @ step {e['step']}: "
+                  f"macro_acc={e['macro_accuracy']:.4f}")
+        else:
+            print(f"  eval @ step {e['step']}: loss={_fmt(e['loss'], 4)} "
+                  f"ppl={_fmt(e['ppl'])}")
+    if s["checkpoints"]:
+        print(f"  checkpoints: {s['checkpoints']}")
+    if s["run_end"]:
+        r = s["run_end"]
+        print(f"  run_end: {r['steps']} steps in {r['wall_s']:.1f}s "
+              f"(exit={r['exit']})")
+    else:
+        print("  run_end: MISSING (crashed or still running)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("jsonl", help="telemetry stream (--telemetry_out)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary instead of text")
+    args = ap.parse_args(argv)
+    try:
+        events, bad = load_events(args.jsonl)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if not events:
+        print(f"error: no valid telemetry events in {args.jsonl}",
+              file=sys.stderr)
+        return 1
+    s = summarize(events, bad)
+    try:
+        if args.json:
+            print(json.dumps(s, indent=1))
+        else:
+            print_summary(s)
+    except BrokenPipeError:  # `report run.jsonl | head` is a normal use
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

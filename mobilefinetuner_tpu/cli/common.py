@@ -12,8 +12,10 @@ SURVEY.md §5 Checkpoint/resume).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import itertools
 import os
+import statistics
 import time
 from typing import Callable, Iterator, Optional
 
@@ -23,7 +25,8 @@ import numpy as np
 
 from mobilefinetuner_tpu.core.logging import (JSONLWriter, MetricsLogger,
                                               get_logger)
-from mobilefinetuner_tpu.core.telemetry import (SpikeConfig, SpikeDetector,
+from mobilefinetuner_tpu.core.telemetry import (GoodputMeter, HangWatchdog,
+                                                SpikeConfig, SpikeDetector,
                                                 Telemetry, device_peak_flops,
                                                 mfu_from, run_manifest)
 from mobilefinetuner_tpu.core.xla_stats import (compiled_flops,
@@ -40,7 +43,8 @@ from mobilefinetuner_tpu.parallel.offload import (OffloadConfig,
                                                   placement_stats,
                                                   plan_placement)
 from mobilefinetuner_tpu.system.governor import GovernorConfig, StepGovernor
-from mobilefinetuner_tpu.train.trainer import (TrainConfig, init_optimizer,
+from mobilefinetuner_tpu.train.trainer import (StepClock, TrainConfig,
+                                               init_optimizer,
                                                make_eval_step,
                                                make_train_step)
 
@@ -123,9 +127,12 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
                         "(core/telemetry.py): run_start manifest, "
                         "compile, step_stats (loss/mfu/tok_s/health), "
                         "throttle/eval/checkpoint/anomaly, run_end. "
-                        "Coordinator-only under multi-host; appending "
-                        "to an existing file continues its sequence "
-                        "numbers (crash/resume). Render with "
+                        "Under multi-host every process writes: the "
+                        "coordinator to this path, host k to "
+                        "PATH.host<k> (merge with tools/"
+                        "fleet_report.py); appending to an existing "
+                        "file continues its sequence numbers "
+                        "(crash/resume). Render with "
                         "tools/telemetry_report.py")
     g.add_argument("--spike_z", type=float, default=8.0,
                    help="loss-spike detector: emit an `anomaly` "
@@ -138,6 +145,38 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
     g.add_argument("--spike_warmup", type=int, default=20,
                    help="steps observed before the spike detector arms "
                         "(early-training loss is legitimately wild)")
+    # fleet observability (DESIGN.md §14)
+    g.add_argument("--watchdog", type=int, default=1,
+                   choices=[0, 1, 2],
+                   help="hang watchdog: a daemon thread dumps every "
+                        "Python thread's stack (faulthandler) and emits "
+                        "a `hang` telemetry event when no step completes "
+                        "within watchdog_mult x the rolling-median step "
+                        "time. 0 = off (kill-switch), 1 = report and "
+                        "keep waiting (deadline backs off 2x), 2 = "
+                        "report then abort the process (exit 113 — for "
+                        "pods where a wedged collective should fail "
+                        "fast instead of burning the reservation)")
+    g.add_argument("--watchdog_mult", type=float, default=10.0,
+                   help="hang deadline = this many rolling-median step "
+                        "times (floored at --watchdog_min_s)")
+    g.add_argument("--watchdog_min_s", type=float, default=60.0,
+                   help="hang deadline floor in seconds; also the "
+                        "pre-first-step grace (compile/eval/checkpoint "
+                        "pauses suspend the clock, so they need no "
+                        "extra padding)")
+    g.add_argument("--straggler_cadence", type=int, default=0,
+                   help="every K steps gather each host's median step "
+                        "time across the fleet (collective; "
+                        "deterministic cadence), stamp the per-host "
+                        "map into step_stats.host_step_ms, and emit a "
+                        "`straggler` event for any host slower than "
+                        "straggler_mult x the fleet median. 0 = off "
+                        "(default: single-host runs have nothing to "
+                        "compare)")
+    g.add_argument("--straggler_mult", type=float, default=1.5,
+                   help="straggler threshold: host median step time vs "
+                        "fleet median")
 
 
 def add_align_flags(p: argparse.ArgumentParser):
@@ -492,20 +531,43 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     step_stats (None: MFU omitted).
     Returns (trainable, opt_state, last_metrics).
     """
-    from mobilefinetuner_tpu.parallel.distributed import (device_put_global,
+    from mobilefinetuner_tpu.parallel.distributed import (allgather_scalars,
+                                                          device_put_global,
                                                           gather_to_host,
                                                           is_coordinator)
     # multi-host: every process runs the identical compiled step over global
-    # arrays; file sinks (CSV/JSONL/telemetry/checkpoints) write once, on
-    # process 0. Saving first gathers cross-process-sharded trees to host
-    # on EVERY process (gather_to_host is collective), then only process 0
-    # writes.
+    # arrays; the CSV/JSONL/checkpoint sinks write once, on process 0.
+    # TELEMETRY writes on every process — the coordinator to the given
+    # path, host k to PATH.host<k>, each record host-stamped — so a
+    # stalled worker leaves evidence instead of silently dropping events
+    # (merge with tools/fleet_report.py). Saving first gathers
+    # cross-process-sharded trees to host on EVERY process
+    # (gather_to_host is collective), then only process 0 writes.
     coord = is_coordinator()
     multiproc = jax.process_count() > 1
-    tel = Telemetry(getattr(args, "telemetry_out", ""), enabled=coord)
+    tel = Telemetry.for_process(getattr(args, "telemetry_out", ""))
     tel.emit("run_start", **run_manifest(vars(args), mesh))
     t_start = time.time()
+    # wall-clock bucket accounting over run_training's whole span; the
+    # buckets sum to run_end.wall_s by construction (DESIGN.md §14)
+    meter = GoodputMeter()
     done_steps = 0
+    governor = None  # assigned in setup; end_run late-binds the local
+    wd = None        # assigned in setup; the outer finally stops it
+
+    def end_run(exit_name: str, steps: int):
+        """Terminate the stream exactly once on any exit path: run_end
+        carries the goodput buckets (plus the governor's own run-total
+        sleep counter — an independently-clocked cross-check of the
+        meter's governor_sleep bucket); emit/close no-op on a closed
+        stream, so nested handlers compose without double emission."""
+        extra = {}
+        if governor is not None:
+            extra["governor_slept_ms"] = round(governor.total_slept_ms, 1)
+        tel.emit("run_end", steps=steps,
+                 wall_s=round(time.time() - t_start, 3),
+                 exit=exit_name, goodput=meter.summary(), **extra)
+        tel.close()
     # EVERYTHING after run_start runs under one handler: a setup
     # failure (device placement OOM, stream construction) must still
     # terminate the stream with run_end{exit: <type>} — emit/close
@@ -519,6 +581,58 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
             zscore=getattr(args, "spike_z", 8.0),
             beta=getattr(args, "spike_beta", 0.98),
             warmup=getattr(args, "spike_warmup", 20)))
+        if tel.resumed and tel.trailing_step_stats and start_step > 0:
+            # crash/resume: re-seed the detector from the prior run's
+            # flushed losses so it does not re-enter warmup and miss a
+            # spike in the first post-resume steps (the exact window
+            # where resume bugs bite). Gated on an ACTUAL checkpoint
+            # resume (start_step > 0): a fresh run that merely reuses a
+            # telemetry path must keep its warmup, or its legitimately
+            # wild early losses fire against the old run's statistics
+            fed = spikes.seed(
+                [r.get("loss") for r in tel.trailing_step_stats],
+                count_hint=max(r.get("step", 0)
+                               for r in tel.trailing_step_stats))
+            log.info(f"spike detector re-seeded from {fed} resumed "
+                     f"step_stats (armed={spikes.count >= spikes.config.warmup})")
+        # hang watchdog (--watchdog 0 disables): fires when no step
+        # completes within watchdog_mult x rolling-median step time,
+        # dumps all thread stacks + emits a `hang` event, then keeps
+        # waiting (1) or aborts the process (2)
+        wd_mode = getattr(args, "watchdog", 1)
+        if wd_mode:
+            wd = HangWatchdog(
+                mult=getattr(args, "watchdog_mult", 10.0),
+                min_deadline_s=getattr(args, "watchdog_min_s", 60.0),
+                # the grace honors the flag exactly (its documented
+                # meaning): compile no longer needs a padded grace —
+                # the compile block suspends the clock
+                grace_s=getattr(args, "watchdog_min_s", 60.0),
+                stacks_file=(tel.path + ".stacks") if tel.path else "",
+                abort=wd_mode == 2,
+                probe_fn=lambda: jax.device_put(
+                    jnp.zeros(())).block_until_ready(),
+                on_hang=lambda p: (
+                    tel.emit("hang", last_seq=tel.last_seq, **p),
+                    log.error(
+                        f"HANG: no step for {p['stall_s']:.1f}s "
+                        f"(deadline {p['deadline_s']:.1f}s) after step "
+                        f"{p['step']}; stacks -> {p['stacks_file']}, "
+                        f"device probe: {p['device_probe']}, "
+                        f"action: {p['action']}")))
+        # wd.paused() as a with-block at every known long pause
+        # (compile, eval, checkpoint): the deadline clock stops — such
+        # a pause may exceed any step-derived deadline — and the resume
+        # cannot be forgotten. No-op context when the watchdog is off.
+        pause = wd.paused if wd is not None else contextlib.nullcontext
+        # straggler attribution: every straggler_cadence steps each host
+        # gathers its median step time (collective, deterministic
+        # cadence); the per-host map lands in step_stats.host_step_ms
+        # and outliers raise `straggler` events (coordinator-side)
+        strag_k = max(getattr(args, "straggler_cadence", 0), 0)
+        strag_mult = getattr(args, "straggler_mult", 1.5)
+        step_clock = StepClock()
+        host_step_ms = {"latest": None}
         # flops_per_step covers the GLOBAL batch, so the MFU denominator is
         # the GLOBAL peak: per-chip peak × every device in the run (a
         # single-chip run is unchanged; an 8-chip run divided by one chip's
@@ -655,6 +769,15 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
             dt_ms = ((time.perf_counter() - t_interval) * 1000 - slept_ms) \
                 / len(buffered)
             wait_ms = waited_ms / len(buffered)
+            # the device_get above SYNCED the interval, so dt_ms is the
+            # honest per-step time (a per-iteration clock under async
+            # dispatch measures only enqueue latency): feed the fleet
+            # timing consumers — the straggler window and the watchdog's
+            # deadline median — from here, the same number step_stats
+            # publishes
+            step_clock.record(dt_ms / 1000.0)
+            if wd is not None:
+                wd.pet(buffered[-1][0], dt_ms / 1000.0)
             hbm = live_hbm_mb() or peak_hbm["mb"]
             mfu = mfu_from(flops_per_step, dt_ms / 1000, peak_flops)
             for (s, ep, toks, _), m in zip(buffered, fetched):
@@ -688,7 +811,8 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 update_ratio=opt_f("update_ratio"),
                 nonfinite_count=(int(m["nonfinite_count"])
                                  if "nonfinite_count" in m else None),
-                hbm_mb=hbm, queue_depth=stream.queue_depth())
+                hbm_mb=hbm, queue_depth=stream.queue_depth(),
+                host_step_ms=host_step_ms["latest"])
             if emit_log and args.log_interval:
                 log.info(
                     f"step {s + 1}/{total_steps} loss={float(m['loss']):.4f} "
@@ -704,25 +828,34 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
             waited_ms = 0.0
             t_interval = time.perf_counter()
 
+        if wd is not None:
+            wd.start()
         try:
             for step in range(start_step, total_steps):
                 # the prefetched stream yields batches already placed (and
                 # dropout-keyed); this next() is the step loop's only input
                 # dependency, and the time it blocks is the host/device
                 # breakdown's host_wait_ms
+                meter.enter("input_wait")
                 t_wait = time.perf_counter()
                 step_i, epoch, batch = next(stream)
                 waited_ms += (time.perf_counter() - t_wait) * 1000
+                meter.enter("step")
                 assert step_i == step  # strict order preservation
                 if compiled_step is None:
                     # AOT compile once: the SAME executable serves every step
                     # (shapes are static), and its memory analysis gives peak
                     # HBM for free — no second trace/compile on the jit cache
                     # path.
+                    meter.enter("compile")
                     t_comp = time.perf_counter()
-                    compiled_step = step_fn.lower(
-                        trainable, frozen, opt_state, batch,
-                        jnp.int32(step)).compile()
+                    # pause the watchdog: a pod-scale compile can exceed
+                    # any grace window, and the loop KNOWS it is compiling
+                    with pause():
+                        compiled_step = step_fn.lower(
+                            trainable, frozen, opt_state, batch,
+                            jnp.int32(step)).compile()
+                    meter.enter("step")
                     peak_hbm["mb"] = compiled_peak_mb(compiled_step)
                     xla_flops = compiled_flops(compiled_step)
                     tel.emit("compile", step=step,
@@ -732,6 +865,14 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                     if peak_hbm["mb"]:
                         log.info(f"compiled step peak HBM: "
                                  f"{peak_hbm['mb']:.0f} MB")
+                    # compile ≠ step time: restart the interval AND its
+                    # accumulators — the pre-compile first-batch wait
+                    # belongs to the init/input_wait goodput buckets,
+                    # not to the first flush's host_wait_ms (it could
+                    # exceed the post-compile dt and report >100%)
+                    t_interval = time.perf_counter()
+                    waited_ms = 0.0
+                    slept_ms = 0.0
                 maybe_profile(step)
                 trainable, opt_state, metrics = compiled_step(
                     trainable, frozen, opt_state, batch, jnp.int32(step))
@@ -744,14 +885,44 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                     # CSV rows; the log line fires exactly on the requested
                     # cadence
                     flush_metrics(emit_log=log_boundary)
+                # idle-reset only (no duration sample — the honest
+                # per-step time comes from the flush's synced interval
+                # average, fed to the watchdog/straggler window inside
+                # flush_metrics)
+                if wd is not None:
+                    wd.pet(step)
+                if strag_k and (step + 1) % strag_k == 0 \
+                        and step_clock.n:
+                    # collective on a deterministic cadence: every
+                    # process reaches this gather at the same step
+                    fleet = allgather_scalars(step_clock.median_ms())
+                    host_step_ms["latest"] = {
+                        str(i): round(v, 3) for i, v in enumerate(fleet)}
+                    med = statistics.median(fleet)
+                    if coord and med > 0:
+                        for h, v in enumerate(fleet):
+                            if v > strag_mult * med:
+                                tel.emit("straggler", step=step + 1,
+                                         slow_host=h, host_ms=round(v, 3),
+                                         fleet_ms=round(med, 3),
+                                         ratio=round(v / med, 3))
+                                log.warning(
+                                    f"straggler: host {h} at {v:.1f} "
+                                    f"ms/step vs fleet median "
+                                    f"{med:.1f} ms ({v / med:.2f}x)")
+                    step_clock.reset()
 
                 if (args.eval_interval and valid_ds is not None
                         and (step + 1) % args.eval_interval == 0):
                     flush_metrics(emit_log=False)  # off-cadence boundary flush
-                    ev = evaluate(eval_step, trainable, frozen, valid_ds,
-                                  args.eval_batches, mesh=eval_mesh,
-                                  sequence_parallel=eval_sp,
-                                  prefetch=prefetch_depth)
+                    meter.enter("eval")
+                    with pause():  # an eval may exceed any step deadline
+                        ev = evaluate(eval_step, trainable, frozen,
+                                      valid_ds, args.eval_batches,
+                                      mesh=eval_mesh,
+                                      sequence_parallel=eval_sp,
+                                      prefetch=prefetch_depth)
+                    meter.enter("step")
                     log.info(f"eval @ step {step + 1}: loss={ev['loss']:.4f} "
                              f"ppl={ev['ppl']:.2f} ({ev['tokens']} tokens)")
                     if eval_jsonl:
@@ -766,27 +937,33 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 if args.save_every and save_hook and (step + 1) % \
                         args.save_every == 0 and (step + 1) < total_steps:
                     flush_metrics(emit_log=False)  # off-cadence boundary flush
+                    meter.enter("checkpoint")
                     t_save = time.perf_counter()
-                    save_hook(step + 1, trainable, opt_state, final=False)
+                    with pause():  # a slow save is not a hang
+                        save_hook(step + 1, trainable, opt_state,
+                                  final=False)
+                    meter.enter("step")
                     tel.emit("checkpoint", step=step + 1, final=False,
                              wall_s=round(time.perf_counter() - t_save, 3))
                     t_interval = time.perf_counter()  # save time ≠ step time
 
+                meter.enter("governor_sleep")
                 slept_ms += governor.throttle(step)
+                meter.enter("step")
                 done_steps = step + 1 - start_step
         except BaseException as e:
             # the stream records HOW the run ended before the exception
             # propagates — a crashed run's tail is run_start..last flush +
             # run_end{exit: <type>}, which is what post-mortems need
-            tel.emit("run_end", steps=done_steps,
-                     wall_s=round(time.time() - t_start, 3),
-                     exit=type(e).__name__)
-            tel.close()
+            end_run(type(e).__name__, done_steps)
             raise
         finally:
             # stop the producer thread even when the consumer dies mid-epoch
             # (compiled-step failure, KeyboardInterrupt): no leaked threads,
-            # and the original exception propagates untouched
+            # and the original exception propagates untouched. The
+            # watchdog is NOT stopped here — the post-loop tail (final
+            # eval + final save) stays monitored; wd_ref's outer finally
+            # owns the stop.
             stream.close()
             # profiler-leak fix: a run whose total_steps end (or whose
             # exception) lands inside the profiling window used to leave the
@@ -799,13 +976,17 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
         # the post-loop tail (final flush/eval/save) carries the same
         # run_end-on-exception contract as the loop: a disk-full save or a
         # lost-worker collective here must still leave run_end{exit: <type>}
+        meter.enter("shutdown")
         try:
             flush_metrics()
             if valid_ds is not None and args.eval_interval:
-                ev = evaluate(eval_step, trainable, frozen, valid_ds,
-                              args.eval_batches, mesh=eval_mesh,
-                              sequence_parallel=eval_sp,
-                              prefetch=prefetch_depth)
+                meter.enter("eval")
+                with pause():  # unbounded legitimate pause
+                    ev = evaluate(eval_step, trainable, frozen, valid_ds,
+                                  args.eval_batches, mesh=eval_mesh,
+                                  sequence_parallel=eval_sp,
+                                  prefetch=prefetch_depth)
+                meter.enter("shutdown")
                 log.info(f"final eval: loss={ev['loss']:.4f} "
                          f"ppl={ev['ppl']:.2f}")
                 if eval_jsonl:
@@ -816,31 +997,33 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 tel.emit("eval", step=total_steps, loss=ev["loss"],
                          ppl=ev["ppl"], tokens=ev["tokens"])
             if save_hook:
+                meter.enter("checkpoint")
                 t_save = time.perf_counter()
-                save_hook(total_steps, trainable, opt_state, final=True)
+                with pause():
+                    save_hook(total_steps, trainable, opt_state,
+                              final=True)
+                meter.enter("shutdown")
                 tel.emit("checkpoint", step=total_steps, final=True,
                          wall_s=round(time.perf_counter() - t_save, 3))
         except BaseException as e:
-            tel.emit("run_end", steps=done_steps,
-                     wall_s=round(time.time() - t_start, 3),
-                     exit=type(e).__name__)
-            tel.close()
+            end_run(type(e).__name__, done_steps)
             raise
         live = live_hbm_mb()
         log.info(f"peak HBM: {peak_hbm['mb']:.0f} MB (compiled estimate)"
                  + (f", {live:.0f} MB live" if live else ""))
         if metrics_csv:
             metrics_csv.close()
-        tel.emit("run_end", steps=total_steps - start_step,
-                 wall_s=round(time.time() - t_start, 3), exit="ok")
-        tel.close()
+        end_run("ok", total_steps - start_step)
         return trainable, opt_state, metrics
     except BaseException as e:
-        tel.emit("run_end", steps=done_steps,
-                 wall_s=round(time.time() - t_start, 3),
-                 exit=type(e).__name__)
-        tel.close()
+        end_run(type(e).__name__, done_steps)
         raise
+    finally:
+        # the watchdog outlives the step loop on purpose (the post-loop
+        # tail stays monitored); this is the single stop for every exit
+        # path — return, loop exception, tail exception, setup failure
+        if wd is not None:
+            wd.stop()
 
 
 def setup_frozen_params(args, params, mesh):

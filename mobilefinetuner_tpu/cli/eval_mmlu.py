@@ -128,9 +128,9 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     import time as _time
     from mobilefinetuner_tpu.core.telemetry import Telemetry, run_manifest
-    from mobilefinetuner_tpu.parallel.distributed import is_coordinator
-    tel = Telemetry(getattr(args, "telemetry_out", ""),
-                    enabled=is_coordinator())
+    # fleet-aware: each process writes its own host-stamped shard
+    # (coordinator at the given path; merge with tools/fleet_report.py)
+    tel = Telemetry.for_process(getattr(args, "telemetry_out", ""))
     tel.emit("run_start", **run_manifest(vars(args)))
     t0 = _time.time()
     (hidden_fn, head_key, compute_dtype, tok, letter_encode, max_len,
@@ -191,7 +191,7 @@ def main(argv=None) -> int:
              tokens=result.total, macro_accuracy=report["macro_accuracy"],
              micro_accuracy=report["micro_accuracy"])
     tel.emit("run_end", steps=result.total,
-             wall_s=round(_time.time() - t0, 3), exit="ok")
+             wall_s=round(_time.time() - t0, 3), exit="ok", goodput=None)
     tel.close()
     print(json.dumps({"macro_accuracy": report["macro_accuracy"],
                       "micro_accuracy": report["micro_accuracy"],

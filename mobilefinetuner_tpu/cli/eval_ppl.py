@@ -129,8 +129,9 @@ def main(argv=None) -> int:
 
     jsonl = JSONLWriter(args.out) if args.out else None
     from mobilefinetuner_tpu.core.telemetry import Telemetry, run_manifest
-    from mobilefinetuner_tpu.parallel.distributed import is_coordinator
-    tel = Telemetry(args.telemetry_out, enabled=is_coordinator())
+    # fleet-aware: each process writes its own host-stamped shard
+    # (coordinator at the given path; merge with tools/fleet_report.py)
+    tel = Telemetry.for_process(args.telemetry_out)
     tel.emit("run_start", **run_manifest(vars(args)))
     # device-side accumulation: per-batch float(s)/int(c) forced a full
     # device sync per eval step — the sums stay on device (tiny adds on
@@ -177,8 +178,9 @@ def main(argv=None) -> int:
     if jsonl:
         jsonl.write(record)
     tel.emit("eval", step=n_done, loss=mean, ppl=ppl, tokens=count)
+    # goodput is None: the eval CLIs have no metered phase loop
     tel.emit("run_end", steps=n_done,
-             wall_s=round(time.time() - t0, 3), exit="ok")
+             wall_s=round(time.time() - t0, 3), exit="ok", goodput=None)
     tel.close()
     print(json.dumps(record))
     return 0

@@ -13,10 +13,12 @@ training/eval entry point writes through, with a fixed event taxonomy
 tests/test_telemetry.py both validate against, so the contract cannot
 drift from the implementation.
 
-Design rules (DESIGN.md §13):
-  - coordinator-only sink: under multi-host every process computes the
-    same metrics, but only process 0 writes (same rule as the CSV/JSONL
-    sinks in cli/common.run_training);
+Design rules (DESIGN.md §13, fleet-extended by §14):
+  - per-host shards: under multi-host EVERY process writes — the
+    coordinator to the requested path, host k to `<path>.host<k>`
+    (`shard_path`/`Telemetry.for_process`), each record host-stamped —
+    so a stalled worker leaves evidence; the CSV/JSONL/checkpoint sinks
+    in cli/common.run_training stay coordinator-only;
   - crash-durable: every event is written and flushed individually, so
     a killed run keeps everything up to its last completed flush; a
     resumed run APPENDS to the same stream, continuing the monotonic
@@ -35,12 +37,18 @@ in-loop `step_stats.mfu` agree by construction
 
 from __future__ import annotations
 
+import collections
+import contextlib
 import dataclasses
+import faulthandler
 import json
 import math
 import os
+import statistics
+import tempfile
+import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 # --------------------------- event taxonomy ---------------------------------
 
@@ -50,8 +58,11 @@ _OPT_STR = (str, type(None))
 
 # Per-event required payload fields and their allowed types. Every event
 # additionally carries the envelope: event (str), seq (int, monotonic per
-# stream), t (float unix time). Extra fields are ALLOWED (the schema is a
-# floor, not a ceiling) so events can grow without breaking old readers.
+# stream), t (float unix time), and — since the fleet layer (DESIGN.md
+# §14) — host (int process index; 0 on single-host, optional for
+# back-compat with pre-fleet streams). Extra fields are ALLOWED (the
+# schema is a floor, not a ceiling) so events can grow without breaking
+# old readers.
 EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
     # one per run, always the stream's first event of that run
     "run_start": {
@@ -90,6 +101,9 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "nonfinite_count": _OPT_NUM,
         "hbm_mb": _NUM,
         "queue_depth": _OPT_NUM,    # input-pipeline gauge (None: no stream)
+        "host_step_ms": (dict, type(None)),  # {host: per-step ms} from the
+                                    # last straggler-cadence gather; None
+                                    # when --straggler_cadence is off
     },
     # governor throttle decision (system/governor.py event_sink)
     "throttle": {
@@ -121,12 +135,50 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "final": (bool,),
         "wall_s": _NUM,
     },
-    # one per run on orderly exit; exit != "ok" names the exception type
+    # one host's measured per-step time pulled away from the fleet: fired
+    # by the coordinator after a --straggler_cadence cross-host gather
+    # when host_ms > straggler_mult * fleet median
+    "straggler": {
+        "step": (int,),
+        "slow_host": (int,),        # NOT "host": that's the envelope's
+                                    # writer stamp (the coordinator
+                                    # emits this about another process)
+        "host_ms": _NUM,            # the slow host's median step ms
+        "fleet_ms": _NUM,           # fleet median over the same window
+        "ratio": _NUM,              # host_ms / fleet_ms
+    },
+    # hang watchdog fired: no step completed within the armed deadline.
+    # The Python stacks of every thread are in stacks_file (faulthandler
+    # dump) and device_probe says whether a trivial device op still
+    # completes ("ok" | "timeout" | "error:<type>" | "skipped").
+    "hang": {
+        "step": (int,),             # last COMPLETED step
+        "stall_s": _NUM,            # time since the last completed step
+        "deadline_s": _NUM,         # the armed deadline that expired
+        "stacks_file": (str,),
+        "device_probe": (str,),
+        "action": (str,),           # "continue" | "abort"
+    },
+    # one per run on orderly exit; exit != "ok" names the exception type.
+    # goodput: wall-clock bucket totals (seconds) from GoodputMeter — the
+    # buckets sum to the run's wall time by construction (None on entry
+    # points without a metered loop, e.g. the eval CLIs).
     "run_end": {
         "steps": (int,),
         "wall_s": _NUM,
         "exit": (str,),
+        "goodput": (dict, type(None)),
     },
+}
+
+
+# Fields added AFTER a schema generation was already in the wild:
+# current writers always emit them, but a reader must accept their
+# ABSENCE so pre-fleet (round-8) streams still validate and render —
+# when present they are type-checked as usual.
+OPTIONAL_FIELDS: Dict[str, frozenset] = {
+    "step_stats": frozenset({"host_step_ms"}),
+    "run_end": frozenset({"goodput"}),
 }
 
 
@@ -143,8 +195,16 @@ def validate_event(rec: Any) -> Optional[str]:
         return f"{ev}: bad seq {rec.get('seq')!r}"
     if not isinstance(rec.get("t"), (int, float)):
         return f"{ev}: bad t {rec.get('t')!r}"
+    # host is envelope, stamped by the fleet layer; optional so pre-fleet
+    # streams still validate
+    if "host" in rec and (not isinstance(rec["host"], int)
+                          or isinstance(rec["host"], bool)
+                          or rec["host"] < 0):
+        return f"{ev}: bad host {rec.get('host')!r}"
     for field, types in EVENT_SCHEMA[ev].items():
         if field not in rec:
+            if field in OPTIONAL_FIELDS.get(ev, ()):
+                continue  # pre-fleet stream: absence is legal on read
             return f"{ev}: missing field {field!r}"
         v = rec[field]
         # bool is an int subclass; reject it where a number is expected
@@ -157,24 +217,42 @@ def validate_event(rec: Any) -> Optional[str]:
 
 # --------------------------- the JSONL sink ---------------------------------
 
-def _last_seq(path: str) -> int:
-    """Highest seq among the file's valid JSONL lines (-1 when none).
-    Scans the whole file: it is read once at open, and a telemetry stream
-    is small (one step_stats per flush, not per step)."""
+def shard_path(path: str, host: int) -> str:
+    """Per-host shard naming (DESIGN.md §14): the coordinator keeps the
+    requested path, host k > 0 appends `.host<k>` — a single-host run
+    keeps the pre-fleet path and schema (records additionally carry the
+    `host` envelope stamp), and a pod run leaves one mergeable shard per
+    process next to it."""
+    if not path or host == 0:
+        return path
+    return f"{path}.host{host}"
+
+
+def _scan_existing(path: str, trailing: int = 256):
+    """(last_seq, trailing step_stats records) from the file's valid JSONL
+    lines; (-1, []) when the file is absent/empty. Scans the whole file: it
+    is read once at open, and a telemetry stream is small (one step_stats
+    per flush, not per step). The trailing step_stats feed the spike
+    detector's crash/resume re-seed (SpikeDetector.seed)."""
     last = -1
+    tail: collections.deque = collections.deque(maxlen=trailing)
     try:
         with open(path, "rb") as f:
             for raw in f:
                 try:
                     rec = json.loads(raw)
-                    s = rec.get("seq")
-                    if isinstance(s, int):
-                        last = max(last, s)
                 except (json.JSONDecodeError, UnicodeDecodeError):
                     continue  # truncated tail line from a crashed writer
+                if not isinstance(rec, dict):
+                    continue
+                s = rec.get("seq")
+                if isinstance(s, int):
+                    last = max(last, s)
+                if rec.get("event") == "step_stats":
+                    tail.append(rec)
     except OSError:
-        return -1
-    return last
+        return -1, []
+    return last, list(tail)
 
 
 def _json_finite(v):
@@ -192,23 +270,36 @@ def _json_finite(v):
 class Telemetry:
     """Append-only JSONL event stream, one record per `emit` call.
 
-    A falsy `path` (or enabled=False — how non-coordinator processes are
-    muted) makes every method a no-op, so call sites never branch.
-    Appending to an existing file continues its seq numbering — the
-    crash/resume contract: one stream per run directory, ordered across
-    process restarts.
+    A falsy `path` (or enabled=False) makes every method a no-op, so call
+    sites never branch. Appending to an existing file continues its seq
+    numbering — the crash/resume contract: one stream per run directory,
+    ordered across process restarts. `resumed` is True exactly then, and
+    `trailing_step_stats` holds the prior run's tail of step_stats
+    records (the spike-detector re-seed source).
+
+    `host` stamps every record's envelope with the writing process index
+    (fleet merge key together with seq); emit is lock-serialized so the
+    hang watchdog's daemon thread can report through the same stream as
+    the step loop.
     """
 
-    def __init__(self, path: str = "", enabled: bool = True):
+    def __init__(self, path: str = "", enabled: bool = True,
+                 host: int = 0):
         self.path = path
+        self.host = int(host)
         self.enabled = bool(path) and enabled
         self._f = None
         self._seq = 0
+        self._lock = threading.Lock()
+        self.resumed = False
+        self.trailing_step_stats: List[dict] = []
         if self.enabled:
             d = os.path.dirname(os.path.abspath(path))
             os.makedirs(d, exist_ok=True)
             if os.path.exists(path):
-                self._seq = _last_seq(path) + 1
+                last, self.trailing_step_stats = _scan_existing(path)
+                self._seq = last + 1
+                self.resumed = last >= 0
             self._f = open(path, "a", encoding="utf-8")
             # a killed writer can leave a partial line with NO trailing
             # newline; terminate it so this run's first event starts a
@@ -228,26 +319,54 @@ class Telemetry:
         consumers (jq, JSON.parse) on exactly the divergence records the
         stream exists to capture; the `anomaly` event's kind field
         carries the non-finiteness."""
-        if not self.enabled or self._f is None:
-            return None
-        rec = {"event": event, "seq": self._seq, "t": time.time(),
-               **{k: _json_finite(v) for k, v in fields.items()}}
-        self._seq += 1
-        self._f.write(json.dumps(rec) + "\n")
-        self._f.flush()
-        return rec
+        with self._lock:
+            if not self.enabled or self._f is None:
+                return None
+            # envelope last: a payload field may not shadow the stream's
+            # identity keys (event/seq/t/host) — the straggler event
+            # learned this the hard way (its slow-host field is named
+            # slow_host for exactly this reason)
+            rec = {**{k: _json_finite(v) for k, v in fields.items()},
+                   "event": event, "seq": self._seq, "t": time.time(),
+                   "host": self.host}
+            self._seq += 1
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
+            return rec
 
     def close(self):
-        if self._f is not None:
-            self._f.close()
-            self._f = None
-        self.enabled = False
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+            self.enabled = False
 
     def __enter__(self) -> "Telemetry":
         return self
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+    @property
+    def last_seq(self) -> int:
+        """seq of the most recently emitted record (-1: none yet) — the
+        hang event reports it so a post-mortem can line the stall up
+        against the stream's tail."""
+        return self._seq - 1
+
+    @classmethod
+    def for_process(cls, path: str) -> "Telemetry":
+        """The fleet-aware stream for THIS process: coordinator writes the
+        requested path, every other process its `.host<k>` shard, all
+        host-stamped. Replaces the pre-fleet coordinator-only muting —
+        under multi-host a stalled non-coordinator used to be invisible;
+        now every host leaves a mergeable record
+        (tools/fleet_report.py)."""
+        if not path:
+            return cls("")
+        import jax
+        host = jax.process_index()
+        return cls(shard_path(path, host), host=host)
 
 
 def run_manifest(config: dict, mesh=None) -> dict:
@@ -334,6 +453,363 @@ class SpikeDetector:
         self.var = c.beta * self.var + (1 - c.beta) * dev * dev
         self.count += 1
         return out
+
+    def seed(self, losses: Sequence[float], count_hint: int = 0) -> int:
+        """Re-seed from a resumed run's trailing flushed losses (the
+        telemetry stream's step_stats tail) so a crash/resume does NOT
+        re-enter warmup: a fresh detector needs `warmup` observations
+        before arming, and a spike in the first post-resume steps — the
+        exact window where resume bugs (stale optimizer state, data-order
+        skew) bite — would be silently missed. The historical losses walk
+        the EMA mean/variance to the pre-crash level without firing
+        (seeding never emits), and `count_hint` (the resumed step number)
+        bumps the observation count past warmup even when the stream's
+        flush cadence kept fewer than `warmup` step_stats lines. Returns
+        the number of samples consumed."""
+        fed = 0
+        for loss in losses:
+            if not isinstance(loss, (int, float)) \
+                    or not math.isfinite(loss):
+                continue
+            if self.mean is None:
+                self.mean = float(loss)
+            else:
+                dev = float(loss) - self.mean
+                c = self.config
+                self.mean = c.beta * self.mean + (1 - c.beta) * float(loss)
+                self.var = c.beta * self.var + (1 - c.beta) * dev * dev
+            self.count += 1
+            fed += 1
+        self.count = max(self.count, int(count_hint))
+        return fed
+
+
+# --------------------------- goodput accounting -----------------------------
+
+# Every second of a run's wall-clock lands in exactly one bucket:
+#   init           process start -> first batch requested (model load,
+#                  placement, stream construction)
+#   compile        blocked in XLA compilation
+#   step           dispatching/retiring optimizer steps (the productive
+#                  bucket; includes the flush device_get, which is time
+#                  spent WAITING for useful device work)
+#   input_wait     step loop blocked pulling the next batch from the
+#                  input pipeline (host-bound: tokenization/refetch)
+#   eval           in-loop + final evaluation
+#   checkpoint     save_hook wall time
+#   governor_sleep duty-cycle throttle sleeps (deliberate idleness)
+#   shutdown       post-loop teardown until run_end
+GOODPUT_BUCKETS = ("init", "compile", "step", "input_wait", "eval",
+                   "checkpoint", "governor_sleep", "shutdown")
+
+
+class GoodputMeter:
+    """Wall-clock classifier: at any instant the run is in exactly ONE
+    phase, `enter(phase)` charges the elapsed time to the previous one,
+    so the buckets sum to total wall-clock BY CONSTRUCTION (the
+    acceptance criterion's within-1% identity is structural, not
+    approximate). `summary()` is the run_end `goodput` payload."""
+
+    def __init__(self):
+        self.buckets = {b: 0.0 for b in GOODPUT_BUCKETS}
+        self._phase = "init"
+        self._mark = time.perf_counter()
+
+    @property
+    def phase(self) -> str:
+        return self._phase
+
+    def enter(self, phase: str) -> None:
+        assert phase in self.buckets, phase
+        now = time.perf_counter()
+        self.buckets[self._phase] += now - self._mark
+        self._mark = now
+        self._phase = phase
+
+    def summary(self) -> dict:
+        """Close the current phase and render {total_s, productive_frac,
+        <bucket>_s...}. productive_frac = step / total — the goodput
+        number: what fraction of wall-clock advanced training.
+        total_s is derived from the ROUNDED buckets (not independently
+        rounded), so the emitted record itself satisfies the
+        sum-to-total identity, not just the internal floats."""
+        self.enter(self._phase)  # charge the open phase through `now`
+        out = {f"{b}_s": round(v, 4) for b, v in self.buckets.items()}
+        total = round(sum(out.values()), 6)
+        out["total_s"] = total
+        out["productive_frac"] = round(
+            out["step_s"] / total, 4) if total > 0 else 0.0
+        return out
+
+
+# --------------------------- step-time window -------------------------------
+
+class StepClock:
+    """Rolling host-side per-step time window (the trainer's timing
+    hook for the fleet layer; re-exported as train.trainer.StepClock).
+
+    The step loop feeds it the FLUSH-INTERVAL synced per-step average
+    (the same measurement step_stats.step_time_ms publishes; governor
+    sleep excluded) — under async dispatch a per-iteration wall clock
+    measures only enqueue latency, so the device_get-synced interval
+    average is the honest per-step number. Consumers read the MEDIAN
+    (robust: one compile- or eval-inflated sample must not shift it):
+    the straggler-attribution cadence gathers `median_ms()` across
+    hosts, and the hang watchdog derives its deadline from the same
+    window mechanism. `reset()` starts a fresh window at a cadence
+    boundary."""
+
+    def __init__(self, window: int = 512):
+        self._durs: collections.deque = collections.deque(maxlen=window)
+
+    def record(self, seconds: float) -> None:
+        self._durs.append(max(float(seconds), 0.0))
+
+    @property
+    def n(self) -> int:
+        return len(self._durs)
+
+    def median_s(self) -> float:
+        return statistics.median(self._durs) if self._durs else 0.0
+
+    def median_ms(self) -> float:
+        return self.median_s() * 1000.0
+
+    def reset(self) -> None:
+        self._durs.clear()
+
+
+# --------------------------- hang watchdog ----------------------------------
+
+class HangWatchdog:
+    """Daemon-thread deadline on step completion (DESIGN.md §14).
+
+    State machine: GRACE (armed at start(), deadline `grace_s` — covers
+    pre-first-step setup; compile itself should be wrapped in paused()
+    by the caller, as cli/common.run_training does) -> ARMED (after the
+    first pet(), deadline
+    max(mult x rolling-median step time, min_deadline_s), re-armed by
+    every pet; SUSPENDED across known long pauses — eval, checkpoint —
+    via suspend()/resume(), because such a pause may legitimately exceed
+    any step-derived deadline) -> FIRED (deadline expired with no pet:
+    dump ALL Python
+    thread stacks via faulthandler to `stacks_file`, probe the device
+    with a trivial op under a bounded side-thread join, report through
+    `on_hang`, then either re-arm with a doubled deadline — so a truly
+    wedged run logs O(log) hang events, not one per deadline — or abort
+    the process). stop() ends the thread on every loop exit path.
+
+    The deadline tracks the RUN'S OWN step-time distribution (rolling
+    median over `window` completed steps), not a fixed constant: a
+    governor-throttled 2 s/step run and a 20 ms/step LoRA run both get a
+    meaningful multiple of normal. The median is robust to the
+    compile-inflated first sample and to eval/checkpoint pauses, whose
+    iterations pet late but are single samples.
+
+    Everything observable is injectable (`probe_fn`, `abort_fn`,
+    `clock`) so the injected-stall tests are deterministic and never
+    kill the test process.
+    """
+
+    def __init__(self, mult: float = 10.0, min_deadline_s: float = 60.0,
+                 grace_s: float = 300.0,
+                 on_hang: Optional[Callable[[dict], Any]] = None,
+                 stacks_file: str = "", abort: bool = False,
+                 probe_fn: Optional[Callable[[], Any]] = None,
+                 abort_fn: Optional[Callable[[int], Any]] = None,
+                 window: int = 31, probe_timeout_s: float = 5.0):
+        self.mult = float(mult)
+        self.min_deadline_s = float(min_deadline_s)
+        self.grace_s = float(grace_s)
+        self.on_hang = on_hang
+        self.stacks_file = stacks_file or os.path.join(
+            tempfile.gettempdir(), f"hang_stacks_{os.getpid()}.txt")
+        self.abort = bool(abort)
+        self._probe_fn = probe_fn
+        self._abort_fn = abort_fn or os._exit
+        self._probe_timeout_s = float(probe_timeout_s)
+        self._clock = StepClock(window=window)
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._suspended = False
+        self._last_pet = time.perf_counter()
+        self._last_step = -1
+        self._deadline_s = max(self.grace_s, self.min_deadline_s)
+        self._backoff = 1.0
+        self.fired = 0  # hang events raised (test + report observable)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- step-loop side -----------------------------------------------------
+    def start(self) -> "HangWatchdog":
+        # the GRACE clock starts HERE, not at construction: the caller
+        # may build the watchdog early in setup and arm it only at the
+        # loop, and that gap must not count against the grace deadline
+        self._last_pet = time.perf_counter()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="hang-watchdog")
+        self._thread.start()
+        return self
+
+    def pet(self, step: int, step_s: Optional[float] = None) -> None:
+        """A step completed: re-arm the idle deadline; with `step_s`,
+        also feed a duration sample and recompute the deadline. The two
+        are split because under async dispatch the per-iteration wall
+        time is just enqueue latency — the honest duration is the
+        flush-interval synced average, so the loop pets every iteration
+        (idle reset) and feeds samples only at flush boundaries."""
+        with self._lock:
+            if step_s is not None:
+                self._clock.record(step_s)
+                self._deadline_s = max(self.mult * self._clock.median_s(),
+                                       self.min_deadline_s)
+            self._backoff = 1.0
+            self._last_step = step
+            self._last_pet = time.perf_counter()
+        self._wake.set()
+
+    def touch(self) -> None:
+        """Reset the idle clock without a completed step."""
+        with self._lock:
+            self._last_pet = time.perf_counter()
+        self._wake.set()
+
+    def suspend(self) -> None:
+        """Stop the deadline clock across a legitimate long pause the
+        loop KNOWS about (eval, checkpoint save): the pause may exceed
+        any step-derived deadline, and the watchdog must not fire MID
+        pause — a touch() after the pause returns would be too late."""
+        with self._lock:
+            self._suspended = True
+        self._wake.set()
+
+    def resume(self) -> None:
+        """End a suspend(): the idle clock restarts from now."""
+        with self._lock:
+            self._suspended = False
+            self._last_pet = time.perf_counter()
+        self._wake.set()
+
+    @contextlib.contextmanager
+    def paused(self):
+        """suspend()/resume() as a with-block: the resume cannot be
+        forgotten even if the pause body raises."""
+        self.suspend()
+        try:
+            yield
+        finally:
+            self.resume()
+
+    def stop(self) -> None:
+        self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    # -- watchdog-thread side -----------------------------------------------
+    def _probe_device(self) -> str:
+        """Run the probe in a bounded side thread: the whole point of the
+        probe is that a wedged collective may never return, and the
+        watchdog thread must survive to write the report."""
+        if self._probe_fn is None:
+            return "skipped"
+        result = {}
+
+        def go():
+            try:
+                self._probe_fn()
+                result["r"] = "ok"
+            except BaseException as e:  # noqa: BLE001 — report, not mask
+                result["r"] = f"error:{type(e).__name__}"
+
+        t = threading.Thread(target=go, daemon=True, name="hang-probe")
+        t.start()
+        t.join(timeout=self._probe_timeout_s)
+        return result.get("r", "timeout")
+
+    def _dump_stacks(self) -> None:
+        try:
+            with open(self.stacks_file, "a") as f:
+                f.write(f"=== hang at {time.strftime('%Y-%m-%d %H:%M:%S')}"
+                        f" (last step {self._last_step}) ===\n")
+                f.flush()
+                faulthandler.dump_traceback(file=f, all_threads=True)
+        except OSError:
+            pass  # the report event still fires
+
+    def _run(self) -> None:
+        while not self._stop:
+            with self._lock:
+                deadline = self._deadline_s * self._backoff
+                idle = time.perf_counter() - self._last_pet
+                suspended = self._suspended
+            if suspended:
+                # clock stopped (known pause); resume() wakes us
+                self._wake.wait(timeout=0.25)
+                self._wake.clear()
+                continue
+            if idle < deadline:
+                # sleep only to the earliest possible expiry; a pet wakes
+                # us immediately so the loop re-reads the fresh deadline
+                self._wake.wait(timeout=max(deadline - idle, 0.02))
+                self._wake.clear()
+                continue
+            # deadline expired with no completed step: FIRED
+            self._dump_stacks()
+            probe = self._probe_device()
+            self.fired += 1
+            payload = {"step": self._last_step,  # last COMPLETED step
+                       "stall_s": round(idle, 3),
+                       "deadline_s": round(deadline, 3),
+                       "stacks_file": self.stacks_file,
+                       "device_probe": probe,
+                       "action": "abort" if self.abort else "continue"}
+            if self.on_hang is not None:
+                try:
+                    self.on_hang(payload)
+                except Exception:
+                    pass  # reporting failure must not kill the watchdog
+            if self.abort:
+                # a wedged collective cannot be unwound by raising in
+                # another thread; hard-exit is the honest abort (the
+                # stacks + hang event are already durable)
+                self._abort_fn(113)
+                return
+            with self._lock:
+                self._last_pet = time.perf_counter()
+                self._backoff *= 2.0  # O(log) events while truly wedged
+
+
+# --------------------------- partial goodput (reader side) ------------------
+
+def partial_goodput(events: Sequence[dict]) -> dict:
+    """Best-effort goodput buckets for a TRUNCATED stream (killed run, no
+    run_end): reconstruct what the events themselves carry — compile and
+    checkpoint wall times are explicit, governor sleep totals ride in
+    step_stats.slept_ms, and input-wait is the flush-interval host-wait
+    fraction applied to the observed step span. Marked partial=True; the
+    buckets do NOT sum to wall-clock (that identity needs the writer-side
+    GoodputMeter)."""
+    compile_s = sum(e.get("wall_s") or 0.0 for e in events
+                    if e.get("event") == "compile")
+    ckpt_s = sum(e.get("wall_s") or 0.0 for e in events
+                 if e.get("event") == "checkpoint")
+    stats = [e for e in events if e.get("event") == "step_stats"]
+    sleep_s = sum((e.get("slept_ms") or 0.0) for e in stats) / 1000.0
+    times = sum(e.get("step_time_ms") or 0.0 for e in stats)
+    waits = sum(e.get("host_wait_ms") or 0.0 for e in stats)
+    wait_frac = waits / times if times > 0 else 0.0
+    first_t = events[0]["t"] if events else 0.0
+    last_t = events[-1]["t"] if events else 0.0
+    span = max(last_t - first_t, 0.0)
+    return {
+        "partial": True,
+        "compile_s": round(compile_s, 4),
+        "checkpoint_s": round(ckpt_s, 4),
+        "governor_sleep_s": round(sleep_s, 4),
+        "input_wait_frac_of_step": round(wait_frac, 4),
+        "observed_span_s": round(span, 4),
+    }
 
 
 # --------------------------- FLOP / MFU accounting --------------------------

@@ -198,6 +198,23 @@ def put_batch_global(batch: dict, sharding_for) -> dict:
             for k, v in batch.items()}
 
 
+def allgather_scalars(value: float) -> list:
+    """Every process's copy of a host-side scalar, as a plain list indexed
+    by process: the straggler-attribution primitive (cli/common.py feeds
+    each host's measured per-step wall time through on the
+    --straggler_cadence boundary, and the coordinator compares the fleet).
+    COLLECTIVE under multi-process — every process must call it at the
+    same step, which the deterministic cadence guarantees. Single-process:
+    [value], no device work at all, so the single-host path costs
+    nothing."""
+    if jax.process_count() == 1:
+        return [float(value)]
+    from jax.experimental import multihost_utils
+    out = multihost_utils.process_allgather(
+        np.asarray([value], np.float32))
+    return [float(v) for v in np.asarray(out).reshape(-1)]
+
+
 def gather_to_host(tree):
     """Bring a (possibly cross-process-sharded) pytree fully to host for
     checkpoint writing. COLLECTIVE under multi-process: every process must

@@ -122,6 +122,12 @@ class StepGovernor:
         self._last_battery: Optional[float] = None
         self._last_temp: Optional[float] = None
         self._last_emitted = None  # (sleep_ms, source) of the last event
+        # run-total deliberate idleness, independently clocked from the
+        # goodput meter's governor_sleep bucket; run_end carries it as
+        # governor_slept_ms (cli/common.end_run) so a post-mortem can
+        # cross-check the two (the per-flush slept_ms in step_stats is
+        # interval-scoped and resets)
+        self.total_slept_ms = 0.0
 
     # -- telemetry ----------------------------------------------------------
     def set_manual_telemetry(self, battery: Optional[float] = None,
@@ -211,5 +217,6 @@ class StepGovernor:
                     self._event_sink({
                         "step": step, "sleep_ms": ms, "battery": batt,
                         "temp": temp, "source": src})
+            self.total_slept_ms += ms
             time.sleep(ms / 1000.0)
         return ms

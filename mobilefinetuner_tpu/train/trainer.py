@@ -163,3 +163,14 @@ def make_eval_step(nll_fn: Callable[[Any, Any, dict], tuple]):
 def init_optimizer(trainable, train_cfg: TrainConfig,
                    mask: Optional[Any] = None) -> dict:
     return init_state(trainable, train_cfg.adam(), mask)
+
+
+# The trainer's timing hook for the fleet-observability layer
+# (DESIGN.md §14): the step loop records each completed optimizer step's
+# wall seconds (deliberate idleness — governor sleep, input wait —
+# excluded by the caller) and the straggler-attribution cadence gathers
+# `median_ms()` across hosts via `parallel.distributed.allgather_scalars`.
+# ONE implementation serves both it and the hang watchdog's deadline
+# window, so it lives in core/telemetry (no jax dependency) and is
+# re-exported here as the training-facing surface.
+from mobilefinetuner_tpu.core.telemetry import StepClock  # noqa: E402,F401

@@ -82,6 +82,45 @@ def test_all_scripts_bash_parse():
         subprocess.run(["bash", "-n", sh], check=True)
 
 
+def test_multihost_smoke_shards_merge_through_fleet_report(tmp_path):
+    """Fleet-observability recipe guard (DESIGN.md §14): the smoke tool's
+    simulated two-host shard writer produces exactly the per-host layout
+    (base + base.host1) that tools/fleet_report.py discovers and merges —
+    per-host percentiles and the baked-in 3x skew attributed to host 1 —
+    all as real subprocess invocations, like an operator would run."""
+    import sys
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    base = str(tmp_path / "pod.jsonl")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "multihost_smoke.py"),
+         "--write_shards", base],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "SHARDS_OK" in r.stdout
+    assert os.path.exists(base) and os.path.exists(base + ".host1")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fleet_report.py"),
+         base, "--json"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr
+    import json
+    s = json.loads(r.stdout)
+    assert s["hosts"] == 2
+    assert s["per_host"]["0"]["seq_monotonic"] \
+        and s["per_host"]["1"]["seq_monotonic"]
+    assert s["per_host"]["1"]["step_time_ms"]["p50"] \
+        > 2.5 * s["per_host"]["0"]["step_time_ms"]["p50"]
+    assert s["skew"]["slowest_host"] == 1
+    assert s["stragglers"] and s["stragglers"][0]["slow_host"] == 1
+    # the human rendering names the straggler too
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fleet_report.py"),
+         base], capture_output=True, text=True, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "STRAGGLER" in r.stdout and "skew" in r.stdout
+
+
 def test_plot_loss_runs_on_metrics_csv(tmp_path):
     import sys
     p = tmp_path / "m.csv"

@@ -45,14 +45,22 @@ REPRESENTATIVE = {
                        step_time_ms=10.0, host_wait_ms=0.1, slept_ms=0.0,
                        tok_s=1000.0, mfu=None, param_norm=12.0,
                        update_ratio=1e-3, nonfinite_count=0,
-                       hbm_mb=100.0, queue_depth=2),
+                       hbm_mb=100.0, queue_depth=2,
+                       host_step_ms={"0": 10.0, "1": 31.0}),
     "throttle": dict(step=5, sleep_ms=100.0, battery=80.0, temp=30.0,
                      source="telemetry"),
     "anomaly": dict(step=7, kind="loss_spike", loss=9.9, ema=3.0,
                     zscore=8.4),
+    "straggler": dict(step=50, slow_host=1, host_ms=31.0, fleet_ms=10.0,
+                      ratio=3.1),
+    "hang": dict(step=51, stall_s=120.5, deadline_s=60.0,
+                 stacks_file="/tmp/run.jsonl.stacks",
+                 device_probe="timeout", action="continue"),
     "eval": dict(step=10, loss=3.1, ppl=22.2, tokens=4096),
     "checkpoint": dict(step=10, final=False, wall_s=0.2),
-    "run_end": dict(steps=10, wall_s=60.0, exit="ok"),
+    "run_end": dict(steps=10, wall_s=60.0, exit="ok",
+                    goodput={"total_s": 60.0, "step_s": 50.0,
+                             "productive_frac": 0.83}),
 }
 
 

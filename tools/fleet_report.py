@@ -1,0 +1,238 @@
+"""Merge and render per-host telemetry shards from a multi-host run.
+
+Under multi-host every process writes its own host-stamped stream
+(`core/telemetry.py`): the coordinator at `--telemetry_out`'s path, host
+k at `<path>.host<k>` (DESIGN.md §14). This tool discovers the shard
+set next to the given base path, validates every line against the shared
+EVENT_SCHEMA, checks each shard's seq monotonicity and (host, seq)
+uniqueness, merges the fleet timeline, and answers the pod questions the
+single-stream report cannot: which host is slow (per-host step-time
+percentiles), how far apart the fleet is (cross-host median skew, step
+reach), and whether any host raised `straggler` or `hang` events.
+
+Usage:
+  python tools/fleet_report.py run.jsonl [--json]
+  (run.jsonl.host1, run.jsonl.host2, ... are discovered automatically)
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from telemetry_report import (_fmt, goodput_lines,  # noqa: E402
+                              hang_entries, hang_lines, load_events,
+                              percentile, split_latest_run,
+                              straggler_entries, straggler_lines)
+
+from mobilefinetuner_tpu.core.telemetry import partial_goodput  # noqa: E402
+
+
+def discover_shards(base: str) -> dict:
+    """{host_index: path} — the base path is host 0's stream (when it
+    exists), `<base>.host<k>` the others. Hosts may be sparse (a dead
+    worker that never wrote is itself a finding, reported as a gap)."""
+    shards = {}
+    if os.path.exists(base):
+        shards[0] = base
+    for p in glob.glob(glob.escape(base) + ".host*"):
+        m = re.fullmatch(re.escape(base) + r"\.host(\d+)", p)
+        if m:
+            shards[int(m.group(1))] = p
+    return shards
+
+
+def shard_summary(host: int, events: list, n_invalid: int) -> dict:
+    """Per-host rollup over one shard's validated events. A resumed
+    shard whose LATEST run was killed scopes its stats/incidents to
+    that run and withholds the prior run's clean run_end
+    (telemetry_report's latest-run rule)."""
+    truncated, latest = split_latest_run(events)
+    scope = latest if truncated else events
+    stats = [e for e in scope if e["event"] == "step_stats"]
+    times = sorted(s["step_time_ms"] for s in stats)
+    waits = [s["host_wait_ms"] for s in stats]
+    seqs = [e["seq"] for e in events]
+    # records are host-stamped since the fleet layer; pre-fleet shards
+    # carry no host field (counted, not fatal)
+    mismatched = sum(1 for e in events
+                     if "host" in e and e["host"] != host)
+    ends = [] if truncated else \
+        [e for e in events if e["event"] == "run_end"]
+    return {
+        "host": host,
+        "events": len(events),
+        "invalid_lines": n_invalid,
+        "seq_monotonic": all(a < b for a, b in zip(seqs, seqs[1:])),
+        "host_stamp_mismatches": mismatched,
+        "flushes": len(stats),
+        "last_step": stats[-1]["step"] if stats else None,
+        "step_time_ms": {
+            "p50": percentile(times, 50),
+            "p90": percentile(times, 90),
+            "p99": percentile(times, 99),
+        },
+        "host_wait_frac": (sum(waits) / max(sum(times), 1e-9)
+                           if stats else None),
+        "stragglers": sum(1 for e in scope if e["event"] == "straggler"),
+        "hangs": sum(1 for e in scope if e["event"] == "hang"),
+        "anomalies": sum(1 for e in scope if e["event"] == "anomaly"),
+        "run_end": ({"steps": ends[-1]["steps"],
+                     "wall_s": ends[-1]["wall_s"],
+                     "exit": ends[-1]["exit"],
+                     "goodput": ends[-1].get("goodput")}
+                    if ends else None),
+    }
+
+
+def fleet_summary(shards: dict) -> dict:
+    """shards: {host: (events, n_invalid)} -> the merged fleet view."""
+    per_host = {h: shard_summary(h, ev, bad)
+                for h, (ev, bad) in sorted(shards.items())}
+    # merged timeline: every shard's events ordered by wall time, ties
+    # broken by (host, seq) — (host, seq) is the global event identity
+    merged = sorted(
+        (e for ev, _ in shards.values() for e in ev),
+        key=lambda e: (e["t"], e.get("host", 0), e["seq"]))
+    keys = [(e.get("host", 0), e["seq"]) for e in merged]
+    dup_keys = len(keys) - len(set(keys))
+    # incident lists follow each shard's latest-run scope (a prior
+    # appended run's stragglers are not this post-mortem's)
+    scoped = []
+    for ev, _ in shards.values():
+        trunc, latest = split_latest_run(ev)
+        scoped.extend(latest if trunc else ev)
+    scoped.sort(key=lambda e: (e["t"], e.get("host", 0), e["seq"]))
+    # cross-host skew over the per-host MEDIAN step time: the headline
+    # "is the fleet balanced" number
+    medians = {h: s["step_time_ms"]["p50"] for h, s in per_host.items()
+               if s["step_time_ms"]["p50"] is not None}
+    skew = None
+    if len(medians) >= 2:
+        lo_h = min(medians, key=medians.get)
+        hi_h = max(medians, key=medians.get)
+        skew = {
+            "fastest_host": lo_h, "fastest_ms": medians[lo_h],
+            "slowest_host": hi_h, "slowest_ms": medians[hi_h],
+            "abs_ms": round(medians[hi_h] - medians[lo_h], 3),
+            "ratio": round(medians[hi_h] / max(medians[lo_h], 1e-9), 3),
+        }
+    reach = {h: s["last_step"] for h, s in per_host.items()}
+    reached = [r for r in reach.values() if r is not None]
+    # a host with a run_end is done; one without is crashed/running
+    missing_end = sorted(h for h, s in per_host.items()
+                         if s["run_end"] is None)
+    # coordinator goodput when its run ENDED (None stays None — some
+    # entry points carry no metered loop); only a run_end-less
+    # coordinator shard gets the partial reconstruction
+    goodput = None
+    h0 = per_host.get(0)
+    if h0 and h0["run_end"]:
+        goodput = h0["run_end"]["goodput"]
+    elif 0 in shards:
+        # reconstruct over the LATEST run's slice of the coordinator
+        # shard (a prior appended run's events are not this post-mortem)
+        goodput = partial_goodput(split_latest_run(shards[0][0])[1])
+    return {
+        "hosts": len(per_host),
+        "events": len(merged),
+        "duplicate_host_seq_keys": dup_keys,
+        "per_host": per_host,
+        "skew": skew,
+        "step_reach": {"min": min(reached) if reached else None,
+                       "max": max(reached) if reached else None},
+        # shared builders (telemetry_report) — the two reports render
+        # these events identically by construction
+        "stragglers": straggler_entries(scoped),
+        "hangs": hang_entries(scoped),
+        "hosts_missing_run_end": missing_end,
+        "goodput": goodput,
+    }
+
+
+def print_fleet(s: dict):
+    print(f"fleet: {s['hosts']} host shard(s), {s['events']} events"
+          + (f"  [{s['duplicate_host_seq_keys']} DUPLICATE (host,seq)]"
+             if s["duplicate_host_seq_keys"] else ""))
+    for h, ph in s["per_host"].items():
+        t = ph["step_time_ms"]
+        flags = []
+        if not ph["seq_monotonic"]:
+            flags.append("SEQ NOT MONOTONIC")
+        if ph["invalid_lines"]:
+            flags.append(f"{ph['invalid_lines']} invalid lines")
+        if ph["host_stamp_mismatches"]:
+            flags.append(f"{ph['host_stamp_mismatches']} host-stamp "
+                         f"mismatches")
+        end = ph["run_end"]
+        end_s = (f"exit={end['exit']} after {end['steps']} steps"
+                 if end else "NO run_end (crashed or running)")
+        wf = ph["host_wait_frac"]
+        print(f"  host {h}: {ph['events']} events, "
+              f"{ph['flushes']} flushes through step "
+              f"{ph['last_step'] if ph['last_step'] is not None else '-'}; "
+              f"step_time p50/p90/p99 = {_fmt(t['p50'])}/"
+              f"{_fmt(t['p90'])}/{_fmt(t['p99'])} ms; "
+              f"host_wait {_fmt(100 * wf if wf is not None else None, 1)}%; "
+              f"{end_s}"
+              + (f"  [{'; '.join(flags)}]" if flags else ""))
+    if s["skew"]:
+        k = s["skew"]
+        print(f"  skew: host {k['slowest_host']} median "
+              f"{_fmt(k['slowest_ms'])} ms vs host {k['fastest_host']} "
+              f"{_fmt(k['fastest_ms'])} ms "
+              f"({k['ratio']}x, +{_fmt(k['abs_ms'])} ms)")
+    r = s["step_reach"]
+    if r["min"] is not None and r["min"] != r["max"]:
+        print(f"  step reach: min {r['min']} / max {r['max']} "
+              f"(a lagging shard means a stalled or dead host)")
+    for line in straggler_lines(s["stragglers"]) + hang_lines(s["hangs"]):
+        print(line)
+    if s["hosts_missing_run_end"]:
+        print(f"  hosts without run_end: {s['hosts_missing_run_end']}")
+    for line in goodput_lines(s["goodput"]):  # one shared renderer
+        print(line)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("jsonl", help="coordinator stream (--telemetry_out "
+                                  "base path; .host<k> shards are "
+                                  "discovered next to it)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary instead of text")
+    args = ap.parse_args(argv)
+    paths = discover_shards(args.jsonl)
+    if not paths:
+        print(f"error: no telemetry shards at {args.jsonl}",
+              file=sys.stderr)
+        return 1
+    shards = {}
+    for h, p in paths.items():
+        try:
+            shards[h] = load_events(p)
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    if not any(ev for ev, _ in shards.values()):
+        print(f"error: no valid telemetry events in {sorted(paths.values())}",
+              file=sys.stderr)
+        return 1
+    s = fleet_summary(shards)
+    try:
+        if args.json:
+            print(json.dumps(s, indent=1))
+        else:
+            print_fleet(s)
+    except BrokenPipeError:  # `fleet_report run.jsonl | head` is normal
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,19 +14,80 @@ all-gathered. Prints `MULTIHOST_OK loss=<x> gemma_loss=<y>` — the launcher
 asserts every process prints the same losses, which can only happen if the
 cross-process collectives actually ran.
 
+Fleet-observability wiring (DESIGN.md §14): with a telemetry base path
+(5th positional arg), every process writes its own host-stamped shard —
+the coordinator at the path itself, process k at `<path>.host<k>` — with
+run_start, per-phase step_stats (measured step ms), and run_end, so a
+real pod smoke leaves exactly the shard set `tools/fleet_report.py`
+merges. `--write_shards <path>` simulates the same two-host shard set in
+ONE process on CPU (no jax.distributed needed) with a known straggler
+skew baked in — the cheap merge-path proof tests/test_scripts.py runs.
+
 Usage (one line per process):
-  python tools/multihost_smoke.py <coordinator> <num_procs> <proc_id> [ndev]
+  python tools/multihost_smoke.py <coordinator> <num_procs> <proc_id> \
+      [ndev] [telemetry_out]
+  python tools/multihost_smoke.py --write_shards out.jsonl
 """
 
 import sys
+import time
 
 import numpy as np
 
 
+def write_simulated_shards(base: str, hosts: int = 2,
+                           flushes: int = 5) -> list:
+    """Two(+) per-host shards with a deterministic skew: host 0 steps at
+    ~40 ms, the last host at ~3x that, plus the coordinator-side
+    `straggler` event the cadence gather would have fired and a goodput-
+    carrying run_end on every shard. Returns the shard paths. Every
+    record passes EVENT_SCHEMA (tests/test_scripts.py re-validates via
+    fleet_report)."""
+    from mobilefinetuner_tpu.core.telemetry import Telemetry, shard_path
+    paths = []
+    for h in range(hosts):
+        p = shard_path(base, h)
+        paths.append(p)
+        slow = 3.0 if h == hosts - 1 else 1.0
+        step_ms = 40.0 * slow
+        with Telemetry(p, host=h) as tel:
+            tel.emit("run_start", jax_version="sim", mesh_shape=None,
+                     process_count=hosts, process_index=h,
+                     device_kind="sim-cpu", device_count=hosts,
+                     config={"simulated": True, "steps": flushes})
+            for i in range(flushes):
+                tel.emit("step_stats", step=i + 1, loss=3.0 - 0.1 * i,
+                         ema=3.0 - 0.05 * i, lr=1e-4, grad_norm=0.5,
+                         step_time_ms=step_ms + (i % 2),
+                         host_wait_ms=1.0, slept_ms=0.0,
+                         tok_s=1000.0 / slow, mfu=None, param_norm=10.0,
+                         update_ratio=1e-3, nonfinite_count=0,
+                         hbm_mb=100.0, queue_depth=2,
+                         host_step_ms={str(k): 40.0 * (3.0 if
+                                       k == hosts - 1 else 1.0)
+                                       for k in range(hosts)})
+            if h == 0:
+                # what the straggler cadence fires on the coordinator
+                tel.emit("straggler", step=flushes, slow_host=hosts - 1,
+                         host_ms=step_ms * 3.0, fleet_ms=40.0, ratio=3.0)
+            tel.emit("run_end", steps=flushes,
+                     wall_s=flushes * step_ms / 1000.0, exit="ok",
+                     goodput={"total_s": flushes * step_ms / 1000.0,
+                              "step_s": flushes * step_ms / 1000.0,
+                              "productive_frac": 1.0})
+    return paths
+
+
 def main():
+    if sys.argv[1] == "--write_shards":
+        for p in write_simulated_shards(sys.argv[2]):
+            print(f"SHARD {p}")
+        print("SHARDS_OK")
+        return
     coordinator, num_procs, proc_id = (sys.argv[1], int(sys.argv[2]),
                                        int(sys.argv[3]))
     ndev = int(sys.argv[4]) if len(sys.argv) > 4 else 4
+    telemetry_out = sys.argv[5] if len(sys.argv) > 5 else ""
 
     from mobilefinetuner_tpu.parallel.host_devices import force_host_devices
     force_host_devices(ndev)
@@ -60,6 +121,14 @@ def main():
                                  n_layer=2)
     mesh = dist.make_hybrid_mesh(data=num_procs, fsdp=ndev)
     assert mesh.shape == {"data": num_procs, "fsdp": ndev}
+
+    # fleet telemetry: EVERY process writes its host-stamped shard (the
+    # per-host contract tools/fleet_report.py merges)
+    from mobilefinetuner_tpu.core.telemetry import Telemetry, run_manifest
+    tel = Telemetry.for_process(telemetry_out)
+    tel.emit("run_start", **run_manifest(
+        {"smoke": True, "num_procs": num_procs, "ndev": ndev}, mesh))
+    t_run0 = time.time()
 
     params = gpt2.init_params(config, jax.random.PRNGKey(0))
     params = shard_params(params, mesh, min_size=0)
@@ -95,9 +164,20 @@ def main():
     with mesh:
         losses = []
         for step in range(2):
+            t0 = time.perf_counter()
             lora, opt, metrics = step_fn(lora, params, opt, batch,
                                          jnp.int32(step))
             losses.append(float(metrics["loss"]))  # host sync (global)
+            step_ms = (time.perf_counter() - t0) * 1000
+            tel.emit("step_stats", step=step + 1, loss=losses[-1],
+                     ema=losses[-1], lr=1e-3,
+                     grad_norm=float(metrics["grad_norm"]),
+                     step_time_ms=step_ms, host_wait_ms=0.0,
+                     slept_ms=0.0, tok_s=batch["input_ids"].size
+                     / max(step_ms / 1000, 1e-9), mfu=None,
+                     param_norm=None, update_ratio=None,
+                     nonfinite_count=None, hbm_mb=0.0, queue_depth=None,
+                     host_step_ms=None)
     loss = losses[-1]
     assert np.isfinite(loss), losses
     # convergence, not just finiteness: the optimizer stepped on the same
@@ -175,6 +255,9 @@ def main():
     assert np.isfinite(glosses[-1]), glosses
     assert glosses[1] < glosses[0], glosses
 
+    tel.emit("run_end", steps=4, wall_s=round(time.time() - t_run0, 3),
+             exit="ok", goodput=None)
+    tel.close()
     print(f"MULTIHOST_OK loss={loss:.6f} gemma_loss={glosses[-1]:.6f} "
           f"proc={jax.process_index()}/{jax.process_count()}")
 

@@ -18,7 +18,8 @@ import argparse
 import json
 import sys
 
-from mobilefinetuner_tpu.core.telemetry import validate_event
+from mobilefinetuner_tpu.core.telemetry import (partial_goodput,
+                                                validate_event)
 
 
 def percentile(sorted_vals, q):
@@ -48,17 +49,39 @@ def load_events(path):
     return events, bad
 
 
+def split_latest_run(events):
+    """(truncated, latest_run_events): a resumed stream appends runs, so
+    'is there any run_end' is the wrong truncation test — run 1 may have
+    ended cleanly while the appended run 2 was SIGKILLed. The post-mortem
+    subject is the LATEST run: truncated iff its run_start has no
+    following run_end; the returned slice is that run's events (the whole
+    stream when nothing is truncated)."""
+    idx_start = max((i for i, e in enumerate(events)
+                     if e.get("event") == "run_start"), default=-1)
+    idx_end = max((i for i, e in enumerate(events)
+                   if e.get("event") == "run_end"), default=-1)
+    truncated = idx_start > idx_end
+    return truncated, (events[idx_start:]
+                       if truncated and idx_start >= 0 else events)
+
+
 def summarize(events, n_invalid=0) -> dict:
+    truncated, latest = split_latest_run(events)
+    # a truncated stream's post-mortem subject is the LATEST run: stats
+    # and incident lists over the whole file would attribute an earlier
+    # appended run's stragglers/anomalies/percentiles to the killed run
+    scope = latest if truncated else events
     by = {}
-    for e in events:
+    for e in scope:
         by.setdefault(e["event"], []).append(e)
+    runs_all = [e for e in events if e["event"] == "run_start"]
     stats = by.get("step_stats", [])
     times = sorted(s["step_time_ms"] for s in stats)
     waits = [s["host_wait_ms"] for s in stats]
     mfus = [s["mfu"] for s in stats if s.get("mfu") is not None]
     toks = [s["tok_s"] for s in stats]
     nonfinite = sum(s.get("nonfinite_count") or 0 for s in stats)
-    runs = by.get("run_start", [])
+    runs = runs_all  # manifest/run count span the WHOLE stream
     ends = by.get("run_end", [])
     seqs = [e["seq"] for e in events]
     out = {
@@ -112,15 +135,86 @@ def summarize(events, n_invalid=0) -> dict:
                    "macro_accuracy": e.get("macro_accuracy")}
                   for e in by.get("eval", [])],
         "checkpoints": len(by.get("checkpoint", [])),
+        "stragglers": straggler_entries(scope),
+        "hangs": hang_entries(scope),
+        # a killed LATEST run leaves no run_end after its run_start (a
+        # prior appended run's clean run_end must not mask it): report
+        # the truncation with the last step the stream DID see instead
+        # of pretending nothing ran. A truncated stream's stale run_end
+        # (from the earlier run) is withheld — rendering it as current
+        # is exactly the post-mortem trap.
         "run_end": ({"steps": ends[-1]["steps"],
                      "wall_s": ends[-1]["wall_s"],
-                     "exit": ends[-1]["exit"]} if ends else None),
+                     "exit": ends[-1]["exit"]}
+                    if ends and not truncated else None),
+        "truncated": truncated,
+        "last_seen_step": max(
+            (e.get("step") for e in latest
+             if isinstance(e.get("step"), int)), default=None),
+        # goodput: the writer-side buckets when the latest run ENDED
+        # (None stays None — e.g. the eval CLIs have no metered loop;
+        # that is not a truncation); a truncated run gets the partial
+        # reconstruction over ITS OWN slice of the stream
+        "goodput": (ends[-1].get("goodput") if ends and not truncated
+                    else partial_goodput(latest)),
     }
     return out
 
 
 def _fmt(v, nd=2):
     return "-" if v is None else f"{v:.{nd}f}"
+
+
+def straggler_entries(events) -> list:
+    """Summary dicts for `straggler` events — ONE builder shared with
+    tools/fleet_report.py (same rule as goodput_lines)."""
+    return [{"step": e["step"], "slow_host": e["slow_host"],
+             "host_ms": e["host_ms"], "fleet_ms": e["fleet_ms"],
+             "ratio": e["ratio"]}
+            for e in events if e.get("event") == "straggler"]
+
+
+def hang_entries(events) -> list:
+    """Summary dicts for `hang` events (host = the WRITER's envelope
+    stamp: which process's watchdog fired)."""
+    return [{"host": e.get("host", 0), "step": e["step"],
+             "stall_s": e["stall_s"], "device_probe": e["device_probe"],
+             "action": e["action"], "stacks_file": e["stacks_file"]}
+            for e in events if e.get("event") == "hang"]
+
+
+def straggler_lines(entries) -> list:
+    return [f"  STRAGGLER @ step {e['step']}: host {e['slow_host']} at "
+            f"{e['host_ms']:.1f} ms vs fleet {e['fleet_ms']:.1f} ms "
+            f"({e['ratio']}x)" for e in entries]
+
+
+def hang_lines(entries) -> list:
+    return [f"  HANG on host {e['host']} @ step {e['step']}: stalled "
+            f"{e['stall_s']:.1f}s, device probe {e['device_probe']}, "
+            f"action {e['action']} (stacks: {e['stacks_file']})"
+            for e in entries]
+
+
+def goodput_lines(g) -> list:
+    """Render a goodput dict — writer-side (GoodputMeter.summary) or
+    reader-side (partial_goodput) — to report lines. ONE renderer,
+    shared with tools/fleet_report.py, so the two reports cannot
+    drift."""
+    if not g:
+        return []
+    if g.get("partial"):
+        return [f"  goodput (PARTIAL, reconstructed): compile "
+                f"{g['compile_s']:.1f}s, checkpoint "
+                f"{g['checkpoint_s']:.1f}s, governor sleep "
+                f"{g['governor_sleep_s']:.1f}s, input-wait "
+                f"{100 * g['input_wait_frac_of_step']:.1f}% of step "
+                f"time over {g['observed_span_s']:.1f}s observed"]
+    buckets = ", ".join(
+        f"{k[:-2]} {v:.1f}s" for k, v in g.items()
+        if k.endswith("_s") and k != "total_s" and v)
+    return [f"  goodput: {100 * g['productive_frac']:.1f}% productive "
+            f"of {g['total_s']:.1f}s ({buckets})"]
 
 
 def print_summary(s: dict):
@@ -173,12 +267,25 @@ def print_summary(s: dict):
                   f"ppl={_fmt(e['ppl'])}")
     if s["checkpoints"]:
         print(f"  checkpoints: {s['checkpoints']}")
+    for line in straggler_lines(s.get("stragglers", [])) \
+            + hang_lines(s.get("hangs", [])):
+        print(line)
+    g = s.get("goodput")
+    if g and not g.get("partial"):
+        for line in goodput_lines(g):
+            print(line)
     if s["run_end"]:
         r = s["run_end"]
         print(f"  run_end: {r['steps']} steps in {r['wall_s']:.1f}s "
               f"(exit={r['exit']})")
     else:
-        print("  run_end: MISSING (crashed or still running)")
+        last = s.get("last_seen_step")
+        print(f"  run TRUNCATED (no run_end — killed or still running); "
+              f"last seen step: "
+              f"{last if last is not None else 'none'}")
+        if g and g.get("partial"):
+            for line in goodput_lines(g):
+                print(line)
 
 
 def main(argv=None) -> int:

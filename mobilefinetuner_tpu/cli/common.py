@@ -79,6 +79,22 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
     g.add_argument("--eval_batches", type=int, default=50)
     g.add_argument("--eval_batch_size", type=int, default=2)
     g.add_argument("--save_every", type=int, default=0)
+    g.add_argument("--async_save", type=int, default=1,
+                   help="1 = snapshot-then-write checkpointing "
+                        "(io/async_ckpt.py): at a save step the loop "
+                        "blocks only for a batched device->host "
+                        "snapshot; key-mapping, bf16 encode, and the "
+                        "safetensors write run on a background thread "
+                        "(depth-1 queue — a save landing while one is "
+                        "in flight coalesces to the newest snapshot "
+                        "with a ckpt_dropped telemetry event; final "
+                        "saves drain). 0 = fully synchronous oracle. "
+                        "Files are byte-identical either way and every "
+                        "writer publishes atomically (tmp+fsync+rename "
+                        "— a kill mid-write cannot corrupt the "
+                        "checkpoint --resume_from loads); telemetry's "
+                        "checkpoint event splits snapshot_ms (blocking) "
+                        "from write_ms/bytes/mb_s (background)")
     g.add_argument("--ema_beta", type=float, default=0.9)
     g.add_argument("--seed", type=int, default=42)
     g.add_argument("--coupled_weight_decay", action="store_true",
@@ -521,7 +537,11 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     metrics CSV + JSONL eval records + governor throttle + periodic saves
     + the run-telemetry event stream (--telemetry_out, core/telemetry.py).
 
-    save_hook(step, trainable, opt_state, final) persists checkpoints.
+    save_hook(step, trainable, opt_state, final, ckpt=None) persists
+    checkpoints: the hook snapshots its trees to host (blocking, batched
+    — io/async_ckpt.timed_snapshot) and routes the disk write through
+    `ckpt` (async_ckpt.submit), which under --async_save runs it on a
+    background thread so the step loop resumes after the snapshot.
     dropout_rng: base PRNG key; when set, a fresh per-sample key array
     folded with the step index rides in batch["dropout_rng"], so dropout
     masks differ across steps AND micro-batches (a fixed closure key would
@@ -554,13 +574,21 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     done_steps = 0
     governor = None  # assigned in setup; end_run late-binds the local
     wd = None        # assigned in setup; the outer finally stops it
+    ckpt = None      # async checkpointer; end_run drains it
 
     def end_run(exit_name: str, steps: int):
         """Terminate the stream exactly once on any exit path: run_end
         carries the goodput buckets (plus the governor's own run-total
         sleep counter — an independently-clocked cross-check of the
         meter's governor_sleep bucket); emit/close no-op on a closed
-        stream, so nested handlers compose without double emission."""
+        stream, so nested handlers compose without double emission.
+        The async checkpoint writer is drained FIRST: a snapshot already
+        taken is a recovery point worth finishing even when the loop
+        died, and its checkpoint event must land before run_end closes
+        the stream (write errors are swallowed here — they must not
+        mask the exception that brought us down)."""
+        if ckpt is not None:
+            ckpt.close(raise_errors=False)
         extra = {}
         if governor is not None:
             extra["governor_slept_ms"] = round(governor.total_slept_ms, 1)
@@ -577,6 +605,18 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     try:
         governor = governor_from_args(
             args, event_sink=lambda p: tel.emit("throttle", **p))
+        # snapshot-then-write checkpointing (io/async_ckpt.py): the save
+        # hooks snapshot on the loop thread (blocking, batched D2H) and
+        # hand the disk write to this checkpointer's background thread;
+        # --async_save 0 is the synchronous oracle (same writer, inline).
+        # The checkpointer emits the `checkpoint`/`ckpt_dropped` events
+        # itself — including from its writer thread; Telemetry.emit is
+        # lock-serialized — so the blocking/background split is recorded
+        # where it is measured.
+        from mobilefinetuner_tpu.io.async_ckpt import AsyncCheckpointer
+        ckpt = AsyncCheckpointer(
+            enabled=bool(getattr(args, "async_save", 1)),
+            event_sink=tel.emit)
         spikes = SpikeDetector(SpikeConfig(
             zscore=getattr(args, "spike_z", 8.0),
             beta=getattr(args, "spike_beta", 0.98),
@@ -645,10 +685,15 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
         if save_hook is not None and multiproc:
             orig_save = save_hook
 
-            def save_hook(step, tr, opt, final=False):
+            # gather-then-coordinator-write, unchanged under async save:
+            # the gather is COLLECTIVE (every process participates, and
+            # its cost is part of the blocking snapshot the loop pays);
+            # only the coordinator snapshots/queues the write, so the
+            # background writer thread exists on one process
+            def save_hook(step, tr, opt, final=False, ckpt=None):
                 tr_h, opt_h = gather_to_host(tr), gather_to_host(opt)
                 if coord:
-                    orig_save(step, tr_h, opt_h, final=final)
+                    orig_save(step, tr_h, opt_h, final=final, ckpt=ckpt)
         # the eval path must feed global arrays under multi-host (raw host
         # numpy cannot address a global mesh); single-process keeps the
         # uncommitted-numpy fast path
@@ -937,14 +982,18 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 if args.save_every and save_hook and (step + 1) % \
                         args.save_every == 0 and (step + 1) < total_steps:
                     flush_metrics(emit_log=False)  # off-cadence boundary flush
+                    # the meter's checkpoint bucket spans only this
+                    # blocking call: under --async_save that is the
+                    # batched snapshot (+ enqueue), and the background
+                    # write's wall time stays charged to `step` — the
+                    # overlap IS the feature. The checkpoint telemetry
+                    # event (with the snapshot/write split) is emitted
+                    # by the checkpointer when the write completes.
                     meter.enter("checkpoint")
-                    t_save = time.perf_counter()
                     with pause():  # a slow save is not a hang
                         save_hook(step + 1, trainable, opt_state,
-                                  final=False)
+                                  final=False, ckpt=ckpt)
                     meter.enter("step")
-                    tel.emit("checkpoint", step=step + 1, final=False,
-                             wall_s=round(time.perf_counter() - t_save, 3))
                     t_interval = time.perf_counter()  # save time ≠ step time
 
                 meter.enter("governor_sleep")
@@ -997,14 +1046,15 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 tel.emit("eval", step=total_steps, loss=ev["loss"],
                          ppl=ev["ppl"], tokens=ev["tokens"])
             if save_hook:
+                # final=True drains the writer inside the hook's submit:
+                # the run must not end before its last checkpoint is on
+                # disk, so this blocking span (snapshot + any queued
+                # writes) honestly lands in the checkpoint bucket
                 meter.enter("checkpoint")
-                t_save = time.perf_counter()
                 with pause():
                     save_hook(total_steps, trainable, opt_state,
-                              final=True)
+                              final=True, ckpt=ckpt)
                 meter.enter("shutdown")
-                tel.emit("checkpoint", step=total_steps, final=True,
-                         wall_s=round(time.perf_counter() - t_save, 3))
         except BaseException as e:
             end_run(type(e).__name__, done_steps)
             raise
@@ -1024,6 +1074,11 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
         # path — return, loop exception, tail exception, setup failure
         if wd is not None:
             wd.stop()
+        # belt-and-braces: end_run already drained the writer on every
+        # path (close is idempotent) — this guards exits that never
+        # reached an end_run, e.g. a failure inside end_run itself
+        if ckpt is not None:
+            ckpt.close(raise_errors=False)
 
 
 def setup_frozen_params(args, params, mesh):

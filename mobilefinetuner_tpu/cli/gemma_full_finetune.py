@@ -23,6 +23,7 @@ import argparse
 import dataclasses
 import os
 import sys
+import time
 
 import jax
 
@@ -30,6 +31,7 @@ from mobilefinetuner_tpu.cli import common
 from mobilefinetuner_tpu.core.logging import get_logger
 from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
 from mobilefinetuner_tpu.data.wikitext2 import WT2Config, WikiText2Dataset
+from mobilefinetuner_tpu.io import async_ckpt
 from mobilefinetuner_tpu.io.checkpoints import (gemma3_params_from_hf,
                                                 load_gemma3, save_gemma3)
 from mobilefinetuner_tpu.models import gemma3
@@ -190,7 +192,7 @@ def main(argv=None) -> int:
             hidden, params_t["embed"], mb["labels"],
             num_chunks=args.loss_chunks, mesh=ce_mesh)
 
-    def save_hook(step, params_t, opt_st, final):
+    def save_hook(step, params_t, opt_st, final, ckpt=None):
         path = args.output_path
         if not final:
             root, ext = os.path.splitext(path)
@@ -198,16 +200,36 @@ def main(argv=None) -> int:
         if os.path.dirname(path):
             os.makedirs(os.path.dirname(path), exist_ok=True)
         if args.opt_offload:
+            t0 = time.perf_counter()
             # the f32 MASTER is the real model (params_t is the bf16
-            # compute copy); the sidecar carries step + m/v only
+            # compute copy); the sidecar carries step + m/v only. The
+            # master/m/v tiers already live in host RAM — "snapshot"
+            # here is the batched pull of the few device-resident
+            # leaves plus reshaping, still the only blocking work
             from mobilefinetuner_tpu.optim import opt_offload as oo
-            save_gemma3(path, oo.master_to_params(opt_st, plan, params_t))
-            oo.save_opt_sidecar(path + ".opt", opt_st, tc.adam())
+            model_h = oo.master_to_params(opt_st, plan, params_t)
+            side_h = async_ckpt.snapshot(
+                {"step": opt_st["step"], "m": opt_st["m"],
+                 "v": opt_st["v"]})
+            snap_ms = (time.perf_counter() - t0) * 1000.0
+
+            def write():
+                save_gemma3(path, model_h)
+                adam_mod.save_state(path + ".opt", side_h, tc.adam())
+                log.info(f"saved full model -> {path}")
+                return [path, path + ".opt"]
         else:
-            save_gemma3(path, params_t)
-            adam_mod.save_state(path + ".opt", jax.device_get(opt_st),
-                                tc.adam())
-        log.info(f"saved full model -> {path}")
+            (params_h, opt_h), snap_ms = async_ckpt.timed_snapshot(
+                (params_t, opt_st))
+
+            def write():
+                save_gemma3(path, params_h)
+                adam_mod.save_state(path + ".opt", opt_h, tc.adam())
+                log.info(f"saved full model -> {path}")
+                return [path, path + ".opt"]
+
+        async_ckpt.submit(ckpt, step, write, final=final,
+                          snapshot_ms=snap_ms)
 
     # in-loop MFU from the shared estimator (core/telemetry.py)
     from mobilefinetuner_tpu.core.telemetry import transformer_flops

@@ -27,6 +27,7 @@ from mobilefinetuner_tpu.cli import common
 from mobilefinetuner_tpu.core.logging import get_logger
 from mobilefinetuner_tpu.data.tokenizer_bpe import GPT2BPETokenizer
 from mobilefinetuner_tpu.data.wikitext2 import WT2Config, WikiText2Dataset
+from mobilefinetuner_tpu.io import async_ckpt
 from mobilefinetuner_tpu.io.checkpoints import (gpt2_params_from_hf,
                                                 load_gpt2, save_gpt2)
 from mobilefinetuner_tpu.models import gpt2
@@ -121,16 +122,27 @@ def main(argv=None) -> int:
                               cp_mesh=cp_mesh)
         return lm_cross_entropy_sum(logits, mb["labels"])
 
-    def save_hook(step, params_t, opt_st, final):
+    def save_hook(step, params_t, opt_st, final, ckpt=None):
         path = args.output_path
         if not final:
             root, ext = os.path.splitext(path)
             path = f"{root}_step{step}{ext}"
         if os.path.dirname(path):
             os.makedirs(os.path.dirname(path), exist_ok=True)
-        save_gpt2(path, params_t)
-        adam_mod.save_state(path + ".opt", jax.device_get(opt_st), tc.adam())
-        log.info(f"saved full model -> {path}")
+        # full-FT trees are the expensive case: the batched snapshot is
+        # the loop's only stall; the HF key-mapping + write of params
+        # AND the 2x-params .opt sidecar happen off-loop
+        (params_h, opt_h), snap_ms = async_ckpt.timed_snapshot(
+            (params_t, opt_st))
+
+        def write():
+            save_gpt2(path, params_h)
+            adam_mod.save_state(path + ".opt", opt_h, tc.adam())
+            log.info(f"saved full model -> {path}")
+            return [path, path + ".opt"]
+
+        async_ckpt.submit(ckpt, step, write, final=final,
+                          snapshot_ms=snap_ms)
 
     # in-loop MFU from the shared estimator (core/telemetry.py)
     from mobilefinetuner_tpu.core.telemetry import transformer_flops

@@ -35,6 +35,7 @@ from mobilefinetuner_tpu.cli import common
 from mobilefinetuner_tpu.core.logging import get_logger
 from mobilefinetuner_tpu.data.tokenizer_bpe import GPT2BPETokenizer
 from mobilefinetuner_tpu.data.wikitext2 import WT2Config, WikiText2Dataset
+from mobilefinetuner_tpu.io import async_ckpt
 from mobilefinetuner_tpu.io.checkpoints import load_gpt2
 from mobilefinetuner_tpu.lora import peft_io
 from mobilefinetuner_tpu.lora.lora import (LoRASpec, init_lora_gpt2,
@@ -192,21 +193,32 @@ def main(argv=None) -> int:
             steps=args.align_steps)
         return 0
 
-    def save_hook(step, lora_t, opt_st, final):
+    def save_hook(step, lora_t, opt_st, final, ckpt=None):
         path = args.lora_out
         if not final:  # _stepN suffix (main.cpp:180-187)
             root, ext = os.path.splitext(path)
             path = f"{root}_step{step}{ext}"
         if os.path.dirname(path):
             os.makedirs(os.path.dirname(path), exist_ok=True)
-        peft_io.save_adapter(path, jax.device_get(lora_t), spec)
-        adam_mod.save_state(path + ".opt", jax.device_get(opt_st), tc.adam())
-        log.info(f"saved adapter -> {path}")
-        if final and args.peft_export_dir:
-            peft_io.export_peft(args.peft_export_dir,
-                                jax.device_get(lora_t), spec, "gpt2",
-                                base_model_name=args.pretrained_dir)
-            log.info(f"PEFT export -> {args.peft_export_dir}")
+        # blocking part: one batched D2H snapshot of adapter + opt state;
+        # the write (key-map, encode, atomic safetensors publish) runs on
+        # the checkpointer's background thread under --async_save
+        (lora_h, opt_h), snap_ms = async_ckpt.timed_snapshot(
+            (lora_t, opt_st))
+
+        def write():
+            peft_io.save_adapter(path, lora_h, spec)
+            adam_mod.save_state(path + ".opt", opt_h, tc.adam())
+            log.info(f"saved adapter -> {path}")
+            if final and args.peft_export_dir:
+                peft_io.export_peft(args.peft_export_dir, lora_h, spec,
+                                    "gpt2",
+                                    base_model_name=args.pretrained_dir)
+                log.info(f"PEFT export -> {args.peft_export_dir}")
+            return [path, path + ".opt"]
+
+        async_ckpt.submit(ckpt, step, write, final=final,
+                          snapshot_ms=snap_ms)
 
     # in-loop MFU: the SAME analytic estimator as bench.py's MFU column
     # (core/telemetry.transformer_flops), per GLOBAL optimizer step

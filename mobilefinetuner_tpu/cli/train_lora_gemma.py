@@ -31,6 +31,7 @@ from mobilefinetuner_tpu.cli import common
 from mobilefinetuner_tpu.core.logging import get_logger
 from mobilefinetuner_tpu.data.tokenizer_gemma import GemmaTokenizer
 from mobilefinetuner_tpu.data.wikitext2 import WT2Config, WikiText2Dataset
+from mobilefinetuner_tpu.io import async_ckpt
 from mobilefinetuner_tpu.io.checkpoints import load_gemma3
 from mobilefinetuner_tpu.lora import peft_io
 from mobilefinetuner_tpu.lora.lora import (GEMMA_PRESETS, LoRASpec,
@@ -206,18 +207,27 @@ def main(argv=None) -> int:
             steps=args.align_steps)
         return 0
 
-    def save_hook(step, lora_t, opt_st, final):
+    def save_hook(step, lora_t, opt_st, final, ckpt=None):
         os.makedirs(args.output_dir, exist_ok=True)
         name = "gemma_lora.safetensors" if final \
             else f"gemma_lora_step{step}.safetensors"
         path = os.path.join(args.output_dir, name)
-        peft_io.save_adapter(path, jax.device_get(lora_t), spec)
-        adam_mod.save_state(path + ".opt", jax.device_get(opt_st), tc.adam())
-        log.info(f"saved adapter -> {path}")
-        if final and args.peft_export_dir:
-            peft_io.export_peft(args.peft_export_dir,
-                                jax.device_get(lora_t), spec, "gemma",
-                                base_model_name=args.model_dir)
+        # blocking snapshot on the loop thread; write off-loop (atomic)
+        (lora_h, opt_h), snap_ms = async_ckpt.timed_snapshot(
+            (lora_t, opt_st))
+
+        def write():
+            peft_io.save_adapter(path, lora_h, spec)
+            adam_mod.save_state(path + ".opt", opt_h, tc.adam())
+            log.info(f"saved adapter -> {path}")
+            if final and args.peft_export_dir:
+                peft_io.export_peft(args.peft_export_dir, lora_h, spec,
+                                    "gemma",
+                                    base_model_name=args.model_dir)
+            return [path, path + ".opt"]
+
+        async_ckpt.submit(ckpt, step, write, final=final,
+                          snapshot_ms=snap_ms)
 
     # in-loop MFU from the shared estimator (core/telemetry.py)
     from mobilefinetuner_tpu.core.telemetry import transformer_flops

@@ -130,10 +130,31 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "ppl": _OPT_NUM,
         "tokens": (int,),
     },
+    # one per completed checkpoint WRITE (emitted by io/async_ckpt.py's
+    # writer — possibly from its background thread). wall_s is the
+    # BLOCKING cost the save charged to the step loop (snapshot only
+    # under --async_save; snapshot + write on the sync oracle path) —
+    # the number the goodput `checkpoint` bucket counts; write_ms/bytes/
+    # mb_s describe the disk write, which under async overlaps `step`.
     "checkpoint": {
         "step": (int,),
         "final": (bool,),
         "wall_s": _NUM,
+        # round-10 snapshot/write split (optional on READ: pre-async
+        # streams carry only step/final/wall_s)
+        "snapshot_ms": _NUM,
+        "write_ms": _NUM,
+        "bytes": (int,),
+        "mb_s": _OPT_NUM,           # None when bytes/write_ms unknown
+        "async": (bool,),
+    },
+    # a snapshot superseded before its write started: the async writer's
+    # depth-1 queue coalesces to the newest snapshot when saves outpace
+    # the disk (backpressure by dropping stale recovery points, not by
+    # growing an unbounded queue of whole-tree host copies)
+    "ckpt_dropped": {
+        "step": (int,),             # the dropped snapshot's step
+        "superseded_by": (int,),    # the snapshot that replaced it
     },
     # one host's measured per-step time pulled away from the fleet: fired
     # by the coordinator after a --straggler_cadence cross-host gather
@@ -179,6 +200,8 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
 OPTIONAL_FIELDS: Dict[str, frozenset] = {
     "step_stats": frozenset({"host_step_ms"}),
     "run_end": frozenset({"goodput"}),
+    "checkpoint": frozenset({"snapshot_ms", "write_ms", "bytes", "mb_s",
+                             "async"}),
 }
 
 

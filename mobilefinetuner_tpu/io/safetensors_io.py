@@ -17,12 +17,51 @@ reference and the fallback. MFT_NO_NATIVE_ST=1 forces Python.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import struct
 from typing import Dict, Optional, Tuple
 
 import numpy as np
+
+
+@contextlib.contextmanager
+def atomic_publish(path: str):
+    """Crash-safe file publication (DESIGN.md §15): yields a tmp path
+    (`<path>.tmp.<pid>`) for the caller to write, then fsyncs it and
+    atomically `os.replace`s it onto `path` (plus a best-effort fsync of
+    the directory entry). A death at ANY instant before the rename —
+    including SIGKILL from the energy governor's suspend path or a
+    mid-write crash — leaves the previous `path` bytes untouched, so a
+    resumable checkpoint can never be replaced by a truncated one
+    (tests/test_async_ckpt.py kills a writer mid-write to pin this).
+    On exception the tmp file is removed and the exception propagates;
+    only a hard kill can leave a stale `.tmp.<pid>` file behind, which
+    later successful saves ignore (the pid suffix keeps concurrent
+    writers from colliding)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        yield tmp
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+        try:  # durability of the rename itself (directory entry)
+            dfd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                          os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass  # non-posix dir semantics: the data fsync already landed
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
 
 
 def _native_mod():
@@ -176,7 +215,19 @@ def save_safetensors(path: str, tensors: Dict[str, np.ndarray],
     passed as jax bfloat16 via float32 conversion upstream) are stored BF16.
     Uses the native streamed writer when available; the Python writer below
     is the fallback and behavioral reference.
+
+    EVERY write is atomically published (tmp + fsync + rename): since all
+    checkpoint writers in the repo — adapters, full-model saves, the .opt
+    optimizer sidecar — funnel through here, none of them can leave a
+    truncated file where a resumable checkpoint used to be.
     """
+    with atomic_publish(path) as tmp:
+        _write_safetensors(tmp, tensors, metadata, bf16_keys)
+
+
+def _write_safetensors(path: str, tensors: Dict[str, np.ndarray],
+                       metadata: Optional[Dict[str, str]] = None,
+                       bf16_keys: Optional[set] = None):
     nat = _native_mod()
     if nat is not None:
         # real write failures (IOError) propagate — a disk that rejects

@@ -54,7 +54,9 @@ PEFT_TARGET_MODULES = {
 
 def save_adapter(path: str, lora_tree, spec: LoRASpec,
                  extra_metadata: Optional[Dict[str, str]] = None):
-    """Native adapter safetensors: stacked arrays + spec metadata."""
+    """Native adapter safetensors: stacked arrays + spec metadata.
+    Atomically published via save_safetensors (tmp + fsync + rename) —
+    a crash mid-save leaves the previous adapter intact."""
     tensors = {}
     for name, entry in lora_tree["blocks"].items():
         tensors[f"blocks.{name}.A"] = np.asarray(entry["A"],
@@ -130,8 +132,11 @@ def export_peft(out_dir: str, lora_tree, spec: LoRASpec, family: str,
         "target_modules": target_modules,
         "inference_mode": False,
     }
-    with open(os.path.join(out_dir, "adapter_config.json"), "w") as f:
-        json.dump(cfg, f, indent=2)
+    from mobilefinetuner_tpu.io.safetensors_io import atomic_publish
+    cfg_path = os.path.join(out_dir, "adapter_config.json")
+    with atomic_publish(cfg_path) as tmp:  # crash-safe like the tensors
+        with open(tmp, "w") as f:
+            json.dump(cfg, f, indent=2)
 
 
 def import_peft(adapter_dir: str, family: str) -> Tuple[dict, LoRASpec]:

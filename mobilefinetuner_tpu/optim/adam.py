@@ -152,8 +152,13 @@ def clip_by_global_norm(grads, max_norm: float):
 
 def save_state(path: str, state: dict, config: AdamConfig):
     """Serialize optimizer state + config to a safetensors blob
-    (Adam::save analog, adam.cpp:103+)."""
+    (Adam::save analog, adam.cpp:103+). Device leaves come to host via
+    one batched issue-then-wait (io/async_ckpt.snapshot) instead of a
+    serialized per-leaf pull; the write itself is atomically published
+    by save_safetensors."""
+    from mobilefinetuner_tpu.io.async_ckpt import snapshot
     from mobilefinetuner_tpu.io.safetensors_io import save_safetensors
+    state = snapshot(state)  # no-op on trees already on host
     flat = {}
     leaves, _ = jax.tree_util.tree_flatten_with_path(state)
     for path_keys, leaf in leaves:

@@ -182,11 +182,15 @@ def init_opt_offload(params, plan, compute_dtype=jnp.bfloat16, device=None,
 
 def master_to_params(opt_state, plan, shape_tree):
     """Gather the f32 master back to host numpy in ORIGINAL shapes (for
-    save_gemma3 / checkpoint writers)."""
+    save_gemma3 / checkpoint writers). One batched issue-then-wait pull
+    of the whole master tree (io/async_ckpt.snapshot) — the previous
+    per-leaf device_get serialized a blocking transfer per tensor."""
+    from mobilefinetuner_tpu.io.async_ckpt import snapshot
+    master = snapshot(opt_state["master"])
+
     def back(x, c, ref):
-        arr = np.asarray(jax.device_get(x), np.float32)
-        return arr.reshape(np.shape(ref))
-    return jax.tree.map(back, opt_state["master"], plan, shape_tree)
+        return np.asarray(x, np.float32).reshape(np.shape(ref))
+    return jax.tree.map(back, master, plan, shape_tree)
 
 
 def save_opt_sidecar(path: str, opt_state, adam_cfg):

@@ -1,0 +1,347 @@
+"""Async overlapped checkpointing tests (io/async_ckpt.py, DESIGN.md §15):
+the snapshot is the step loop's only blocking work and survives donated
+buffers, the background writer coalesces under backpressure and surfaces
+its failures, every writer publishes atomically (a SIGKILL mid-write can
+never corrupt the checkpoint --resume_from loads), and the sync oracle
+(--async_save 0) produces byte-identical files to the async pipeline for
+both model families, end to end through the real CLIs."""
+
+import filecmp
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from fixtures import (write_tiny_gemma3_dir, write_tiny_gpt2_dir,
+                      write_wikitext_dir)
+
+from mobilefinetuner_tpu.io.async_ckpt import (AsyncCheckpointer, snapshot,
+                                               submit, timed_snapshot,
+                                               tree_bytes)
+from mobilefinetuner_tpu.io.safetensors_io import (SafeTensorsReader,
+                                                   atomic_publish,
+                                                   save_safetensors)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def gpt2_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("gpt2ckpt")
+    write_tiny_gpt2_dir(str(d))
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def gemma_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("gemmackpt")
+    write_tiny_gemma3_dir(str(d))
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def wiki_dir(tmp_path_factory):
+    return write_wikitext_dir(str(tmp_path_factory.mktemp("wt2")))
+
+
+# --------------------------- snapshot ---------------------------------------
+
+def test_snapshot_returns_plain_numpy():
+    import jax
+    import jax.numpy as jnp
+    tree = {"a": jax.device_put(jnp.arange(8, dtype=jnp.float32)),
+            "b": {"c": jax.device_put(jnp.ones((2, 3)))},
+            "host": np.arange(4)}  # numpy passes through untouched
+    host = snapshot(tree)
+    for leaf in [host["a"], host["b"]["c"], host["host"]]:
+        assert isinstance(leaf, np.ndarray)
+    np.testing.assert_array_equal(host["a"], np.arange(8, dtype=np.float32))
+    # idempotent on an already-host tree (multi-host gathered case)
+    again = snapshot(host)
+    np.testing.assert_array_equal(again["a"], host["a"])
+    assert tree_bytes(host) == host["a"].nbytes + host["b"]["c"].nbytes \
+        + host["host"].nbytes
+
+
+def test_timed_snapshot_reports_blocking_ms():
+    import jax.numpy as jnp
+    host, ms = timed_snapshot({"w": jnp.zeros((16, 16))})
+    assert isinstance(host["w"], np.ndarray) and ms >= 0.0
+
+
+def test_snapshot_immune_to_donated_updates():
+    """The donation-hazard regression (ISSUE 5): snapshot at step k, then
+    dispatch k+1..k+3 with DONATED input buffers — the loop's real train
+    step donates the trainable/optimizer trees, so an un-awaited D2H
+    copy would race the donated buffers' reuse and snapshot garbage.
+    snapshot() must return step-k values no matter what the loop
+    dispatches afterwards."""
+    import jax
+    import jax.numpy as jnp
+
+    step = jax.jit(lambda p: jax.tree.map(lambda x: x + 1.0, p),
+                   donate_argnums=0)
+    params = {"w": jax.device_put(jnp.zeros(4096, jnp.float32)),
+              "b": jax.device_put(jnp.zeros((8, 8), jnp.float32))}
+    for _ in range(2):  # reach "step k"
+        params = step(params)
+    snap = snapshot(params)
+    for _ in range(3):  # k+1..k+3 donate (and may reuse) the old buffers
+        params = step(params)
+    jax.block_until_ready(params)
+    np.testing.assert_array_equal(snap["w"],
+                                  np.full(4096, 2.0, np.float32))
+    np.testing.assert_array_equal(snap["b"],
+                                  np.full((8, 8), 2.0, np.float32))
+    # and the loop really kept running past the snapshot
+    np.testing.assert_array_equal(np.asarray(params["w"])[:4],
+                                  np.full(4, 5.0, np.float32))
+
+
+# --------------------------- writer semantics -------------------------------
+
+def _sink(events):
+    return lambda ev, **f: events.append({"event": ev, **f})
+
+
+def test_sync_oracle_runs_inline(tmp_path):
+    events = []
+    ck = AsyncCheckpointer(enabled=False, event_sink=_sink(events))
+    p = str(tmp_path / "sync.safetensors")
+
+    def write():
+        save_safetensors(p, {"x": np.arange(4, dtype=np.float32)})
+        return [p]
+
+    ck.save(3, write, snapshot_ms=1.5)
+    assert os.path.exists(p)  # inline: durable the moment save returns
+    ck.close()
+    (ev,) = events
+    assert ev["event"] == "checkpoint" and ev["async"] is False
+    # sync blocking cost = snapshot + write
+    assert ev["wall_s"] >= ev["write_ms"] / 1000.0
+    assert ev["bytes"] == os.path.getsize(p) and ev["step"] == 3
+
+
+def test_async_write_lands_with_split_telemetry(tmp_path):
+    events = []
+    ck = AsyncCheckpointer(enabled=True, event_sink=_sink(events))
+    p = str(tmp_path / "async.safetensors")
+    ck.save(7, lambda: (save_safetensors(
+        p, {"x": np.ones(8, np.float32)}), [p])[1], snapshot_ms=2.0)
+    ck.close()
+    assert os.path.exists(p) and ck.written == 1
+    (ev,) = events
+    assert ev["event"] == "checkpoint" and ev["async"] is True
+    # async blocking cost = the snapshot ONLY; the write overlapped
+    assert ev["snapshot_ms"] == 2.0 and ev["wall_s"] == 0.002
+    assert ev["write_ms"] > 0 and ev["bytes"] == os.path.getsize(p)
+
+
+def test_depth1_queue_coalesces_to_newest(tmp_path):
+    """Backpressure: a save landing while one is pending supersedes it —
+    the stale snapshot is dropped with a ckpt_dropped event, the queue
+    never grows beyond one whole-tree host copy."""
+    events, written = [], []
+    ck = AsyncCheckpointer(enabled=True, event_sink=_sink(events))
+    gate = threading.Event()
+
+    def slow_write(step):
+        def write():
+            gate.wait(30.0)
+            written.append(step)
+            return []
+        return write
+
+    ck.save(1, slow_write(1))           # picked up by the writer
+    time.sleep(0.05)                    # let it start (blocked on gate)
+    ck.save(2, slow_write(2))           # pending
+    ck.save(3, slow_write(3))           # supersedes 2
+    gate.set()
+    ck.close()
+    assert written == [1, 3] and ck.dropped == 1
+    drops = [e for e in events if e["event"] == "ckpt_dropped"]
+    assert drops == [{"event": "ckpt_dropped", "step": 2,
+                      "superseded_by": 3}]
+    # final=True drains: both surviving checkpoints completed
+    assert [e["step"] for e in events
+            if e["event"] == "checkpoint"] == [1, 3]
+
+
+def test_background_write_error_surfaces(tmp_path):
+    ck = AsyncCheckpointer(enabled=True)
+
+    def boom():
+        raise IOError("disk full")
+
+    ck.save(1, boom)
+    with pytest.raises(RuntimeError, match="background checkpoint"):
+        ck.drain()
+    # errors don't wedge the writer: each failed write is re-raised at
+    # the next drain, and exception-path cleanup can swallow them
+    ck.save(2, boom)
+    with pytest.raises(RuntimeError):
+        ck.drain()
+    ck.close(raise_errors=False)  # exception-path cleanup swallows
+
+
+def test_close_stops_writer_thread_even_on_write_error():
+    """Regression: close(raise_errors=True) must stop/join the writer
+    thread in a finally — a failed write that re-raises at close must
+    not leak a parked ckpt-writer thread per run."""
+    ck = AsyncCheckpointer(enabled=True)
+    ck.save(1, lambda: (_ for _ in ()).throw(IOError("disk full")))
+    with pytest.raises(RuntimeError):
+        ck.close()
+    assert ck._thread is None
+    assert not [t for t in threading.enumerate()
+                if t.name == "ckpt-writer"]
+
+
+def test_submit_without_checkpointer_writes_inline(tmp_path):
+    p = str(tmp_path / "direct.safetensors")
+    submit(None, 0, lambda: (save_safetensors(
+        p, {"x": np.zeros(2, np.float32)}), [p])[1])
+    assert os.path.exists(p)
+
+
+# --------------------------- atomic publication -----------------------------
+
+def test_atomic_publish_success_and_abort(tmp_path):
+    p = str(tmp_path / "f.bin")
+    with atomic_publish(p) as tmp:
+        with open(tmp, "wb") as f:
+            f.write(b"v1")
+    assert open(p, "rb").read() == b"v1"
+    # a failure mid-write leaves the published bytes untouched and no tmp
+    with pytest.raises(RuntimeError):
+        with atomic_publish(p) as tmp:
+            with open(tmp, "wb") as f:
+                f.write(b"garbage")
+            raise RuntimeError("writer died")
+    assert open(p, "rb").read() == b"v1"
+    assert os.listdir(tmp_path) == ["f.bin"]  # tmp cleaned up
+
+
+_KILL_CHILD = textwrap.dedent("""
+    import sys, time
+    import numpy as np
+    import mobilefinetuner_tpu.io.safetensors_io as sio
+
+    path = sys.argv[1]
+    orig = sio._write_safetensors
+
+    def slow(p, tensors, metadata=None, bf16_keys=None):
+        orig(p, tensors, metadata, bf16_keys)  # tmp fully written...
+        print("TMP_DONE", flush=True)
+        time.sleep(60)  # ...killed before fsync + atomic rename
+
+    sio._write_safetensors = slow
+    sio.save_safetensors(path, {"x": np.full(1024, 2.0, np.float32)})
+""")
+
+
+def test_sigkill_mid_write_leaves_previous_checkpoint_loadable(tmp_path):
+    """The crash-safety contract: a checkpoint v1 exists; a writer is
+    SIGKILLed while overwriting it (after the tmp bytes, before the
+    rename — the widest window a real crash can hit); v1 must still load
+    byte-for-byte, and the stale tmp must not break later saves."""
+    p = str(tmp_path / "ckpt.safetensors")
+    v1 = {"x": np.full(1024, 1.0, np.float32)}
+    save_safetensors(p, v1)
+    golden = open(p, "rb").read()
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    child = subprocess.Popen([sys.executable, "-c", _KILL_CHILD, p],
+                             stdout=subprocess.PIPE, env=env, text=True)
+    try:
+        assert child.stdout.readline().strip() == "TMP_DONE"
+        os.kill(child.pid, signal.SIGKILL)
+    finally:
+        child.wait(timeout=30)
+
+    assert open(p, "rb").read() == golden  # prior checkpoint untouched
+    np.testing.assert_array_equal(
+        SafeTensorsReader(p).load_all()["x"], v1["x"])
+    # the orphaned .tmp.<childpid> is inert: the next save (different
+    # pid) publishes cleanly over the same destination
+    assert any(f.startswith("ckpt.safetensors.tmp.")
+               for f in os.listdir(tmp_path))
+    save_safetensors(p, {"x": np.full(1024, 3.0, np.float32)})
+    assert SafeTensorsReader(p).load_all()["x"][0] == 3.0
+
+
+# --------------------------- CLI parity e2e ---------------------------------
+
+def test_gpt2_lora_sync_async_byte_identical(gpt2_dir, wiki_dir, tmp_path):
+    """--async_save 1 vs 0 (oracle) must produce byte-identical adapter
+    AND optimizer-sidecar files for the same seeded run."""
+    from mobilefinetuner_tpu.cli.gpt2_lora_finetune import main
+    outs = {}
+    for mode in (0, 1):
+        out = str(tmp_path / f"a{mode}.safetensors")
+        rc = main(["--pretrained_dir", gpt2_dir, "--data_dir", wiki_dir,
+                   "--steps", "3", "--batch_size", "2", "--seq_len", "32",
+                   "--lora_out", out, "--async_save", str(mode)])
+        assert rc == 0
+        outs[mode] = out
+    for sfx in ("", ".opt"):
+        assert filecmp.cmp(outs[0] + sfx, outs[1] + sfx,
+                           shallow=False), sfx
+
+
+def test_gemma_fullft_sync_async_byte_identical(gemma_dir, wiki_dir,
+                                                tmp_path):
+    from mobilefinetuner_tpu.cli.gemma_full_finetune import main
+    outs = {}
+    for mode in (0, 1):
+        out = str(tmp_path / f"g{mode}.safetensors")
+        rc = main(["--model_dir", gemma_dir, "--data_dir", wiki_dir,
+                   "--steps", "2", "--batch_size", "2", "--seq_len", "32",
+                   "--loss_chunks", "2", "--output_path", out,
+                   "--async_save", str(mode)])
+        assert rc == 0
+        outs[mode] = out
+    for sfx in ("", ".opt"):
+        assert filecmp.cmp(outs[0] + sfx, outs[1] + sfx,
+                           shallow=False), sfx
+
+
+def test_periodic_async_saves_emit_split_telemetry(gpt2_dir, wiki_dir,
+                                                   tmp_path):
+    """End to end through run_training: --save_every under the default
+    --async_save produces loadable periodic checkpoints and checkpoint
+    events carrying the round-10 snapshot/write split, all valid against
+    EVENT_SCHEMA; the final event is a drained final=True save."""
+    from mobilefinetuner_tpu.cli.gpt2_lora_finetune import main
+    from mobilefinetuner_tpu.core.telemetry import validate_event
+    out = str(tmp_path / "a.safetensors")
+    stream = str(tmp_path / "run.jsonl")
+    rc = main(["--pretrained_dir", gpt2_dir, "--data_dir", wiki_dir,
+               "--steps", "4", "--batch_size", "2", "--seq_len", "32",
+               "--lora_out", out, "--save_every", "2",
+               "--telemetry_out", stream])
+    assert rc == 0
+    assert os.path.exists(str(tmp_path / "a_step2.safetensors"))
+    assert os.path.exists(out) and os.path.exists(out + ".opt")
+    events = [json.loads(l) for l in open(stream).read().splitlines()]
+    cks = [e for e in events if e["event"] == "checkpoint"]
+    assert len(cks) == 2  # step-2 periodic + final (fast writes: 0 drops)
+    for e in cks:
+        assert validate_event(e) is None
+        assert e["async"] is True and e["bytes"] > 0
+        assert e["write_ms"] > 0 and e["snapshot_ms"] >= 0
+        # under async the blocking cost is the snapshot, not the write
+        # (wall_s is rounded to 4 decimals — compare at that granularity)
+        assert abs(e["wall_s"] - e["snapshot_ms"] / 1000.0) < 1e-4
+    assert cks[-1]["final"] is True
+    assert not [e for e in events if e["event"] == "ckpt_dropped"]

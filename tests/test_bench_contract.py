@@ -103,3 +103,37 @@ def test_failed_headline_reports_zero_and_exits_nonzero(bench,
     with open("BENCH_SUITE.json") as f:
         suite = json.load(f)
     assert "error" in suite["suite"][0]
+
+
+def test_bench_checkpoint_rows_contract(tmp_path):
+    """tools/bench_checkpoint.py (round 10): each row self-certifies the
+    async-save claim it rides on — sync oracle stall vs async blocking
+    time through the REAL AsyncCheckpointer plus file-against-file byte
+    parity. Tiny trees on CPU pin the schema and the invariants; the
+    ≤25% acceptance bar is read off the real-size BENCH_CKPT artifact."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import bench_checkpoint as bc
+    rows = bc.run_rows("tiny", repeats=2, out_dir=str(tmp_path))
+    assert [r["config"] for r in rows] == ["gpt2s_fullft_tiny",
+                                           "gemma270m_lora_tiny"]
+    for r in rows:
+        for k in ("tree_bytes", "sync_stall_ms", "async_blocking_ms",
+                  "snapshot_ms", "write_ms", "blocking_frac"):
+            assert isinstance(r[k], (int, float)) and r[k] >= 0, k
+        assert r["byte_identical"] is True
+        # the async path may never block LONGER than the sync oracle
+        # (the sync stall includes the same snapshot plus the write)
+        assert r["async_blocking_ms"] <= r["sync_stall_ms"], r
+        assert 0.0 <= r["blocking_frac"] <= 1.0
+    # the checked-in real-size rows must satisfy the acceptance bar:
+    # blocking ≤ 25% of the sync stall, byte-identical files
+    art = os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "BENCH_CKPT_r10.json")
+    with open(art) as f:
+        real = json.load(f)["rows"]
+    assert {r["config"] for r in real} == {"gpt2s_fullft_real",
+                                           "gemma270m_lora_real"}
+    for r in real:
+        assert r["blocking_frac"] <= 0.25 and r["byte_identical"], r

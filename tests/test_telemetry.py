@@ -57,7 +57,13 @@ REPRESENTATIVE = {
                  stacks_file="/tmp/run.jsonl.stacks",
                  device_probe="timeout", action="continue"),
     "eval": dict(step=10, loss=3.1, ppl=22.2, tokens=4096),
-    "checkpoint": dict(step=10, final=False, wall_s=0.2),
+    # round-10 snapshot/write split (io/async_ckpt.py): wall_s is the
+    # BLOCKING cost charged to the loop, the write fields the background
+    # cost; the split fields are optional on read (pre-async streams)
+    "checkpoint": dict(step=10, final=False, wall_s=0.2,
+                       snapshot_ms=1.3, write_ms=198.7, bytes=1 << 20,
+                       mb_s=5.03, **{"async": True}),
+    "ckpt_dropped": dict(step=10, superseded_by=12),
     "run_end": dict(steps=10, wall_s=60.0, exit="ok",
                     goodput={"total_s": 60.0, "step_s": 50.0,
                              "productive_frac": 0.83}),

@@ -1,0 +1,193 @@
+"""Checkpoint save-path benchmark: sync stall vs async blocking time.
+
+Measures what ISSUE 5 / DESIGN.md §15 claims: with snapshot-then-write
+checkpointing (`io/async_ckpt.py`) the step loop's blocking cost at a
+save step collapses to the batched device→host snapshot, while the
+HF key-mapping + encode + atomic safetensors write moves to the
+background writer. One JSON row per measured tree on stdout:
+
+  {"config": "...", "tree_bytes": ..., "sync_stall_ms": ...,
+   "async_blocking_ms": ..., "snapshot_ms": ..., "write_ms": ...,
+   "mb_s": ..., "blocking_frac": ..., "byte_identical": true}
+
+`sync_stall_ms` is the full old-path stall (snapshot + write, the
+`--async_save 0` oracle); `async_blocking_ms` is what the loop pays
+under `--async_save` (snapshot + enqueue — the acceptance bar is
+async_blocking ≤ 25% of sync on the real trees); `write_ms` is the
+background write as reported by the checkpointer's own telemetry
+event, and `byte_identical` is checked file-against-file, so every row
+self-certifies the parity claim it rides on.
+
+Trees measured by default (the two checkpoint shapes the train CLIs
+produce): the GPT-2-small full-FT tree (params + Adam m/v sidecar,
+via the real save_gpt2/save_state writers) and the Gemma-3-270M LoRA
+adapter (save_adapter + sidecar). CPU-runnable: `--size tiny` swaps in
+the test configs (what tests/test_async_ckpt.py contract-tests).
+
+Usage:
+  python tools/bench_checkpoint.py                # real sizes
+  python tools/bench_checkpoint.py --size tiny --repeats 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import filecmp
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _device_tree(host_tree):
+    """Place a host pytree on the default device so the snapshot
+    measures a real D2H pull."""
+    return jax.tree.map(
+        lambda x: jax.device_put(jnp.asarray(x)).block_until_ready(),
+        host_tree)
+
+
+def _adam_like(params):
+    """Adam m/v the same shape as params (what the .opt sidecar holds)."""
+    return {"step": jnp.zeros((), jnp.int32),
+            "m": jax.tree.map(jnp.zeros_like, params),
+            "v": jax.tree.map(jnp.zeros_like, params)}
+
+
+def build_gpt2_fullft(size: str):
+    from mobilefinetuner_tpu.core.config import GPT2Config
+    from mobilefinetuner_tpu.io.checkpoints import save_gpt2
+    from mobilefinetuner_tpu.optim.adam import AdamConfig, save_state
+    from mobilefinetuner_tpu.models import gpt2
+    cfg = GPT2Config.tiny() if size == "tiny" else GPT2Config.gpt2_small()
+    params = _device_tree(gpt2.init_params(cfg, jax.random.PRNGKey(0)))
+    opt = _device_tree(_adam_like(params))
+
+    def write(path, params_h, opt_h):
+        save_gpt2(path, params_h)
+        save_state(path + ".opt", opt_h, AdamConfig())
+        return [path, path + ".opt"]
+
+    return f"gpt2s_fullft_{size}", (params, opt), write
+
+
+def build_gemma_lora(size: str):
+    from mobilefinetuner_tpu.core.config import Gemma3TextConfig
+    from mobilefinetuner_tpu.lora.lora import LoRASpec, init_lora_gemma3
+    from mobilefinetuner_tpu.lora.peft_io import save_adapter
+    from mobilefinetuner_tpu.optim.adam import AdamConfig, save_state
+    cfg = (Gemma3TextConfig.tiny() if size == "tiny"
+           else Gemma3TextConfig.gemma3_270m())
+    spec = LoRASpec(rank=8, alpha=16.0)
+    lora = _device_tree(init_lora_gemma3(cfg, spec,
+                                         jax.random.PRNGKey(1)))
+    opt = _device_tree(_adam_like(lora))
+
+    def write(path, lora_h, opt_h):
+        save_adapter(path, lora_h, spec)
+        save_state(path + ".opt", opt_h, AdamConfig())
+        return [path, path + ".opt"]
+
+    return f"gemma270m_lora_{size}", (lora, opt), write
+
+
+def bench_tree(name, trees, write, out_dir, repeats: int) -> dict:
+    """One row: run the sync oracle and the async pipeline through the
+    REAL AsyncCheckpointer (the measured path is the shipped path), take
+    the best-of-repeats for each side, verify byte parity."""
+    from mobilefinetuner_tpu.io.async_ckpt import (AsyncCheckpointer,
+                                                   timed_snapshot,
+                                                   tree_bytes)
+    events = []
+    sink = lambda ev, **f: events.append((ev, f))
+    sync_path = os.path.join(out_dir, f"{name}_sync.safetensors")
+    async_path = os.path.join(out_dir, f"{name}_async.safetensors")
+
+    sync_ms, async_ms, snap_ms, write_ms, mb_s, nbytes = \
+        [], [], [], [], [], 0
+    for _ in range(repeats):
+        # sync oracle: blocking = snapshot + write
+        ck = AsyncCheckpointer(enabled=False, event_sink=sink)
+        t0 = time.perf_counter()
+        host, sms = timed_snapshot(trees)
+        ck.save(0, lambda: write(sync_path, *host), snapshot_ms=sms)
+        sync_ms.append((time.perf_counter() - t0) * 1000.0)
+        nbytes = tree_bytes(host)
+
+        # async: blocking = snapshot + enqueue; write happens behind
+        ck = AsyncCheckpointer(enabled=True, event_sink=sink)
+        t0 = time.perf_counter()
+        host, sms = timed_snapshot(trees)
+        ck.save(0, lambda: write(async_path, *host), snapshot_ms=sms)
+        async_ms.append((time.perf_counter() - t0) * 1000.0)
+        snap_ms.append(sms)
+        ck.close()  # drain so write_ms below covers a completed write
+        ev = [f for e, f in events if e == "checkpoint"][-1]
+        write_ms.append(ev["write_ms"])
+        if ev["mb_s"]:
+            mb_s.append(ev["mb_s"])
+
+    identical = all(
+        filecmp.cmp(sync_path + sfx, async_path + sfx, shallow=False)
+        for sfx in ("", ".opt"))
+    best_sync, best_async = min(sync_ms), min(async_ms)
+    return {
+        "config": name,
+        "tree_bytes": nbytes,
+        "sync_stall_ms": round(best_sync, 3),
+        "async_blocking_ms": round(best_async, 3),
+        "snapshot_ms": round(min(snap_ms), 3),
+        "write_ms": round(min(write_ms), 3),
+        "mb_s": round(max(mb_s), 2) if mb_s else None,
+        "blocking_frac": round(best_async / best_sync, 4)
+        if best_sync > 0 else None,
+        "byte_identical": identical,
+    }
+
+
+def run_rows(size: str, repeats: int, out_dir=None) -> list:
+    keep = out_dir is not None
+    out_dir = out_dir or tempfile.mkdtemp(prefix="bench_ckpt_")
+    os.makedirs(out_dir, exist_ok=True)
+    rows = []
+    try:
+        for build in (build_gpt2_fullft, build_gemma_lora):
+            name, trees, write = build(size)
+            rows.append(bench_tree(name, trees, write, out_dir, repeats))
+    finally:
+        if not keep:
+            shutil.rmtree(out_dir, ignore_errors=True)
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", choices=["real", "tiny"], default="real",
+                    help="real = GPT-2s full FT + Gemma-270M LoRA; "
+                         "tiny = test configs (CPU contract runs)")
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--out_dir", default="",
+                    help="keep the written checkpoint files here "
+                         "(default: tempdir, removed)")
+    ap.add_argument("--out", default="",
+                    help="also write the rows to this JSON file")
+    args = ap.parse_args(argv)
+    rows = run_rows(args.size, args.repeats, args.out_dir or None)
+    for r in rows:
+        print(json.dumps(r))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"rows": rows}, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

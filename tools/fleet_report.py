@@ -25,7 +25,8 @@ import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from telemetry_report import (_fmt, goodput_lines,  # noqa: E402
+from telemetry_report import (_fmt, checkpoint_lines,  # noqa: E402
+                              checkpoint_summary, goodput_lines,
                               hang_entries, hang_lines, load_events,
                               percentile, split_latest_run,
                               straggler_entries, straggler_lines)
@@ -82,6 +83,10 @@ def shard_summary(host: int, events: list, n_invalid: int) -> dict:
         "stragglers": sum(1 for e in scope if e["event"] == "straggler"),
         "hangs": sum(1 for e in scope if e["event"] == "hang"),
         "anomalies": sum(1 for e in scope if e["event"] == "anomaly"),
+        # snapshot/write split + coalesced-drop count (shared builder —
+        # only the coordinator saves, but the rollup is per-shard so a
+        # misconfigured worker writing checkpoints would show up)
+        "checkpoints": checkpoint_summary(scope),
         "run_end": ({"steps": ends[-1]["steps"],
                      "wall_s": ends[-1]["wall_s"],
                      "exit": ends[-1]["exit"],
@@ -166,6 +171,9 @@ def print_fleet(s: dict):
             flags.append("SEQ NOT MONOTONIC")
         if ph["invalid_lines"]:
             flags.append(f"{ph['invalid_lines']} invalid lines")
+        if ph["checkpoints"]["dropped"]:
+            flags.append(f"{ph['checkpoints']['dropped']} ckpt snapshot(s) "
+                         f"coalesced away")
         if ph["host_stamp_mismatches"]:
             flags.append(f"{ph['host_stamp_mismatches']} host-stamp "
                          f"mismatches")
@@ -193,6 +201,11 @@ def print_fleet(s: dict):
               f"(a lagging shard means a stalled or dead host)")
     for line in straggler_lines(s["stragglers"]) + hang_lines(s["hangs"]):
         print(line)
+    # fleet checkpoint rollup (coordinator writes; shared renderer)
+    h0 = s["per_host"].get(0)
+    if h0:
+        for line in checkpoint_lines(h0["checkpoints"]):
+            print(line)
     if s["hosts_missing_run_end"]:
         print(f"  hosts without run_end: {s['hosts_missing_run_end']}")
     for line in goodput_lines(s["goodput"]):  # one shared renderer

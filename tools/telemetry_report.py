@@ -134,7 +134,7 @@ def summarize(events, n_invalid=0) -> dict:
         "evals": [{"step": e["step"], "loss": e["loss"], "ppl": e["ppl"],
                    "macro_accuracy": e.get("macro_accuracy")}
                   for e in by.get("eval", [])],
-        "checkpoints": len(by.get("checkpoint", [])),
+        "checkpoints": checkpoint_summary(scope),
         "stragglers": straggler_entries(scope),
         "hangs": hang_entries(scope),
         # a killed LATEST run leaves no run_end after its run_start (a
@@ -163,6 +163,46 @@ def summarize(events, n_invalid=0) -> dict:
 
 def _fmt(v, nd=2):
     return "-" if v is None else f"{v:.{nd}f}"
+
+
+def checkpoint_summary(events) -> dict:
+    """Roll up `checkpoint`/`ckpt_dropped` events with the round-10
+    snapshot/write split (io/async_ckpt.py): blocking_s is what the step
+    loop actually stalled (wall_s — snapshot only under --async_save),
+    write_s/bytes/mb_s the background write cost that overlapped compute,
+    dropped the snapshots coalesced away by the depth-1 writer queue.
+    ONE builder shared with tools/fleet_report.py. Pre-async streams
+    (step/final/wall_s only) still summarize: the split fields are
+    optional on read."""
+    cks = [e for e in events if e.get("event") == "checkpoint"]
+    mbs = [c["mb_s"] for c in cks if c.get("mb_s")]
+    return {
+        "count": len(cks),
+        "async": sum(1 for c in cks if c.get("async")),
+        "blocking_s": round(sum(c["wall_s"] for c in cks), 4),
+        "write_s": round(sum(c.get("write_ms") or 0.0
+                             for c in cks) / 1000.0, 4),
+        "bytes": sum(c.get("bytes") or 0 for c in cks),
+        "mb_s_mean": (round(sum(mbs) / len(mbs), 2) if mbs else None),
+        "dropped": sum(1 for e in events
+                       if e.get("event") == "ckpt_dropped"),
+    }
+
+
+def checkpoint_lines(ck) -> list:
+    """Render a checkpoint_summary dict (shared with fleet_report)."""
+    if not ck or not (ck["count"] or ck["dropped"]):
+        return []
+    line = (f"  checkpoints: {ck['count']} ({ck['async']} async), "
+            f"blocking {ck['blocking_s']:.2f}s")
+    if ck["write_s"]:
+        line += (f", background write {ck['write_s']:.2f}s"
+                 + (f" ({ck['bytes'] / 2**20:.1f} MB"
+                    + (f" @ {ck['mb_s_mean']:.1f} MB/s" if ck["mb_s_mean"]
+                       else "") + ")" if ck["bytes"] else ""))
+    if ck["dropped"]:
+        line += f", {ck['dropped']} snapshot(s) coalesced away"
+    return [line]
 
 
 def straggler_entries(events) -> list:
@@ -265,8 +305,8 @@ def print_summary(s: dict):
         else:
             print(f"  eval @ step {e['step']}: loss={_fmt(e['loss'], 4)} "
                   f"ppl={_fmt(e['ppl'])}")
-    if s["checkpoints"]:
-        print(f"  checkpoints: {s['checkpoints']}")
+    for line in checkpoint_lines(s["checkpoints"]):
+        print(line)
     for line in straggler_lines(s.get("stragglers", [])) \
             + hang_lines(s.get("hangs", [])):
         print(line)

@@ -180,6 +180,21 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "device_probe": (str,),
         "action": (str,),           # "continue" | "abort"
     },
+    # serving-request lifecycle (serve/engine.py): one event per phase
+    # transition — enqueue (submit), admit (prefill issued; queue_ms),
+    # first_token (ttft_ms closes), finish (new_tokens/ttft/tpot final),
+    # cancel. The SLO numbers telemetry_report's TTFT/TPOT percentiles
+    # and req/s are computed from.
+    "request": {
+        "id": (int,),
+        "phase": (str,),            # enqueue|admit|first_token|finish|cancel
+        "prompt_tokens": (int,),
+        "adapter": (int, type(None)),  # bank slot; None = base-only
+        "queue_ms": _OPT_NUM,       # enqueue -> admission
+        "new_tokens": _OPT_NUM,     # tokens generated so far
+        "ttft_ms": _OPT_NUM,        # enqueue -> first token
+        "tpot_ms": _OPT_NUM,        # mean per-token after the first
+    },
     # one per run on orderly exit; exit != "ok" names the exception type.
     # goodput: wall-clock bucket totals (seconds) from GoodputMeter — the
     # buckets sum to the run's wall time by construction (None on entry

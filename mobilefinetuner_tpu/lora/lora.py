@@ -176,8 +176,26 @@ def assign_adapters(stacked: dict, adapter_ids) -> dict:
     returned tree drops into the models' `lora=` argument for forwards
     and generation, but it is not a trainable tree (the int32 "ids" leaf
     cannot be differentiated, and routing indices are not parameters —
-    trainable_mask excludes them)."""
+    trainable_mask excludes them).
+
+    Concrete ids are validated against the stacked bank size here — a
+    jnp gather CLAMPS out-of-range indices, so an id typo would silently
+    serve every overflowing row from the LAST adapter in the bank (the
+    worst possible failure for multi-tenant routing: tenant A quietly
+    gets tenant Z's weights). Traced ids (the serve engine routes inside
+    its compiled step) skip the check; the engine's bank resolution is
+    the validator there."""
     ids = jnp.asarray(adapter_ids, jnp.int32)
+    first = next(iter(stacked["blocks"].values()))
+    n = int(first["A"].shape[0])
+    if not isinstance(ids, jax.core.Tracer):
+        concrete = np.asarray(ids)
+        bad = concrete[(concrete < 0) | (concrete >= n)]
+        if bad.size:
+            raise ValueError(
+                f"adapter id(s) {sorted(set(int(b) for b in bad))} out "
+                f"of range for a stacked bank of {n} adapter(s) "
+                f"(valid: 0..{n - 1})")
     out = dict(stacked)
     out["blocks"] = {name: dict(entry, ids=ids)
                      for name, entry in stacked["blocks"].items()}

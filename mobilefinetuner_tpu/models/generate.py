@@ -527,6 +527,224 @@ def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
     return toks.T
 
 
+# ------------------------------------------------- serving entry points -----
+#
+# The serving subsystem (serve/engine.py, DESIGN.md §16) decomposes the
+# one-shot generate() programs above into two trace-stable pieces it can
+# drive per request / per step:
+#
+#   *_prefill            one full-sequence forward for ONE admitted
+#                        request (right-padded to the engine's static
+#                        prompt length), returning the next-token logits
+#                        at the last REAL position plus every layer's
+#                        K/V for the host to scatter into pool blocks;
+#   *_decode_step_paged  one token step for ALL slots against the shared
+#                        block pool [NB, L, KV, bT, D]: write the fed
+#                        token's K/V at (tbl[s, pos//bT], pos%bT), read
+#                        each slot's pages through its block table
+#                        (ops/decode_attention.paged_attention — the
+#                        Pallas paged kernel is the TPU fast path), and
+#                        return the next-token logits.
+#
+# Serve sequences start at position 0 with no padding inside (the engine
+# right-pads only the prompt TAIL), so validity is simply col <= pos —
+# none of the left-padded mask algebra above applies. The layer math is
+# kept line-for-line with decode_step; the buffer structure differs
+# (pool scatter/gather instead of contiguous DUS), and each copy is
+# pinned by the tests/test_serve.py paged-vs-contiguous greedy oracle.
+
+
+def gpt2_prefill(config: GPT2Config, params, input_ids, attention_mask,
+                 compute_dtype=jnp.float32, lora=None):
+    """Prefill for serving: [B, P] right-padded prompts -> (next-token
+    logits [B, V] f32 at each row's last real position, (k, v) per-layer
+    caches [L, B, H, P, D])."""
+    params = jax.tree.map(jnp.asarray, params)
+    x, (pk, pv) = gpt2.hidden_states(
+        config, params, input_ids, attention_mask, lora=lora,
+        compute_dtype=compute_dtype, collect_kv=True)
+    n_real = attention_mask.sum(-1).astype(jnp.int32)
+    last = x[jnp.arange(x.shape[0]), n_real - 1]          # [B, E]
+    logits = last @ params["wte"].astype(compute_dtype).T
+    return logits.astype(jnp.float32), (pk, pv)
+
+
+def gemma3_prefill(config: Gemma3TextConfig, params, input_ids,
+                   attention_mask, compute_dtype=jnp.float32, lora=None):
+    """Gemma-3 serving prefill (see gpt2_prefill)."""
+    params = jax.tree.map(jnp.asarray, params)
+    x, (pk, pv) = gemma3.hidden_states(
+        config, params, input_ids, attention_mask, lora=lora,
+        compute_dtype=compute_dtype, collect_kv=True)
+    n_real = attention_mask.sum(-1).astype(jnp.int32)
+    last = x[jnp.arange(x.shape[0]), n_real - 1]
+    logits = last @ params["embed"].astype(compute_dtype).T
+    return logits.astype(jnp.float32), (pk, pv)
+
+
+def gpt2_decode_step_paged(config: GPT2Config, params, pool_k, pool_v,
+                           tok, pos, tbl, lora=None,
+                           compute_dtype=jnp.float32,
+                           attn_impl: str = "auto"):
+    """One continuous-batching decode step over a block-paged KV pool.
+
+    pool_k/pool_v [NB, L, H, bT, D]; tok [S] the token each slot feeds;
+    pos [S] its cache position (= tokens already cached); tbl [S, M]
+    per-slot block tables (idle slots -> trash block 0). Returns
+    (logits [S, V] f32, pool_k, pool_v) with the fed tokens' K/V
+    scattered in at (tbl[s, pos//bT], pos%bT).
+
+    attn_impl: "xla" = gather-based paged_attention (every backend),
+    "pallas" = the scalar-prefetch paged kernel, "auto" = pallas on TPU
+    when eligible. Both are parity-pinned to each other and to the
+    contiguous generate() oracle."""
+    from mobilefinetuner_tpu.ops.decode_attention import (
+        paged_attention, paged_decode_attention, paged_eligible)
+    S, M = tbl.shape
+    NB, L, H, bT, D = pool_k.shape
+    E = config.n_embd
+    eps = config.layer_norm_epsilon
+    params = jax.tree.map(jnp.asarray, params)
+    lora_b = None if lora is None else lora.get("blocks")
+    cast = lambda t: (t.astype(compute_dtype)
+                      if jnp.issubdtype(t.dtype, jnp.floating) else t)
+    wb = jax.tree.map(cast, params["blocks"])
+    use_pallas = attn_impl == "pallas" or (
+        attn_impl == "auto" and jax.default_backend() == "tpu"
+        and paged_eligible(H, 1, bT, D, pool_k.dtype.itemsize))
+    attend = paged_decode_attention if use_pallas else paged_attention
+
+    x = params["wte"][tok].astype(compute_dtype) \
+        + params["wpe"][pos].astype(compute_dtype)            # [S, E]
+    cols = jnp.arange(M * bT, dtype=jnp.int32)
+    ok = cols[None, :] <= pos[:, None]                        # [S, M*bT]
+    blk = tbl[jnp.arange(S), pos // bT]                       # [S]
+    off = pos % bT
+
+    def apply_lora(y, x_in, name, i):
+        entry = None if lora_b is None else lora_b.get(name)
+        return maybe_lora(y, x_in, entry, i)
+
+    def layer(inner, inp):
+        x, pk, pv = inner
+        bp, i = inp
+        h = gpt2.layer_norm(x, bp["ln_1"]["g"], bp["ln_1"]["b"], eps)
+        qkv = h @ bp["attn"]["qkv_w"] + bp["attn"]["qkv_b"]
+        qkv = apply_lora(qkv, h, "attn_qkv", i)
+        if lora_b is not None:
+            from mobilefinetuner_tpu.lora.lora import GPT2_SPLIT_QKV_SLOTS
+            for name, slot in GPT2_SPLIT_QKV_SLOTS.items():
+                if name in lora_b:
+                    sl = (Ellipsis, slice(slot * E, (slot + 1) * E))
+                    qkv = qkv.at[sl].set(apply_lora(qkv[sl], h, name, i))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        hd = lambda z: z.reshape(S, H, D)
+        q, k, v = hd(q), hd(k), hd(v)
+        # scatter the fed token's K/V into its slot's current page; idle
+        # slots land in the reserved trash block (never attended)
+        pk = pk.at[blk, i, :, off, :].set(k.astype(pk.dtype))
+        pv = pv.at[blk, i, :, off, :].set(v.astype(pv.dtype))
+        ctx = attend(q[:, :, None, :], pk, pv, tbl, i, ok, D ** -0.5)
+        ctx = ctx.reshape(S, E).astype(compute_dtype)
+        proj = ctx @ bp["attn"]["proj_w"] + bp["attn"]["proj_b"]
+        proj = apply_lora(proj, ctx, "attn_proj", i)
+        x = x + proj
+        h2 = gpt2.layer_norm(x, bp["ln_2"]["g"], bp["ln_2"]["b"], eps)
+        fc = h2 @ bp["mlp"]["fc_w"] + bp["mlp"]["fc_b"]
+        fc = gpt2.gelu_new(apply_lora(fc, h2, "mlp_fc_in", i))
+        out = fc @ bp["mlp"]["proj_w"] + bp["mlp"]["proj_b"]
+        out = apply_lora(out, fc, "mlp_fc_out", i)
+        return (x + out, pk, pv), None
+
+    (x, pool_k, pool_v), _ = jax.lax.scan(
+        layer, (x, pool_k, pool_v), (wb, jnp.arange(L, dtype=jnp.int32)))
+    x = gpt2.layer_norm(x, params["ln_f"]["g"].astype(compute_dtype),
+                        params["ln_f"]["b"].astype(compute_dtype), eps)
+    logits = x @ params["wte"].astype(compute_dtype).T
+    return logits.astype(jnp.float32), pool_k, pool_v
+
+
+def gemma3_decode_step_paged(config: Gemma3TextConfig, params, pool_k,
+                             pool_v, tok, pos, tbl, lora=None,
+                             compute_dtype=jnp.float32,
+                             attn_impl: str = "auto"):
+    """Gemma-3 paged decode step (see gpt2_decode_step_paged): GQA pool
+    [NB, L, Hkv, bT, D], per-layer global/local RoPE, sliding-window
+    validity over absolute positions (serve sequences are unpadded, so
+    the column index IS the position)."""
+    from mobilefinetuner_tpu.ops.decode_attention import (
+        paged_attention, paged_decode_attention, paged_eligible)
+    c = config
+    S, M = tbl.shape
+    NB, L, KV, bT, D = pool_k.shape
+    nq = c.num_attention_heads
+    G = nq // KV
+    eps = c.rms_norm_eps
+    scale = c.query_pre_attn_scalar ** -0.5
+    params = jax.tree.map(jnp.asarray, params)
+    lora_b = None if lora is None else lora.get("blocks")
+    cast = lambda t: (t.astype(compute_dtype)
+                      if jnp.issubdtype(t.dtype, jnp.floating) else t)
+    wb = jax.tree.map(cast, params["blocks"])
+    is_global = jnp.asarray([c.is_global_layer(i) for i in range(L)])
+    normalizer = jnp.asarray(c.hidden_size ** 0.5, compute_dtype)
+    use_pallas = attn_impl == "pallas" or (
+        attn_impl == "auto" and jax.default_backend() == "tpu"
+        and paged_eligible(KV, G, bT, D, pool_k.dtype.itemsize))
+    attend = paged_decode_attention if use_pallas else paged_attention
+
+    x = params["embed"][tok].astype(compute_dtype) * normalizer
+    cos_g, sin_g = rope_cos_sin(pos[:, None], D, c.rope_theta)
+    cos_l, sin_l = rope_cos_sin(pos[:, None], D, c.rope_local_base_freq)
+    cols = jnp.arange(M * bT, dtype=jnp.int32)
+    valid = cols[None, :] <= pos[:, None]                     # [S, M*bT]
+    win_ok = (pos[:, None] - cols[None, :]) < c.sliding_window
+    blk = tbl[jnp.arange(S), pos // bT]
+    off = pos % bT
+
+    def apply_lora(y, x_in, name, i):
+        entry = None if lora_b is None else lora_b.get(name)
+        return maybe_lora(y, x_in, entry, i)
+
+    def layer(inner, inp):
+        x, pk, pv = inner
+        bp, glob, i = inp
+        a = bp["attn"]
+        h = gemma3.rms_norm(x, bp["input_ln"], eps)
+        q = apply_lora(h @ a["q_w"], h, "q_proj", i).reshape(S, nq, D)
+        k = apply_lora(h @ a["k_w"], h, "k_proj", i).reshape(S, KV, D)
+        v = apply_lora(h @ a["v_w"], h, "v_proj", i).reshape(S, KV, D)
+        q = gemma3.rms_norm(q, a["q_norm"], eps)
+        k = gemma3.rms_norm(k, a["k_norm"], eps)
+        cos = jnp.where(glob, cos_g, cos_l)
+        sin = jnp.where(glob, sin_g, sin_l)
+        q = apply_rope(q[:, :, None, :], cos, sin)[:, :, 0]
+        k = apply_rope(k[:, :, None, :], cos, sin)[:, :, 0]
+        pk = pk.at[blk, i, :, off, :].set(k.astype(pk.dtype))
+        pv = pv.at[blk, i, :, off, :].set(v.astype(pv.dtype))
+        ok = jnp.where(glob, valid, valid & win_ok)           # [S, M*bT]
+        ctx = attend(q.reshape(S, KV, G, D), pk, pv, tbl, i, ok, scale)
+        ctx = ctx.reshape(S, nq * D).astype(compute_dtype)
+        attn_out = apply_lora(ctx @ a["o_w"], ctx, "o_proj", i)
+        attn_out = gemma3.rms_norm(attn_out, bp["post_attn_ln"], eps)
+        x = x + attn_out
+        h2 = gemma3.rms_norm(x, bp["pre_ffn_ln"], eps)
+        act = gemma3.gelu_tanh(
+            apply_lora(h2 @ bp["mlp"]["gate_w"], h2, "gate_proj", i)) \
+            * apply_lora(h2 @ bp["mlp"]["up_w"], h2, "up_proj", i)
+        down = apply_lora(act @ bp["mlp"]["down_w"], act, "down_proj", i)
+        down = gemma3.rms_norm(down, bp["post_ffn_ln"], eps)
+        return (x + down, pk, pv), None
+
+    (x, pool_k, pool_v), _ = jax.lax.scan(
+        layer, (x, pool_k, pool_v),
+        (wb, is_global, jnp.arange(L, dtype=jnp.int32)))
+    x = gemma3.rms_norm(x, params["final_norm"].astype(compute_dtype),
+                        eps)
+    logits = x @ params["embed"].astype(compute_dtype).T
+    return logits.astype(jnp.float32), pool_k, pool_v
+
+
 def left_pad(seqs, pad_id: int):
     """[[ids...], ...] -> (input_ids [B, P], attention_mask [B, P]) with
     LEFT padding (generation convention; cache writes share one column)."""

@@ -124,6 +124,161 @@ def _decode_kernel(q_ref, k_ref, v_ref, ok_ref, o_ref, *, scale, kvb):
             preferred_element_type=jnp.float32)           # [G, D] f32
 
 
+# --------------------------- block-paged variants ----------------------------
+#
+# The serving subsystem (serve/engine.py, DESIGN.md §16) replaces the
+# per-request contiguous [B, KV, T, D] cache with one shared block pool
+# [NB, L, KV, bT, D]: request r's logical column t lives at physical
+# block tbl[r, t // bT], offset t % bT. Two readers of that layout:
+#
+#   paged_attention       XLA oracle: gather the slot's pages into a
+#                         contiguous [S, M, KV, bT, D] view, then the
+#                         same masked-softmax einsums as xla_reference.
+#                         The gather MATERIALIZES the active cache once
+#                         per layer per step — correct everywhere (it is
+#                         what the CPU tests and the serve engine's
+#                         default path run), but it moves the cache
+#                         bytes twice.
+#   paged_decode_attention Pallas kernel: the block table rides in as a
+#                         scalar-prefetch operand, so each grid step
+#                         DMAs ONE physical page straight from the pool
+#                         (no materialized per-slot copy) and folds it
+#                         into an online-softmax accumulator. This is
+#                         the block-table-indexed upgrade of
+#                         _decode_kernel: same VMEM streaming story,
+#                         indirect page addressing instead of whole-T
+#                         blocks.
+
+
+def paged_attention(q, pool_k, pool_v, tbl, layer, ok, scale):
+    """Block-paged decode attention, XLA path (the kernel's oracle).
+
+    q       [S, KV, G, D]    current-token queries per slot
+    pool_k  [NB, L, KV, bT, D]  shared block pools (all layers)
+    pool_v  [NB, L, KV, bT, D]
+    tbl     [S, M] int32     per-slot block table (unused rows -> the
+                             reserved trash block 0; masked by ok)
+    layer   scalar int32     which layer's pages to read
+    ok      [S, M*bT] bool   attendable logical columns (occupancy AND
+                             any sliding window — caller composes)
+    -> ctx  [S, KV, G, D] float32
+    """
+    kc = pool_k[tbl, layer]                      # [S, M, KV, bT, D]
+    vc = pool_v[tbl, layer]
+    S, M, KV, bT, D = kc.shape
+    G = q.shape[2]
+    s = jnp.einsum("skgd,smktd->skgmt", q, kc,
+                   preferred_element_type=jnp.float32) * scale
+    s = s.reshape(S, KV, G, M * bT)
+    s = jnp.where(ok[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).reshape(S, KV, G, M, bT)
+    return jnp.einsum("skgmt,smktd->skgd", p.astype(vc.dtype), vc,
+                      preferred_element_type=jnp.float32)
+
+
+def paged_eligible(KV: int, G: int, bT: int, D: int,
+                   itemsize: int) -> bool:
+    """One page pair (K+V, double-buffered) + the per-slot q/ctx blocks
+    and [G, bT] score rows must fit VMEM; bT must be sublane-aligned."""
+    need = (4 * KV * bT * D * itemsize          # K+V page, double-buffered
+            + KV * G * D * (itemsize + 4)       # q block + f32 ctx block
+            + 3 * KV * G * max(D, bT) * 4)      # o/m/l accumulators + p
+    return bT % 8 == 0 and need <= _VMEM_BUDGET
+
+
+def _paged_kernel(tbl_ref, lyr_ref, q_ref, k_ref, v_ref, ok_ref, o_ref,
+                  o_acc, m_acc, l_acc, *, scale, kv):
+    """Grid (S, M): slot-major, pages inner — the accumulators carry one
+    slot's online softmax across its pages. A fully-masked page (e.g.
+    beyond a sliding window) contributes exactly zero: probabilities are
+    re-masked after the exp, so the NEG_INF-vs-NEG_INF cancellation in
+    `s - m` cannot resurrect dead columns."""
+    del tbl_ref, lyr_ref  # consumed by the index_maps, not the body
+    m = pl.program_id(1)
+
+    @pl.when(m == 0)
+    def _init():
+        o_acc[...] = jnp.zeros_like(o_acc)
+        m_acc[...] = jnp.full_like(m_acc, NEG_INF)
+        l_acc[...] = jnp.zeros_like(l_acc)
+
+    ok = ok_ref[0] > 0                                  # [bT] (lanes)
+    for j in range(kv):                                 # static unroll
+        k = k_ref[0, 0, j]                              # [bT, D] storage
+        v = v_ref[0, 0, j]
+        q = q_ref[0, j].astype(k.dtype)                 # [G, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [G, bT]
+        s = jnp.where(ok, s, NEG_INF)
+        m_prev = m_acc[j]                                # [G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(ok, jnp.exp(s - m_new), 0.0)       # [G, bT]
+        o_acc[j] = alpha * o_acc[j] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        l_acc[j] = alpha * l_acc[j] + jnp.sum(p, axis=-1, keepdims=True)
+        m_acc[j] = m_new
+
+    @pl.when(m == pl.num_programs(1) - 1)
+    def _finish():
+        # the current token's own column is always attendable, so l > 0
+        o_ref[0] = o_acc[...] / l_acc[...]
+
+
+def paged_decode_attention(q, pool_k, pool_v, tbl, layer, ok, scale):
+    """Pallas block-paged decode attention (shapes as paged_attention).
+    The block table and layer index are scalar-prefetch operands: each
+    (slot, page) grid step's index_map reads tbl to DMA the right
+    physical [bT, D] page out of the pool — the cache is read once, at
+    DMA rate, with no gathered per-slot copy. Caller must have checked
+    paged_eligible."""
+    S, KV, G, D = q.shape
+    NB, L, _, bT, _ = pool_k.shape
+    M = tbl.shape[1]
+    if q.dtype != pool_k.dtype:
+        raise ValueError(
+            f"paged_decode_attention requires q.dtype == pool dtype "
+            f"(got {q.dtype} vs {pool_k.dtype})")
+    if not paged_eligible(KV, G, bT, D, pool_k.dtype.itemsize):
+        raise ValueError(
+            f"paged_decode_attention ineligible for KV={KV}, G={G}, "
+            f"bT={bT}, D={D} (check paged_eligible before calling)")
+    kernel = functools.partial(_paged_kernel, scale=scale, kv=KV)
+    ok2 = ok.astype(jnp.int32).reshape(S, M * bT)
+    lyr = jnp.asarray(layer, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,        # tbl, layer
+        grid=(S, M),
+        in_specs=[
+            pl.BlockSpec((1, KV, G, D), lambda s, m, tbl, l: (s, 0, 0, 0)),
+            pl.BlockSpec((1, 1, KV, bT, D),
+                         lambda s, m, tbl, l: (tbl[s, m], l[0], 0, 0, 0)),
+            pl.BlockSpec((1, 1, KV, bT, D),
+                         lambda s, m, tbl, l: (tbl[s, m], l[0], 0, 0, 0)),
+            pl.BlockSpec((1, bT), lambda s, m, tbl, l: (s, m)),
+        ],
+        out_specs=pl.BlockSpec((1, KV, G, D),
+                               lambda s, m, tbl, l: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KV, G, D), jnp.float32),   # o accumulator
+            pltpu.VMEM((KV, G, 1), jnp.float32),   # running max
+            pltpu.VMEM((KV, G, 1), jnp.float32),   # running sum
+        ],
+    )
+    # no dimension_semantics here: the page dimension must stay
+    # sequential (the accumulators carry across it), which is the
+    # compiler's default for grid_spec-style calls
+    from mobilefinetuner_tpu.ops.pallas_util import interpret_mode
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, KV, G, D), jnp.float32),
+        interpret=interpret_mode(),
+    )(tbl.astype(jnp.int32), lyr, q, pool_k, pool_v, ok2)
+
+
 def decode_attention(q, k_cache, v_cache, ok, scale):
     """Fused decode attention over a whole KV cache (shapes above).
     Caller must have checked decode_eligible for these shapes."""

@@ -1,0 +1,17 @@
+"""Production decode service: continuous batching over static slots, a
+block-paged KV cache shared by every resident request, and multi-adapter
+hot-swap off one frozen base (DESIGN.md §16)."""
+
+from mobilefinetuner_tpu.serve.adapters import AdapterBank
+from mobilefinetuner_tpu.serve.engine import (Request, ServeConfig,
+                                              ServeEngine)
+from mobilefinetuner_tpu.serve.paged_kv import (TRASH_BLOCK, BlockAllocator,
+                                                OutOfBlocks, blocks_for,
+                                                init_pools,
+                                                write_prompt_blocks)
+
+__all__ = [
+    "AdapterBank", "BlockAllocator", "OutOfBlocks", "Request",
+    "ServeConfig", "ServeEngine", "TRASH_BLOCK", "blocks_for",
+    "init_pools", "write_prompt_blocks",
+]

@@ -1,0 +1,118 @@
+"""Resident adapter bank with hot-swap: k LoRA tenants, one base model.
+
+The decode step routes each slot to its adapter through the existing
+ids-gather (lora.stack_adapters layout: every A/B/scale leaf stacked
+along a leading [k] adapter axis, models/lora_apply.py `_multi_lora`).
+The bank makes that stack a MUTABLE resident set: loading a tenant's
+adapter from the safetensors store writes its factors into one bank
+slot (`leaf.at[slot].set(new)` under a single jitted updater whose slot
+index is traced), eviction zeroes the slot — shapes never change, so
+the compiled serving step is reused across every swap. That is the
+hot-swap contract: tenancy changes are DATA, not programs.
+
+All residents must share rank and target set (the stack_adapters
+constraint); a zeroed slot IS the base model (delta == 0), so empty
+capacity serves base-only traffic for free.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from mobilefinetuner_tpu.lora.lora import stack_adapters
+
+
+class AdapterBank:
+    """k resident adapter slots, stacked leaves [k, ...].
+
+    `template` fixes the structure every load must match (rank, targets,
+    layer count); the bank starts all-zero (= base model in every slot).
+    """
+
+    def __init__(self, template, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"bank capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        zero = jax.tree.map(jnp.zeros_like, template)
+        # one hidden slot past capacity stays permanently zero: the BASE
+        # route. Base-only requests carry aid=base_slot, so a banked
+        # engine serves them without burning a tenant slot (and without
+        # the id-0 trap of routing them to whichever tenant loaded
+        # first).
+        self.tree = stack_adapters([zero] * (capacity + 1))
+        self._template_shapes = [
+            (x.shape, x.dtype) for x in jax.tree.leaves(template)]
+        self._template_structure = jax.tree.structure(template)
+        self.names: List[Optional[str]] = [None] * capacity
+        self.trace_count = 0
+
+        def _swap(bank, new, i):
+            self.trace_count += 1  # trace-time only: compile counter
+            return jax.tree.map(
+                lambda b, n: b.at[i].set(n.astype(b.dtype)), bank, new)
+
+        self._swap = jax.jit(_swap)
+        self._zero_one = jax.tree.map(jnp.zeros_like, template)
+
+    # ------------------------------------------------------------ lookup ----
+    @property
+    def base_slot(self) -> int:
+        """The hidden all-zero slot (= base model) base-only rows route
+        to; never loadable or evictable."""
+        return self.capacity
+
+    @property
+    def resident(self) -> Dict[str, int]:
+        return {n: i for i, n in enumerate(self.names) if n is not None}
+
+    def slot(self, name: str) -> int:
+        for i, n in enumerate(self.names):
+            if n == name:
+                return i
+        raise KeyError(
+            f"adapter {name!r} not resident (loaded: "
+            f"{sorted(self.resident)}) — engine.load_adapter first")
+
+    # ------------------------------------------------------------ mutate ----
+    def _validate(self, tree) -> None:
+        if jax.tree.structure(tree) != self._template_structure:
+            raise ValueError(
+                "adapter structure does not match the bank template "
+                "(residents must share rank and target set)")
+        shapes = [(x.shape, x.dtype) for x in jax.tree.leaves(tree)]
+        for (ws, wd), (hs, _) in zip(self._template_shapes, shapes):
+            if ws != hs:
+                raise ValueError(
+                    f"adapter leaf shape {hs} does not match bank "
+                    f"template {ws} (rank mismatch?)")
+
+    def load(self, name: str, tree) -> int:
+        """Load/replace adapter `name` into a bank slot; returns the
+        slot. Same-name load overwrites in place (new adapter version);
+        otherwise the first free slot is taken. Raises OverflowError
+        when the bank is full — eviction policy belongs to the caller
+        (the engine knows which residents are referenced)."""
+        self._validate(tree)
+        if name in self.resident:
+            i = self.resident[name]
+        elif None in self.names:
+            i = self.names.index(None)
+        else:
+            raise OverflowError(
+                f"bank full ({self.capacity} residents: "
+                f"{sorted(self.resident)}) — evict one first")
+        self.tree = self._swap(self.tree, tree, jnp.int32(i))
+        self.names[i] = name
+        return i
+
+    def evict(self, name: str) -> int:
+        """Zero `name`'s slot and free it. Zeroing (not just unmapping)
+        means a stale routing id can only ever reach the base model,
+        never another tenant's weights."""
+        i = self.slot(name)
+        self.tree = self._swap(self.tree, self._zero_one, jnp.int32(i))
+        self.names[i] = None
+        return i

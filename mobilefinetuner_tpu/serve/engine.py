@@ -1,0 +1,470 @@
+"""Continuous-batching serve loop: static slots, paged KV, adapter routing.
+
+The scheduler's unit of work is one DECODE STEP over `num_slots` static
+batch slots. Requests are admitted into free slots (one prefill each)
+and evicted the step they finish — occupancy changes every step, but
+every array the compiled step sees keeps its shape:
+
+    tok [S]      the token each slot feeds this step
+    pos [S]      its cache position (tokens already cached)
+    tbl [S, M]   per-slot block tables into the shared page pool
+    aid [S]      per-slot adapter index into the resident bank
+
+Idle slots are not branches, they are DATA: pos=0, tbl=trash, and their
+outputs are ignored on the host. That is the compile-stability
+invariant the whole design serves — after warmup (one prefill trace +
+one step trace) admissions, evictions, and adapter hot-swaps reuse the
+same two executables (tests/test_serve.py asserts <= 2 traces after
+warmup; `trace_counts` is the observable).
+
+Decoding is greedy: per-request outputs are token-identical to
+batch-at-a-time generate() with the same adapter (the paged-vs-
+contiguous oracle) — deterministic outputs are what make a serving
+rollout auditable. Sampling belongs in a later round (per-slot rng
+state rides the same slot arrays).
+
+Scheduling policy is FCFS with conservative page reservation: a request
+is admitted only when its worst case (prompt + max_new_tokens pages)
+fits what the pool has left after every resident's own worst case.
+Pages are still handed out LAZILY (alloc at admission for the prompt,
+append on page-boundary crossings), so short/eos-early requests return
+their tail reservation without ever touching it; the reservation only
+guarantees `append` cannot fail mid-flight — there is no preemption
+path to need.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mobilefinetuner_tpu.core.telemetry import Telemetry, run_manifest
+from mobilefinetuner_tpu.lora.lora import assign_adapters
+from mobilefinetuner_tpu.models.generate import (gemma3_decode_step_paged,
+                                                 gemma3_prefill,
+                                                 gpt2_decode_step_paged,
+                                                 gpt2_prefill)
+from mobilefinetuner_tpu.serve.adapters import AdapterBank
+from mobilefinetuner_tpu.serve.paged_kv import (TRASH_BLOCK, BlockAllocator,
+                                                OutOfBlocks, blocks_for,
+                                                init_pools,
+                                                write_prompt_blocks)
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Engine shape knobs — all STATIC: together they fix the compiled
+    prefill/step programs and the pool's HBM footprint."""
+    num_slots: int = 8        # concurrent requests per decode step
+    block_T: int = 16         # tokens per KV page (sublane-aligned)
+    num_blocks: int = 512     # pool pages incl. the reserved trash page
+    max_prompt: int = 64      # prompts right-padded to this (block_T mult)
+    max_new_tokens: int = 64  # per-request generation cap
+    dtype: str = "float32"    # compute + cache dtype
+    attn_impl: str = "auto"   # auto | xla | pallas (paged attention path)
+
+    def validate(self) -> None:
+        if self.max_prompt % self.block_T:
+            raise ValueError(
+                f"max_prompt ({self.max_prompt}) must be a multiple of "
+                f"block_T ({self.block_T})")
+        if self.num_slots < 1 or self.max_new_tokens < 1:
+            raise ValueError("num_slots and max_new_tokens must be >= 1")
+        # the pool must hold at least one worst-case request, or FCFS
+        # admission can never fire and drain() spins forever
+        worst = blocks_for(self.max_prompt + self.max_new_tokens - 1,
+                           self.block_T)
+        if self.num_blocks - 1 < worst:
+            raise ValueError(
+                f"num_blocks={self.num_blocks} cannot hold one "
+                f"worst-case request: max_prompt + max_new_tokens - 1 "
+                f"columns need {worst} pages plus the reserved trash "
+                f"page (have {self.num_blocks - 1} allocatable)")
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its telemetry timeline."""
+    id: int
+    prompt: List[int]
+    max_new_tokens: int
+    adapter: Optional[str] = None      # resident bank name; None = base
+    # lifecycle: queued -> active -> finished | cancelled
+    state: str = "queued"
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    enqueue_t: float = 0.0
+    admit_t: float = 0.0
+    first_token_t: float = 0.0
+    finish_t: float = 0.0
+    # engine-internal
+    slot: int = -1
+    aid: int = 0
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    worst_blocks: int = 0
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if not self.first_token_t:
+            return None
+        return (self.first_token_t - self.enqueue_t) * 1000.0
+
+    @property
+    def tpot_ms(self) -> Optional[float]:
+        """Mean per-token latency AFTER the first token (the streaming
+        cadence a client sees)."""
+        if not self.finish_t or len(self.tokens) < 2:
+            return None
+        return ((self.finish_t - self.first_token_t)
+                / (len(self.tokens) - 1) * 1000.0)
+
+
+class ServeEngine:
+    """The serving loop. Drive it with submit() + step() (or drain());
+    close() terminates the telemetry stream.
+
+    family: "gpt2" | "gemma"; params: the frozen base tree;
+    bank: optional AdapterBank for multi-tenant routing;
+    telemetry: optional core.telemetry.Telemetry (emits run_start /
+    per-request `request` events / run_end).
+    """
+
+    def __init__(self, family: str, config, params,
+                 cfg: Optional[ServeConfig] = None,
+                 bank: Optional[AdapterBank] = None,
+                 telemetry: Optional[Telemetry] = None,
+                 eos_id: Optional[int] = None, pad_id: int = 0):
+        cfg = cfg or ServeConfig()
+        cfg.validate()
+        if family == "gpt2":
+            L, KV, D = config.n_layer, config.n_head, config.head_dim
+            if cfg.max_prompt + cfg.max_new_tokens > config.n_positions:
+                raise ValueError(
+                    f"max_prompt + max_new_tokens = "
+                    f"{cfg.max_prompt + cfg.max_new_tokens} exceeds "
+                    f"n_positions={config.n_positions}")
+            self._prefill_fn, self._step_fn = gpt2_prefill, \
+                gpt2_decode_step_paged
+        elif family == "gemma":
+            L = config.num_hidden_layers
+            KV, D = config.num_key_value_heads, config.head_dim
+            self._prefill_fn, self._step_fn = gemma3_prefill, \
+                gemma3_decode_step_paged
+        else:
+            raise ValueError(f"unknown family {family!r}")
+        self.family, self.config, self.cfg = family, config, cfg
+        self.params = jax.tree.map(jnp.asarray, params)
+        self.bank = bank
+        self.eos_id, self.pad_id = eos_id, pad_id
+        self.dtype = jnp.dtype(cfg.dtype)
+
+        S = cfg.num_slots
+        self.M = blocks_for(cfg.max_prompt + cfg.max_new_tokens - 1,
+                            cfg.block_T)
+        self.alloc = BlockAllocator(cfg.num_blocks)
+        self.pool_k, self.pool_v = init_pools(
+            cfg.num_blocks, L, KV, cfg.block_T, D, self.dtype)
+        self._tok = np.zeros(S, np.int32)
+        self._pos = np.zeros(S, np.int32)
+        self._tbl = np.full((S, self.M), TRASH_BLOCK, np.int32)
+        self._aid = np.zeros(S, np.int32)
+        self._slots: List[Optional[Request]] = [None] * S
+        self.queue: collections.deque = collections.deque()
+        self.decode_steps = 0
+        self._next_id = 0
+        self._t0 = time.perf_counter()
+
+        # --- the two compiled programs (+ the prompt-page writer) ----------
+        # trace_counts is the compile-stability observable: the wrapped
+        # python bodies run ONLY when jax (re)traces, so the counters
+        # count executables, not calls.
+        self.trace_counts: collections.Counter = collections.Counter()
+        dt, impl = self.dtype, cfg.attn_impl
+        prefill_raw, step_raw = self._prefill_fn, self._step_fn
+        conf = config
+
+        def prefill_py(params, bank_tree, ids, mask, aid):
+            self.trace_counts["prefill"] += 1
+            lora = self._route(bank_tree, aid)
+            logits, (pk, pv) = prefill_raw(conf, params, ids, mask,
+                                           compute_dtype=dt, lora=lora)
+            tok0 = jnp.argmax(logits[0], -1).astype(jnp.int32)
+            return tok0, pk[:, 0], pv[:, 0]
+
+        def step_py(params, bank_tree, pool_k, pool_v, tok, pos, tbl, aid):
+            self.trace_counts["decode_step"] += 1
+            lora = self._route(bank_tree, aid)
+            logits, pk, pv = step_raw(conf, params, pool_k, pool_v, tok,
+                                      pos, tbl, lora=lora,
+                                      compute_dtype=dt, attn_impl=impl)
+            return jnp.argmax(logits, -1).astype(jnp.int32), pk, pv
+
+        def write_py(pool_k, pool_v, k, v, block_ids):
+            self.trace_counts["write_prefill"] += 1
+            return write_prompt_blocks(pool_k, pool_v, k, v, block_ids)
+
+        # donating the pools lets XLA scatter in place (the cache never
+        # has two copies); CPU ignores donation, so skip the warning
+        donate = jax.default_backend() != "cpu"
+        self._prefill = jax.jit(prefill_py)
+        self._step = jax.jit(step_py,
+                             donate_argnums=(2, 3) if donate else ())
+        self._write = jax.jit(write_py,
+                              donate_argnums=(0, 1) if donate else ())
+
+        self.telemetry = telemetry or Telemetry("")
+        self.telemetry.emit("run_start", **run_manifest({
+            "serve_family": family, "num_slots": S,
+            "block_T": cfg.block_T, "num_blocks": cfg.num_blocks,
+            "max_prompt": cfg.max_prompt,
+            "max_new_tokens": cfg.max_new_tokens, "dtype": cfg.dtype,
+            "adapter_slots": bank.capacity if bank else 0}))
+
+    # ------------------------------------------------------------ helpers ---
+    @staticmethod
+    def _route(bank_tree, aid):
+        """Bank slots -> per-row lora tree (the ids-gather routing)."""
+        if bank_tree is None:
+            return None
+        return assign_adapters(bank_tree, aid)
+
+    @property
+    def active(self) -> List[Request]:
+        return [r for r in self._slots if r is not None]
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and not self.active
+
+    def total_traces(self) -> int:
+        return sum(self.trace_counts.values()) + (
+            self.bank.trace_count if self.bank else 0)
+
+    def _committed_blocks(self) -> int:
+        """Pages the residents may still demand (their reservations)."""
+        return sum(r.worst_blocks - len(r.blocks) for r in self.active)
+
+    def _emit_request(self, req: Request, phase: str) -> None:
+        self.telemetry.emit(
+            "request", id=req.id, phase=phase,
+            prompt_tokens=len(req.prompt),
+            adapter=req.aid if req.adapter is not None else None,
+            queue_ms=((req.admit_t - req.enqueue_t) * 1000.0
+                      if req.admit_t else None),
+            new_tokens=len(req.tokens) or None,
+            ttft_ms=req.ttft_ms, tpot_ms=req.tpot_ms)
+
+    # ------------------------------------------------------------ tenancy ---
+    def load_adapter(self, name: str, source) -> int:
+        """Hot-swap `source` (native adapter safetensors path, or an
+        already-loaded lora tree) into the resident bank under `name`.
+        Replacing a resident that active/queued requests still route to
+        is refused — finish or cancel them first."""
+        if self.bank is None:
+            raise RuntimeError("engine was built without an adapter bank")
+        if name in self.bank.resident and self._adapter_in_use(name):
+            raise RuntimeError(
+                f"adapter {name!r} is routed by in-flight requests; "
+                f"drain them before replacing it")
+        tree = source
+        if not isinstance(source, dict):
+            from mobilefinetuner_tpu.lora import peft_io
+            tree, _ = peft_io.load_adapter(source)
+        return self.bank.load(name, tree)
+
+    def evict_adapter(self, name: str) -> int:
+        if self.bank is None:
+            raise RuntimeError("engine was built without an adapter bank")
+        if self._adapter_in_use(name):
+            raise RuntimeError(
+                f"adapter {name!r} is routed by in-flight requests")
+        return self.bank.evict(name)
+
+    def _adapter_in_use(self, name: str) -> bool:
+        return any(r.adapter == name
+                   for r in list(self.queue) + self.active)
+
+    # ------------------------------------------------------------ intake ----
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 0,
+               adapter: Optional[str] = None) -> Request:
+        """Enqueue one request (admission happens inside step())."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.cfg.max_prompt:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the engine's "
+                f"max_prompt={self.cfg.max_prompt}")
+        n_new = max_new_tokens or self.cfg.max_new_tokens
+        if not 0 < n_new <= self.cfg.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens {n_new} outside (0, "
+                f"{self.cfg.max_new_tokens}]")
+        aid = 0
+        if adapter is not None:
+            if self.bank is None:
+                raise RuntimeError(
+                    "request names an adapter but the engine has no bank")
+            # resolve the slot NOW (raises KeyError if not resident) so
+            # the enqueue/cancel events report the right tenant; the
+            # slot cannot move while queued (in-use residents refuse
+            # replacement and eviction)
+            aid = self.bank.slot(adapter)
+        req = Request(id=self._next_id, prompt=prompt,
+                      max_new_tokens=n_new, adapter=adapter, aid=aid,
+                      enqueue_t=time.perf_counter())
+        self._next_id += 1
+        self.queue.append(req)
+        self._emit_request(req, "enqueue")
+        return req
+
+    def cancel(self, req: Request) -> None:
+        """Evict a queued or active request (frees its slot + pages)."""
+        if req.state == "queued":
+            self.queue.remove(req)
+        elif req.state == "active":
+            self._release(req)
+        else:
+            return
+        req.state = "cancelled"
+        req.finish_t = time.perf_counter()
+        self._emit_request(req, "cancel")
+
+    # ------------------------------------------------------------ the loop --
+    def _admit(self, req: Request, slot: int) -> None:
+        cfg = self.cfg
+        P = len(req.prompt)
+        req.worst_blocks = blocks_for(P + req.max_new_tokens - 1,
+                                      cfg.block_T)
+        req.blocks = self.alloc.alloc(blocks_for(P, cfg.block_T))
+        req.slot, req.state = slot, "active"
+        if self.bank is None:
+            req.aid = 0
+        elif req.adapter is not None:
+            req.aid = self.bank.slot(req.adapter)
+        else:
+            req.aid = self.bank.base_slot  # zero slot: serve the base
+        self._slots[slot] = req
+
+        ids = np.full((1, cfg.max_prompt), self.pad_id, np.int32)
+        mask = np.zeros((1, cfg.max_prompt), np.int32)
+        ids[0, :P], mask[0, :P] = req.prompt, 1
+        bank_tree = self.bank.tree if self.bank else None
+        tok0, k, v = self._prefill(self.params, bank_tree,
+                                   jnp.asarray(ids), jnp.asarray(mask),
+                                   jnp.asarray([req.aid], jnp.int32))
+        # scatter the prompt pages; table rows past the prompt stay trash
+        block_ids = np.full(cfg.max_prompt // cfg.block_T, TRASH_BLOCK,
+                            np.int32)
+        block_ids[:len(req.blocks)] = req.blocks
+        self.pool_k, self.pool_v = self._write(
+            self.pool_k, self.pool_v, k, v, jnp.asarray(block_ids))
+        tok0 = int(tok0)                 # host sync: the first token
+        now = time.perf_counter()
+        req.admit_t = req.first_token_t = now
+        req.tokens.append(tok0)
+        self._tok[slot], self._pos[slot] = tok0, P
+        self._tbl[slot] = TRASH_BLOCK
+        self._tbl[slot, :len(req.blocks)] = req.blocks
+        self._aid[slot] = req.aid
+        self._emit_request(req, "admit")
+        self._emit_request(req, "first_token")
+        if (self.eos_id is not None and tok0 == self.eos_id) \
+                or req.max_new_tokens == 1:
+            self._finish(req)
+
+    def _release(self, req: Request) -> None:
+        s = req.slot
+        self.alloc.free(req.blocks)
+        req.blocks = []
+        self._slots[s] = None
+        self._tok[s] = self._pos[s] = self._aid[s] = 0
+        self._tbl[s] = TRASH_BLOCK
+
+    def _finish(self, req: Request) -> None:
+        req.state = "finished"
+        req.finish_t = time.perf_counter()
+        self._release(req)
+        self._emit_request(req, "finish")
+
+    def step(self) -> List[Request]:
+        """One scheduler iteration: admit what fits, then one decode
+        step for every active slot. Returns the requests that finished
+        on this iteration."""
+        cfg = self.cfg
+        finished: List[Request] = []
+        # FCFS admission under the worst-case page reservation
+        while self.queue:
+            free = [i for i, r in enumerate(self._slots) if r is None]
+            if not free:
+                break
+            req = self.queue[0]
+            worst = blocks_for(len(req.prompt) + req.max_new_tokens - 1,
+                               cfg.block_T)
+            if self.alloc.free_blocks - self._committed_blocks() < worst:
+                break
+            self.queue.popleft()
+            self._admit(req, free[0])
+            if req.state == "finished":  # eos/cap hit on the first token
+                finished.append(req)
+
+        live = self.active
+        if not live:
+            return finished
+        # a slot crossing a page boundary this step takes its next page
+        # (guaranteed by the admission reservation)
+        for req in live:
+            j = int(self._pos[req.slot]) // cfg.block_T
+            if j == len(req.blocks):
+                try:
+                    req.blocks.append(self.alloc.append())
+                except OutOfBlocks as e:  # pragma: no cover — invariant
+                    raise OutOfBlocks(
+                        f"reservation accounting failed for request "
+                        f"{req.id}: {e}") from e
+                self._tbl[req.slot, j] = req.blocks[-1]
+
+        bank_tree = self.bank.tree if self.bank else None
+        nxt, self.pool_k, self.pool_v = self._step(
+            self.params, bank_tree, self.pool_k, self.pool_v,
+            jnp.asarray(self._tok), jnp.asarray(self._pos),
+            jnp.asarray(self._tbl), jnp.asarray(self._aid))
+        nxt = np.asarray(nxt)            # host sync: this step's tokens
+        self.decode_steps += 1
+        for req in live:
+            s = req.slot
+            self._pos[s] += 1
+            self._tok[s] = int(nxt[s])
+            req.tokens.append(int(nxt[s]))
+            if (self.eos_id is not None and req.tokens[-1] == self.eos_id) \
+                    or len(req.tokens) >= req.max_new_tokens:
+                self._finish(req)
+                finished.append(req)
+        return finished
+
+    def drain(self) -> List[Request]:
+        """step() until queue and slots are empty; returns everything
+        finished along the way, submission order."""
+        done: List[Request] = []
+        while not self.idle:
+            done.extend(self.step())
+        return sorted(done, key=lambda r: r.id)
+
+    # ------------------------------------------------------------ teardown --
+    def close(self, exit: str = "ok") -> None:
+        self.telemetry.emit(
+            "run_end", steps=self.decode_steps,
+            wall_s=time.perf_counter() - self._t0, exit=exit,
+            goodput=None)
+        self.telemetry.close()
+
+    def __enter__(self) -> "ServeEngine":
+        return self
+
+    def __exit__(self, exc_type, *_) -> None:
+        self.close("ok" if exc_type is None else exc_type.__name__)

@@ -1,0 +1,111 @@
+"""Block-paged KV cache: one preallocated pool, a host-side allocator.
+
+The generate() path gives every request a contiguous [L, B, KV, T, D]
+cache sized for its worst case — at serving batch sizes that fragments
+HBM (a 2-token health-check ping reserves as much cache as a 2k-token
+completion). Here the cache is ONE pool of fixed-size pages,
+
+    pool_k / pool_v : [num_blocks, L, KV, block_T, D]
+
+and request r's logical column t lives at physical page
+`tbl[r, t // block_T]`, offset `t % block_T` — the vLLM PagedAttention
+layout, TPU-shaped: block_T is sublane-aligned so a page is a clean
+[bT, D] tile, and every page holds ALL layers' K/V for its span (one
+allocator decision covers L scatters).
+
+The allocator is deliberately host-side and trivial (a free list over
+ints): allocation happens at most once per admitted request plus once
+per block_T generated tokens, never inside the compiled step. Block 0
+is reserved as the TRASH page: idle slots' writes and padded
+block-table rows land there, so the device program needs no branches —
+occupancy is expressed entirely through indices and masks.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+# physical page 0 is never allocated: idle slots write their garbage
+# K/V there and padded block-table rows point at it (always masked)
+TRASH_BLOCK = 0
+
+
+class OutOfBlocks(RuntimeError):
+    """The pool cannot hold another request (admission-time signal; the
+    engine's reservation accounting makes mid-flight exhaustion a bug,
+    not an operational state)."""
+
+
+def blocks_for(tokens: int, block_T: int) -> int:
+    """Pages needed to cache `tokens` columns."""
+    return max(0, -(-int(tokens) // block_T))
+
+
+def init_pools(num_blocks: int, L: int, KV: int, block_T: int, D: int,
+               dtype=jnp.float32):
+    """The two device pools, zero-filled (the trash page must hold
+    finite values: idle slots attend their own zero column)."""
+    shape = (num_blocks, L, KV, block_T, D)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def write_prompt_blocks(pool_k, pool_v, k, v, block_ids):
+    """Scatter one prefilled request's K/V into its allocated pages.
+
+    k/v: [L, KV, Ppad, D] from *_prefill (B squeezed), Ppad a block_T
+    multiple; block_ids: [Ppad // block_T] physical pages, TRASH-padded
+    past the prompt's real pages (their garbage columns are never
+    attendable). Pure — the engine jits this with the pools donated.
+    """
+    NB, L, KV, bT, D = pool_k.shape
+    M = k.shape[2] // bT
+    # [L, KV, M, bT, D] -> [M, L, KV, bT, D]: one row per physical page
+    pages = lambda t: t.reshape(L, KV, M, bT, D).transpose(2, 0, 1, 3, 4)
+    pool_k = pool_k.at[block_ids].set(pages(k).astype(pool_k.dtype))
+    pool_v = pool_v.at[block_ids].set(pages(v).astype(pool_v.dtype))
+    return pool_k, pool_v
+
+
+class BlockAllocator:
+    """Free-list allocator over the pool's pages (block 0 reserved).
+
+    alloc/append/free are the request lifecycle: `alloc(n)` takes the
+    prompt's pages at admission, `append()` one more page when decode
+    crosses a page boundary, `free(ids)` returns everything when the
+    request finishes (or is cancelled). LIFO reuse keeps recently-hot
+    pages recently-reused.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (1 data + reserved trash block "
+                f"{TRASH_BLOCK}), got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise OutOfBlocks(
+                f"asked for {n} pages, {len(self._free)} free "
+                f"(pool has {self.num_blocks - 1} allocatable)")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def append(self) -> int:
+        return self.alloc(1)[0]
+
+    def free(self, ids) -> None:
+        for b in ids:
+            b = int(b)
+            if b == TRASH_BLOCK:
+                raise ValueError("freeing the reserved trash block")
+            if b in self._free or not 0 < b < self.num_blocks:
+                raise ValueError(f"double/invalid free of block {b}")
+            self._free.append(b)

@@ -105,6 +105,53 @@ def test_failed_headline_reports_zero_and_exits_nonzero(bench,
     assert "error" in suite["suite"][0]
 
 
+def test_bench_decode_row_contract():
+    """tools/bench_decode.py rows (round 11): TPOT (= the marginal
+    ms/token the tool always measured), TTFT (max_new_tokens=1 e2e
+    wall), and the --adapters k stacked-bank mode — schema pinned on the
+    tiny CPU config, base vs k=2 both."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import jax.numpy as jnp
+    import bench_decode as bd
+    for k in (0, 2):
+        row = bd.bench_model(False, B=2, P=8, dtype=jnp.float32,
+                             pipeline=1, adapters=k, tiny=True,
+                             n_pair=(2, 4))
+        assert row["adapters"] == k
+        assert row["config"].endswith("_k2") == (k == 2)
+        for key in ("ttft_ms", "sustained_tok_s", "wall_ms_lo",
+                    "wall_ms_hi"):
+            assert isinstance(row[key], (int, float)) and row[key] > 0, key
+        assert isinstance(row["tpot_ms"], (int, float))  # marginal: may
+        # jitter near 0 on CPU at tiny sizes, but must be present/finite
+        assert row["wall_ms_hi"] >= row["wall_ms_lo"] * 0.5
+
+
+def test_serve_bench_row_contract(tmp_path):
+    """tools/serve_bench.py rows: the BENCH_SERVE schema the round
+    scoring reads — offered vs sustained req/s, TTFT/TPOT percentiles,
+    resident-adapter count, and the compile-stability counter."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import serve_bench as sb
+    rows = sb.run_rows("tiny-gpt2", [100.0], n_requests=4, adapters=2,
+                       num_slots=2, block_T=8, num_blocks=32,
+                       max_prompt=16, max_new=4, dtype="float32",
+                       seed=0, prompt_lo=2)
+    (row,) = rows
+    assert row["requests"] == 4 and row["adapters_resident"] == 2
+    assert row["req_s"] > 0 and row["gen_tok_s"] > 0
+    for p in ("p50", "p95", "p99"):
+        assert row["ttft_ms"][p] > 0
+        assert row["tpot_ms"][p] > 0
+    assert row["new_traces_after_warmup"] == 0
+    assert set(row["traces"]) == {"prefill", "write_prefill",
+                                  "decode_step"}
+
+
 def test_bench_checkpoint_rows_contract(tmp_path):
     """tools/bench_checkpoint.py (round 10): each row self-certifies the
     async-save claim it rides on — sync oracle stall vs async blocking

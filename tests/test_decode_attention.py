@@ -15,6 +15,9 @@ import pytest
 
 from mobilefinetuner_tpu.ops.decode_attention import (decode_attention,
                                                       decode_eligible,
+                                                      paged_attention,
+                                                      paged_decode_attention,
+                                                      paged_eligible,
                                                       pick_kvb,
                                                       xla_reference)
 
@@ -83,6 +86,87 @@ def test_eligibility_gates():
     # a long-cache shape falls back to fewer kv heads per program
     kvb = pick_kvb(12, 8192, 64, 4)
     assert kvb is not None and kvb < 12 and 12 % kvb == 0
+
+
+# --------------------------- block-paged variants ----------------------------
+
+def make_paged(S, KV, G, D, bT, M, NB, L, dtype, seed=0):
+    rng = np.random.default_rng(seed)
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(kq, (S, KV, G, D), dtype)
+    pool_k = jax.random.normal(kk, (NB, L, KV, bT, D), dtype)
+    pool_v = jax.random.normal(kv, (NB, L, KV, bT, D), dtype)
+    # block tables over non-trash pages; ragged per-slot lengths, plus a
+    # sliding-window hole on slot 0 so FULLY-masked pages occur
+    tbl = jnp.asarray(rng.integers(1, NB, (S, M)), jnp.int32)
+    lens = rng.integers(1, M * bT + 1, S)
+    cols = np.arange(M * bT)
+    ok = cols[None, :] < lens[:, None]
+    ok[0, :max(int(lens[0]) - 3, 0)] = False       # window: only last 3
+    return q, pool_k, pool_v, tbl, jnp.asarray(ok)
+
+
+@pytest.mark.parametrize("shape,dtype", [
+    ((3, 12, 1, 64, 8, 4, 9, 2), jnp.float32),    # GPT-2 head layout
+    ((3, 12, 1, 64, 8, 4, 9, 2), jnp.bfloat16),
+    ((2, 1, 4, 64, 16, 3, 7, 3), jnp.float32),    # Gemma GQA layout
+    ((4, 2, 2, 32, 8, 5, 11, 2), jnp.bfloat16),
+])
+def test_paged_matches_gathered_contiguous(shape, dtype):
+    """paged_attention == xla_reference over the gathered contiguous
+    cache (the paged read is pure indexing, not new math), and the
+    pallas paged kernel == paged_attention — both for every layer index,
+    under ragged lengths and fully-masked window pages."""
+    S, KV, G, D, bT, M, NB, L = shape
+    q, pk, pv, tbl, ok = make_paged(S, KV, G, D, bT, M, NB, L, dtype)
+    scale = D ** -0.5
+    assert paged_eligible(KV, G, bT, D, jnp.dtype(dtype).itemsize)
+    for layer in range(L):
+        got = paged_attention(q, pk, pv, tbl, layer, ok, scale)
+        kc = pk[tbl, layer].transpose(0, 2, 1, 3, 4) \
+            .reshape(S, KV, M * bT, D)
+        vc = pv[tbl, layer].transpose(0, 2, 1, 3, 4) \
+            .reshape(S, KV, M * bT, D)
+        want = xla_reference(q, kc, vc, ok, scale)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-6, rtol=1e-6)
+        kern = paged_decode_attention(q, pk, pv, tbl, layer, ok, scale)
+        assert kern.dtype == jnp.float32
+        tol = 2e-2 if dtype == jnp.bfloat16 else 1e-5
+        np.testing.assert_allclose(np.asarray(kern), np.asarray(got),
+                                   atol=tol, rtol=tol)
+
+
+def test_paged_trash_pages_never_leak():
+    """Columns routed to the trash page (idle padding in a block table)
+    must contribute nothing even when the trash page holds garbage."""
+    S, KV, G, D, bT, M, NB, L = 2, 2, 1, 16, 8, 3, 6, 1
+    q, pk, pv, tbl, _ = make_paged(S, KV, G, D, bT, M, NB, L, jnp.float32)
+    tbl = tbl.at[:, 2].set(0)                     # last page -> trash
+    ok = jnp.asarray(np.arange(M * bT)[None, :] < 2 * bT)
+    ok = jnp.broadcast_to(ok, (S, M * bT))
+    base = paged_attention(q, pk, pv, tbl, 0, ok, D ** -0.5)
+    poisoned_k = pk.at[0].set(1e6)
+    poisoned_v = pv.at[0].set(-1e6)
+    got = paged_attention(q, poisoned_k, poisoned_v, tbl, 0, ok,
+                          D ** -0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(base),
+                               atol=1e-6)
+    kern = paged_decode_attention(q, poisoned_k, poisoned_v, tbl, 0, ok,
+                                  D ** -0.5)
+    np.testing.assert_allclose(np.asarray(kern), np.asarray(base),
+                               atol=1e-5)
+
+
+def test_paged_validation():
+    S, KV, G, D, bT, M, NB, L = 2, 2, 1, 16, 8, 2, 5, 1
+    q, pk, pv, tbl, ok = make_paged(S, KV, G, D, bT, M, NB, L,
+                                    jnp.float32)
+    with pytest.raises(ValueError, match="dtype"):
+        paged_decode_attention(q.astype(jnp.bfloat16), pk, pv, tbl, 0,
+                               ok, 1.0)
+    assert not paged_eligible(KV, G, bT=12, D=D, itemsize=4)  # misaligned
+    assert not paged_eligible(KV=1, G=4, bT=512, D=4096, itemsize=4)
 
 
 def test_vmem_gate_charges_gqa_terms():

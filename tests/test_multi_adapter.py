@@ -40,6 +40,29 @@ def make_adapters(init_fn, config, n=3, targets=None):
             for i in range(n)]
 
 
+def test_assign_adapters_rejects_out_of_range_ids():
+    """A jnp gather CLAMPS out-of-range indices, so before this check an
+    id typo silently served the LAST adapter's weights to the
+    overflowing rows — assign_adapters must instead raise a named
+    ValueError for concrete ids outside the stacked bank."""
+    stacked = stack_adapters(make_adapters(init_lora_gpt2, GPT2_CFG, n=2))
+    with pytest.raises(ValueError, match=r"out of range.*2 adapter"):
+        assign_adapters(stacked, [0, 2, 1])
+    with pytest.raises(ValueError, match="out of range"):
+        assign_adapters(stacked, [-1, 0])
+    # in-range ids (incl. numpy arrays) pass through untouched
+    out = assign_adapters(stacked, np.asarray([1, 0]))
+    assert out["blocks"]["attn_qkv"]["ids"].tolist() == [1, 0]
+    # traced ids (the serve engine routes inside jit) skip the check
+    import jax as jax_mod
+
+    @jax_mod.jit
+    def route(ids):
+        return assign_adapters(stacked, ids)["blocks"]["attn_qkv"]["ids"]
+
+    assert route(jnp.asarray([0, 1])).tolist() == [0, 1]
+
+
 def test_stack_adapters_validates_structure():
     a = make_adapters(init_lora_gpt2, GPT2_CFG, n=2)
     stacked = stack_adapters(a)

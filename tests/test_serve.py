@@ -1,0 +1,431 @@
+"""Serving subsystem tests (serve/, DESIGN.md §16).
+
+The correctness anchor is the ACCEPTANCE ORACLE: for any request set,
+the continuous-batching loop's per-request greedy outputs — paged KV
+pool, static slots, per-slot adapter routing — must be token-identical
+to batch-at-a-time generate() with the same adapter per row (contiguous
+cache). And the COMPILE-STABILITY invariant: after warmup the engine
+holds exactly one prefill + one decode-step executable, reused across
+every admission, eviction, and adapter hot-swap (<= 2 new traces
+allowed, 0 expected)."""
+
+import dataclasses
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from mobilefinetuner_tpu.core.config import GPT2Config, Gemma3TextConfig
+from mobilefinetuner_tpu.core.telemetry import Telemetry, validate_event
+from mobilefinetuner_tpu.lora.lora import LoRASpec, init_lora_gemma3
+from mobilefinetuner_tpu.models import gemma3, gpt2
+from mobilefinetuner_tpu.models.generate import (SampleConfig,
+                                                 gemma3_generate,
+                                                 gpt2_generate)
+from mobilefinetuner_tpu.serve import (AdapterBank, BlockAllocator,
+                                       OutOfBlocks, ServeConfig,
+                                       ServeEngine, TRASH_BLOCK,
+                                       blocks_for)
+
+GPT2_CFG = dataclasses.replace(
+    GPT2Config.tiny(vocab_size=211), n_embd=64, n_head=4, n_positions=64,
+    n_layer=3, embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0)
+# sliding_window (6) < prompt+gen so local layers actually truncate
+GEMMA_CFG = dataclasses.replace(
+    Gemma3TextConfig.tiny(vocab_size=199), hidden_size=48, head_dim=12,
+    num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+    num_hidden_layers=4, sliding_window=6, sliding_window_pattern=3)
+
+
+@pytest.fixture(scope="module")
+def gpt2_params():
+    return gpt2.init_params(GPT2_CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def gemma_params():
+    return gemma3.init_params(GEMMA_CFG, jax.random.PRNGKey(1))
+
+
+def oracle(family, params, req, lora=None, eos_id=None):
+    """Batch-at-a-time generate() with a CONTIGUOUS cache, truncated the
+    way the serve loop reports (eos inclusive)."""
+    gen = gpt2_generate if family == "gpt2" else gemma3_generate
+    config = GPT2_CFG if family == "gpt2" else GEMMA_CFG
+    ids = jnp.asarray([req.prompt], jnp.int32)
+    cfg = SampleConfig(max_new_tokens=req.max_new_tokens, greedy=True,
+                       eos_id=eos_id, pad_id=0)
+    row = np.asarray(gen(config, params, ids, jnp.ones_like(ids), cfg,
+                         lora=lora))[0].tolist()
+    if eos_id is not None and eos_id in row:
+        row = row[:row.index(eos_id) + 1]
+    return row
+
+
+def rand_lora(seed, scale=0.05):
+    lora = init_lora_gemma3(GEMMA_CFG, LoRASpec(rank=3, alpha=6.0),
+                            jax.random.PRNGKey(seed))
+    leaves, td = jax.tree.flatten(lora)
+    keys = jax.random.split(jax.random.PRNGKey(seed + 50), len(leaves))
+    return jax.tree.unflatten(td, [
+        l if l.ndim == 0 else scale * jax.random.normal(k, l.shape)
+        for l, k in zip(leaves, keys)])
+
+
+# --------------------------- allocator + config ------------------------------
+
+def test_block_allocator_lifecycle():
+    a = BlockAllocator(8)                 # 7 allocatable, 0 reserved
+    assert a.free_blocks == 7
+    got = a.alloc(3)
+    assert len(set(got)) == 3 and TRASH_BLOCK not in got
+    b = a.append()
+    assert b not in got and a.free_blocks == 3
+    with pytest.raises(OutOfBlocks):
+        a.alloc(4)
+    a.free(got)
+    assert a.free_blocks == 6
+    with pytest.raises(ValueError):
+        a.free(got[:1])                   # double free
+    with pytest.raises(ValueError):
+        a.free([TRASH_BLOCK])
+    with pytest.raises(ValueError):
+        BlockAllocator(1)
+    assert blocks_for(0, 8) == 0 and blocks_for(1, 8) == 1
+    assert blocks_for(8, 8) == 1 and blocks_for(9, 8) == 2
+
+
+def test_serve_config_validation(gpt2_params):
+    with pytest.raises(ValueError, match="multiple"):
+        ServeConfig(max_prompt=12, block_T=8).validate()
+    # a pool too small for even one worst-case request must fail fast
+    # (regression: admission could never fire and drain() spun forever)
+    with pytest.raises(ValueError, match="worst-case"):
+        ServeConfig(num_blocks=4, block_T=16, max_prompt=64,
+                    max_new_tokens=64).validate()
+    with pytest.raises(ValueError, match="n_positions"):
+        ServeEngine("gpt2", GPT2_CFG, gpt2_params,
+                    ServeConfig(block_T=8, max_prompt=56,
+                                max_new_tokens=32))
+    with pytest.raises(ValueError, match="family"):
+        ServeEngine("bert", GPT2_CFG, gpt2_params)
+
+
+# --------------------------- the acceptance oracle ---------------------------
+
+@pytest.fixture(scope="module")
+def gpt2_engine(gpt2_params):
+    eng = ServeEngine(
+        "gpt2", GPT2_CFG, gpt2_params,
+        ServeConfig(num_slots=3, block_T=8, num_blocks=32, max_prompt=16,
+                    max_new_tokens=12))
+    yield eng
+    eng.close()
+
+
+def test_gpt2_paged_serving_matches_contiguous_generate(gpt2_engine,
+                                                        gpt2_params):
+    """More requests than slots, ragged prompt lengths: every request's
+    greedy tokens equal its own batch-at-a-time generate() run — the
+    paged-pool cache is observationally identical to the contiguous
+    cache, through continuous-batching admissions and evictions."""
+    eng = gpt2_engine
+    free0 = eng.alloc.free_blocks
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, 200, n)) for n in (5, 9, 2, 13, 7, 3)]
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    done = eng.drain()
+    assert sorted(r.id for r in done) == [r.id for r in reqs]
+    for r in done:
+        assert r.tokens == oracle("gpt2", gpt2_params, r), f"req {r.id}"
+        assert r.ttft_ms is not None and r.tpot_ms is not None
+    # eviction returned every page; slots all idle
+    assert eng.alloc.free_blocks == free0
+    assert eng.idle and not eng.active
+    # warmup state for the trace-stability test below
+    assert eng.trace_counts["decode_step"] == 1
+    assert eng.trace_counts["prefill"] == 1
+
+
+def test_gpt2_eos_stops_request_early(gpt2_engine, gpt2_params):
+    """Declare a request's own second greedy token to be eos: the serve
+    loop must stop that request there (emitting the eos), freeing its
+    slot, while others run to their cap."""
+    eng = gpt2_engine
+    rng = np.random.default_rng(3)
+    probe = list(rng.integers(1, 200, 6))
+    r0 = eng.submit(probe, max_new_tokens=6)
+    eng.drain()
+    eos = r0.tokens[1]
+    eng.eos_id = eos
+    try:
+        reqs = [eng.submit(probe, max_new_tokens=6),
+                eng.submit(list(rng.integers(1, 200, 4)),
+                           max_new_tokens=6)]
+        done = eng.drain()
+        by_id = {r.id: r for r in done}
+        want0 = oracle("gpt2", gpt2_params, reqs[0], eos_id=eos)
+        assert by_id[reqs[0].id].tokens == want0
+        assert by_id[reqs[0].id].tokens[-1] == eos
+        assert len(by_id[reqs[0].id].tokens) == 2      # stopped early
+        assert len(by_id[reqs[1].id].tokens) <= 6
+    finally:
+        eng.eos_id = None
+
+
+def test_trace_stability_across_admissions_evictions_cancel(gpt2_engine):
+    """THE compile-stability acceptance: after warmup, admissions with
+    new prompt lengths, evictions, mid-flight cancels, and pool
+    turnover add <= 2 traces (expected: 0). Shapes are static by
+    construction; this pins that no code path smuggles in a dynamic
+    one."""
+    eng = gpt2_engine
+    warm = eng.total_traces()
+    rng = np.random.default_rng(7)
+    reqs = [eng.submit(list(rng.integers(1, 200, int(n))),
+                       max_new_tokens=int(m))
+            for n, m in zip((1, 16, 4, 11, 8, 2, 6),
+                            (12, 3, 7, 1, 5, 9, 2))]
+    eng.step()
+    eng.cancel(reqs[2])                   # queued cancel
+    eng.step()
+    active = eng.active
+    if active:
+        eng.cancel(active[0])             # mid-flight eviction
+    eng.drain()
+    assert eng.total_traces() - warm <= 2
+    assert eng.total_traces() - warm == 0  # the design target
+    assert eng.idle
+
+
+def test_cancel_frees_pages_and_slot(gpt2_engine):
+    eng = gpt2_engine
+    free0 = eng.alloc.free_blocks
+    r = eng.submit([1, 2, 3, 4, 5], max_new_tokens=10)
+    eng.step()
+    assert r.state == "active" and eng.alloc.free_blocks < free0
+    eng.cancel(r)
+    assert r.state == "cancelled"
+    assert eng.alloc.free_blocks == free0 and eng.idle
+    eng.cancel(r)                          # idempotent
+
+
+def test_submit_validation(gpt2_engine):
+    eng = gpt2_engine
+    with pytest.raises(ValueError, match="max_prompt"):
+        eng.submit(list(range(1, 20)))      # 19 > 16
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit([1, 2], max_new_tokens=99)
+    with pytest.raises(ValueError, match="empty"):
+        eng.submit([])
+    with pytest.raises(RuntimeError, match="bank"):
+        eng.submit([1, 2], adapter="nope")  # bankless engine
+
+
+def test_admission_backpressure_tiny_pool(gpt2_params):
+    """A pool that fits ~one worst-case request at a time still serves
+    everything correctly: admission waits for pages, requests queue,
+    outputs stay oracle-equal."""
+    # worst case = blocks_for(8 + 8 - 1, 8) = 2 pages; pool has 3
+    eng = ServeEngine(
+        "gpt2", GPT2_CFG, gpt2_params,
+        ServeConfig(num_slots=2, block_T=8, num_blocks=4, max_prompt=8,
+                    max_new_tokens=8))
+    rng = np.random.default_rng(11)
+    prompts = [list(rng.integers(1, 200, n)) for n in (6, 8, 3)]
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    seen_queued_while_active = False
+    while not eng.idle:
+        eng.step()
+        if eng.queue and eng.active:
+            seen_queued_while_active = True
+    assert seen_queued_while_active       # backpressure actually engaged
+    for r in reqs:
+        assert r.state == "finished"
+        assert r.tokens == oracle("gpt2", gpt2_params, r), f"req {r.id}"
+    eng.close()
+
+
+# --------------------------- multi-adapter + hot-swap ------------------------
+
+@pytest.fixture(scope="module")
+def gemma_engine(gemma_params):
+    bank = AdapterBank(rand_lora(5), capacity=2)
+    eng = ServeEngine(
+        "gemma", GEMMA_CFG, gemma_params,
+        ServeConfig(num_slots=2, block_T=8, num_blocks=32, max_prompt=24,
+                    max_new_tokens=10),
+        bank=bank)
+    yield eng
+    eng.close()
+
+
+def test_gemma_multi_adapter_serving_matches_per_adapter_generate(
+        gemma_engine, gemma_params):
+    """Slots carrying different adapter ids in the SAME decode step must
+    each produce their own adapter's tokens (and base-only requests the
+    base model's) — sliding-window layers engaged (window 6 < len)."""
+    eng = gemma_engine
+    a1, a2 = rand_lora(5), rand_lora(9)
+    eng.load_adapter("t1", a1)
+    eng.load_adapter("t2", a2)
+    rng = np.random.default_rng(1)
+    prompts = [list(rng.integers(3, 190, n)) for n in (7, 18, 11, 4)]
+    route = ["t1", "t2", None, "t1"]
+    trees = {"t1": a1, "t2": a2, None: None}
+    reqs = [eng.submit(p, max_new_tokens=9, adapter=a)
+            for p, a in zip(prompts, route)]
+    done = {r.id: r for r in eng.drain()}
+    for req, aname in zip(reqs, route):
+        want = oracle("gemma", gemma_params, req, lora=trees[aname])
+        assert done[req.id].tokens == want, f"req {req.id} ({aname})"
+
+
+def test_hot_swap_without_recompile(gemma_engine, gemma_params):
+    """Evict a tenant, load a new adapter into the freed slot: requests
+    routed to the new name get the NEW weights, base/base-slot rows are
+    untouched, and the decode step is NOT retraced."""
+    eng = gemma_engine
+    warm = eng.total_traces()
+    a3 = rand_lora(13)
+    eng.evict_adapter("t2")
+    slot = eng.load_adapter("t3", a3)
+    assert slot == eng.bank.resident["t3"]
+    rng = np.random.default_rng(2)
+    req = eng.submit(list(rng.integers(3, 190, 12)), max_new_tokens=9,
+                     adapter="t3")
+    base = eng.submit(list(rng.integers(3, 190, 5)), max_new_tokens=9)
+    done = {r.id: r for r in eng.drain()}
+    assert done[req.id].tokens == oracle("gemma", gemma_params, req,
+                                         lora=a3)
+    assert done[base.id].tokens == oracle("gemma", gemma_params, base)
+    assert eng.total_traces() - warm == 0
+
+
+def test_tenancy_protocol_guards(gemma_engine):
+    """The hot-swap protocol: in-use residents cannot be replaced or
+    evicted; unknown residents cannot be routed to; a full bank refuses
+    loads until an eviction frees a slot."""
+    eng = gemma_engine
+    for name, seed in (("t1", 5), ("t3", 13)):  # self-provision: the
+        # module's earlier tests leave these resident, but the guards
+        # must also hold when this test runs alone
+        if name not in eng.bank.resident:
+            eng.load_adapter(name, rand_lora(seed))
+    r = eng.submit([3, 4, 5], max_new_tokens=9, adapter="t1")
+    with pytest.raises(RuntimeError, match="in-flight"):
+        eng.load_adapter("t1", rand_lora(21))
+    with pytest.raises(RuntimeError, match="in-flight"):
+        eng.evict_adapter("t1")
+    with pytest.raises(KeyError, match="not resident"):
+        eng.submit([3, 4], adapter="t2")   # evicted in the prior test
+    with pytest.raises(OverflowError, match="full"):
+        eng.load_adapter("t9", rand_lora(22))   # t1 + t3 fill capacity 2
+    eng.cancel(r)
+    eng.drain()
+    # structure mismatches are refused before touching the bank
+    bad = init_lora_gemma3(GEMMA_CFG, LoRASpec(rank=5, alpha=10.0),
+                           jax.random.PRNGKey(0))
+    eng.evict_adapter("t3")
+    with pytest.raises(ValueError, match="rank|shape"):
+        eng.load_adapter("t9", bad)
+
+
+# --------------------------- telemetry + e2e smoke ---------------------------
+
+def test_enqueue_event_reports_tenant_slot(gemma_params, tmp_path):
+    """enqueue/cancel events must attribute a request to its resident
+    bank slot — aid resolves at submit, not admission (regression:
+    every queued tenant reported adapter slot 0)."""
+    stream = str(tmp_path / "t.jsonl")
+    bank = AdapterBank(rand_lora(5), capacity=2)
+    eng = ServeEngine("gemma", GEMMA_CFG, gemma_params,
+                      ServeConfig(num_slots=1, block_T=8, num_blocks=32,
+                                  max_prompt=24, max_new_tokens=10),
+                      bank=bank, telemetry=Telemetry(stream))
+    eng.load_adapter("a", rand_lora(6))
+    eng.load_adapter("b", rand_lora(7))            # bank slot 1
+    rb = eng.submit([3, 4, 5], max_new_tokens=2, adapter="b")
+    r0 = eng.submit([6, 7], max_new_tokens=2)      # base-only
+    assert rb.aid == eng.bank.slot("b") == 1
+    eng.cancel(rb)
+    eng.cancel(r0)
+    eng.close()
+    with open(stream) as f:
+        recs = [json.loads(l) for l in f.read().splitlines()
+                if l.strip()]
+    ev = {(r["id"], r["phase"]): r for r in recs
+          if r["event"] == "request"}
+    assert ev[(rb.id, "enqueue")]["adapter"] == 1
+    assert ev[(rb.id, "cancel")]["adapter"] == 1
+    assert ev[(r0.id, "enqueue")]["adapter"] is None
+
+
+def test_cpu_e2e_serve_bench_smoke(gpt2_params, tmp_path):
+    """Satellite acceptance: a deterministic seeded arrival schedule
+    through the REAL serve loop in-process (tools/serve_bench.py's
+    engine + load generator), asserting (a) run_start..run_end
+    telemetry with schema-valid per-request lifecycle events, (b)
+    oracle-equal outputs, (c) the report tool's TTFT/TPOT/req_s
+    section."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import serve_bench
+    import telemetry_report
+    stream = str(tmp_path / "serve.jsonl")
+    eng = ServeEngine(
+        "gpt2", GPT2_CFG, gpt2_params,
+        ServeConfig(num_slots=2, block_T=8, num_blocks=32, max_prompt=16,
+                    max_new_tokens=8),
+        telemetry=Telemetry(stream))
+    done, elapsed = serve_bench.run_load(
+        eng, [], rate=200.0, n_requests=5, seed=4, prompt_lo=2,
+        prompt_hi=9, max_new=6)
+    row = serve_bench.row_from("tiny_smoke", eng, done, elapsed,
+                               rate=200.0, adapters=0)
+    eng.close()
+    assert len(done) == 5 and row["req_s"] > 0
+    assert row["ttft_ms"]["p50"] is not None
+    assert row["tpot_ms"]["p99"] is not None
+    for r in done:                         # oracle-equal outputs
+        assert r.tokens == oracle("gpt2", gpt2_params, r), f"req {r.id}"
+    # determinism: same seed -> same prompts -> same tokens
+    eng2 = ServeEngine(
+        "gpt2", GPT2_CFG, gpt2_params,
+        ServeConfig(num_slots=2, block_T=8, num_blocks=32, max_prompt=16,
+                    max_new_tokens=8))
+    done2, _ = serve_bench.run_load(eng2, [], rate=200.0, n_requests=5,
+                                    seed=4, prompt_lo=2, prompt_hi=9,
+                                    max_new=6)
+    eng2.close()
+    assert [r.tokens for r in done2] == [r.tokens for r in done]
+
+    with open(stream) as f:
+        recs = [json.loads(l) for l in f.read().splitlines() if l.strip()]
+    for rec in recs:
+        assert validate_event(rec) is None, (rec, validate_event(rec))
+    kinds = [r["event"] for r in recs]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    phases = {}
+    for rec in recs:
+        if rec["event"] == "request":
+            phases.setdefault(rec["id"], []).append(rec["phase"])
+    assert len(phases) == 5
+    for seq in phases.values():
+        assert seq == ["enqueue", "admit", "first_token", "finish"]
+    fin = [r for r in recs if r.get("phase") == "finish"]
+    assert all(r["ttft_ms"] > 0 and r["new_tokens"] == 6 for r in fin)
+    assert all(r["tpot_ms"] is not None for r in fin)
+
+    s = telemetry_report.summarize(recs)
+    assert s["requests"]["finished"] == 5
+    assert s["requests"]["ttft_ms"]["p50"] > 0
+    assert s["requests"]["tpot_ms"]["p95"] is not None
+    assert s["requests"]["req_s"] > 0
+    assert telemetry_report.main([stream]) == 0

@@ -64,6 +64,9 @@ REPRESENTATIVE = {
                        snapshot_ms=1.3, write_ms=198.7, bytes=1 << 20,
                        mb_s=5.03, **{"async": True}),
     "ckpt_dropped": dict(step=10, superseded_by=12),
+    "request": dict(id=3, phase="finish", prompt_tokens=17, adapter=1,
+                    queue_ms=4.2, new_tokens=32, ttft_ms=81.0,
+                    tpot_ms=9.5),
     "run_end": dict(steps=10, wall_s=60.0, exit="ok",
                     goodput={"total_s": 60.0, "step_s": 50.0,
                              "productive_frac": 0.83}),

@@ -9,95 +9,163 @@ the extra decode steps. That isolates the device-side marginal cost of
 one token-step (all layers, cache reads, head matmul, sampling) with
 prefill and RTT subtracted structurally.
 
-Also prints the serving regime: sustained generated-tokens/sec at each
-batch size (weights are read once per token-STEP, so batch amortizes the
-dominant weight stream; the B=8 marginal cost is byte-floor-bound,
-DESIGN.md §10a).
+Serving-SLO columns (round 11): each row also reports
+  TTFT  wall time of a max_new_tokens=1 call — prefill + first token +
+        dispatch, the latency a request sees before its first byte;
+  TPOT  = the marginal ms/token-step above — the streaming cadence.
+`--adapters k` runs the same program with a k-adapter stacked bank
+routed per row (lora.stack_adapters + assign_adapters), pricing exactly
+what multi-tenant decode adds over the base model.
 
 Usage:
   python tools/bench_decode.py                 # GPT-2 small
   python tools/bench_decode.py --gemma         # Gemma-3 270M
+  python tools/bench_decode.py --adapters 8    # k=8 stacked-bank decode
   python tools/bench_decode.py --kernel        # + pallas kernel microbench
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, __file__.rsplit("/", 2)[0])   # repo root
+sys.path.insert(0, __file__.rsplit("/", 1)[0])   # tools/ (serve_bench)
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 
-def marginal_ms(fn_n, params, ids, mask, n_lo, n_hi, pipeline=8):
-    """Marginal device ms/token-step from pipelined deltas between two N."""
+def timed_window(f, pipeline, reps=3):
+    """Best-of-`reps` wall seconds per call for a pipelined dispatch
+    window. Min discards OS scheduler hiccups, which otherwise dominate
+    single-call windows (pipeline=1 contract mode on shared CPU)."""
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        outs = [f() for _ in range(pipeline)]
+        np.asarray(outs[-1])
+        best = min(best, time.perf_counter() - t0)
+    return best / pipeline
+
+
+def marginal_ms(make_f, n_lo, n_hi, pipeline=8):
+    """Marginal device ms/token-step from pipelined deltas between two N.
+    make_f(n) -> zero-arg dispatch returning the output array."""
     out = {}
     for n in (n_lo, n_hi):
-        f = fn_n(n)
-        np.asarray(f(params, ids, mask))            # compile
-        t0 = time.perf_counter()
-        outs = [f(params, ids, mask) for _ in range(pipeline)]
-        np.asarray(outs[-1])
-        out[n] = (time.perf_counter() - t0) / pipeline
+        f = make_f(n)
+        np.asarray(f())                             # compile
+        out[n] = timed_window(f, pipeline)
     return (out[n_hi] - out[n_lo]) * 1000 / (n_hi - n_lo), out
 
 
-def bench_model(gemma: bool, B: int, P: int, dtype, pipeline: int):
+def bench_model(gemma: bool, B: int, P: int, dtype, pipeline: int,
+                adapters: int = 0, tiny: bool = False, n_pair=(16, 64)):
+    """One decode row; returns the row dict (contract-tested by
+    tests/test_bench_contract.py via tiny=True on CPU)."""
     from mobilefinetuner_tpu.models import gemma3, gpt2
     from mobilefinetuner_tpu.models.generate import (SampleConfig,
                                                      gemma3_generate,
                                                      gpt2_generate)
     if gemma:
         from mobilefinetuner_tpu.core.config import Gemma3TextConfig
-        config = Gemma3TextConfig.gemma3_270m()
+        config = (Gemma3TextConfig.tiny() if tiny
+                  else Gemma3TextConfig.gemma3_270m())
         params = gemma3.init_params(config, jax.random.PRNGKey(0))
-        gen = gemma3_generate
-        vocab = config.vocab_size
+        gen, name = gemma3_generate, "gemma270m"
     else:
         from mobilefinetuner_tpu.core.config import GPT2Config
-        config = GPT2Config.gpt2_small()
+        config = GPT2Config.tiny() if tiny else GPT2Config.gpt2_small()
         params = gpt2.init_params(config, jax.random.PRNGKey(0))
-        gen = gpt2_generate
-        vocab = config.vocab_size
+        gen, name = gpt2_generate, "gpt2s"
+    if tiny:
+        name += "_tiny"
+    vocab = config.vocab_size
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, vocab, (B, P)), jnp.int32)
     mask = jnp.ones_like(ids)
 
-    def fn_n(n):
-        cfg = SampleConfig(max_new_tokens=n, greedy=True, eos_id=None)
-        return jax.jit(lambda p, i, m: gen(config, p, i, m, cfg,
-                                           compute_dtype=dtype))
+    lora = None
+    if adapters:
+        from mobilefinetuner_tpu.lora.lora import (assign_adapters,
+                                                   stack_adapters)
+        from serve_bench import rand_adapters
+        trees = rand_adapters("gemma" if gemma else "gpt2", config,
+                              adapters)
+        lora = assign_adapters(stack_adapters(trees),
+                               [i % adapters for i in range(B)])
 
-    ms, walls = marginal_ms(fn_n, params, ids, mask, 16, 64,
-                            pipeline=pipeline)
-    name = "gemma270m" if gemma else "gpt2s"
-    print(f"{name} B={B} P={P}: marginal {ms / 1:.3f} ms/token-step "
-          f"({B / ms * 1000:.0f} tok/s asymptotic)  "
-          f"[wall N=16 {walls[16]*1e3:.1f} ms, N=64 {walls[64]*1e3:.1f}]")
-    # sustained serving number at N=64 (same definition as bench.py)
-    sustained = B * 64 / walls[64]
-    print(f"  sustained e2e (pipeline={pipeline}, N=64): "
+    n_lo, n_hi = n_pair
+
+    def make_f(n):
+        cfg = SampleConfig(max_new_tokens=n, greedy=True, eos_id=None)
+        f = jax.jit(lambda p, l, i, m: gen(config, p, i, m, cfg, lora=l,
+                                           compute_dtype=dtype))
+        return lambda: f(params, lora, ids, mask)
+
+    ms, walls = marginal_ms(make_f, n_lo, n_hi, pipeline=pipeline)
+    # TTFT: one prefill + one sampled token, e2e (dispatch included)
+    f1 = make_f(1)
+    np.asarray(f1())                                # compile
+    ttft_ms = timed_window(lambda: np.asarray(f1()), pipeline) * 1000
+    sustained = B * n_hi / walls[n_hi]
+    row = {
+        "config": f"{name}_decode_B{B}"
+                  + (f"_k{adapters}" if adapters else ""),
+        "B": B, "P": P, "adapters": adapters,
+        "dtype": str(jnp.dtype(dtype)),
+        "tpot_ms": round(ms, 4),                    # marginal ms/token
+        "ttft_ms": round(ttft_ms, 3),
+        "tok_s_asymptotic": round(B / ms * 1000, 1) if ms > 0 else None,
+        "sustained_tok_s": round(sustained, 1),
+        "wall_ms_lo": round(walls[n_lo] * 1e3, 3),
+        "wall_ms_hi": round(walls[n_hi] * 1e3, 3),
+    }
+    asym = (f"{row['tok_s_asymptotic']:.0f} tok/s asymptotic"
+            if row["tok_s_asymptotic"] is not None
+            else "marginal below timer noise")  # tiny CPU contract mode
+    print(f"{row['config']} P={P}: TPOT {ms:.3f} ms/token-step, "
+          f"TTFT {ttft_ms:.1f} ms ({asym})  "
+          f"[wall N={n_lo} {walls[n_lo]*1e3:.1f} ms, "
+          f"N={n_hi} {walls[n_hi]*1e3:.1f}]")
+    print(f"  sustained e2e (pipeline={pipeline}, N={n_hi}): "
           f"{sustained:,.0f} tok/s")
-    return ms, sustained
+    return row
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--gemma", action="store_true")
-    ap.add_argument("--P", type=int, default=128)
+    ap.add_argument("--P", type=int, default=0,
+                    help="prompt length (default 128; 8 under --tiny)")
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--pipeline", type=int, default=8)
     ap.add_argument("--B", type=int, nargs="*", default=[8, 32])
+    ap.add_argument("--adapters", type=int, default=0,
+                    help="stacked-bank decode with k adapters routed "
+                         "per batch row (0 = base model)")
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny config (CPU contract mode)")
+    ap.add_argument("--json", action="store_true", dest="json_out",
+                    help="emit one JSON row per batch size")
     ap.add_argument("--kernel", action="store_true",
                     help="also run the pallas decode_attention microbench")
     args = ap.parse_args()
     dtype = jnp.dtype(args.dtype)
+    # tiny configs have n_positions=64: shrink P and the N pair so
+    # P + n_hi fits (same values the contract test pins)
+    P = args.P or (8 if args.tiny else 128)
+    n_pair = (2, 4) if args.tiny else (16, 64)
     for b in args.B:
-        bench_model(args.gemma, b, args.P, dtype, args.pipeline)
+        row = bench_model(args.gemma, b, P, dtype, args.pipeline,
+                          adapters=args.adapters, tiny=args.tiny,
+                          n_pair=n_pair)
+        if args.json_out:
+            print(json.dumps(row))
     if args.kernel:
         kernel_microbench(args.gemma)
 

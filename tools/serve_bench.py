@@ -1,0 +1,239 @@
+"""Serving-SLO ground truth: seeded Poisson open-loop load over the
+real serve engine (serve/engine.py).
+
+Open-loop means arrivals do NOT wait for the service: request k arrives
+at its scheduled time whether or not the engine is keeping up, so queue
+buildup — the thing a closed-loop "send, wait, send" bench structurally
+cannot show — lands in the TTFT tail exactly as it would in production.
+The arrival schedule is seeded (exponential inter-arrival gaps), so a
+row is reproducible end to end: same seed, same prompts, same adapter
+routing, same admission order.
+
+Every request's lifecycle rides the telemetry `request` events
+(--telemetry_out), so tools/telemetry_report.py renders the same
+TTFT/TPOT percentiles this tool prints — one measurement, two readers.
+
+Usage:
+  python tools/serve_bench.py                        # GPT-2 small, k=1
+  python tools/serve_bench.py --gemma --adapters 8   # Gemma-270M, k=8
+  python tools/serve_bench.py --out BENCH_SERVE_r11.json --rate 4 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# one rank convention, two readers: the percentiles this tool prints
+# must be the ones telemetry_report computes over the same stream
+from telemetry_report import percentile
+
+
+def rand_adapters(family, config, k: int, seed: int = 0):
+    """k seeded random adapters (B pushed off zero so each tenant's
+    outputs actually differ)."""
+    from mobilefinetuner_tpu.lora.lora import (LoRASpec, init_lora_gemma3,
+                                               init_lora_gpt2)
+    init = init_lora_gpt2 if family == "gpt2" else init_lora_gemma3
+    out = []
+    for i in range(k):
+        lora = init(config, LoRASpec(rank=4, alpha=8.0),
+                    jax.random.PRNGKey(seed + i))
+        leaves, td = jax.tree.flatten(lora)
+        keys = jax.random.split(jax.random.PRNGKey(seed + 100 + i),
+                                len(leaves))
+        out.append(jax.tree.unflatten(td, [
+            l if l.ndim == 0 else 0.02 * jax.random.normal(kk, l.shape)
+            for l, kk in zip(leaves, keys)]))
+    return out
+
+
+def build_engine(model: str, num_slots: int, block_T: int,
+                 num_blocks: int, max_prompt: int, max_new: int,
+                 adapters: int, dtype: str, telemetry_out: str = "",
+                 seed: int = 0):
+    """model: gpt2s | gemma270m | tiny-gpt2 | tiny-gemma. The tiny
+    modes are the CPU contract/smoke path (tests/test_serve.py)."""
+    from mobilefinetuner_tpu.core.config import GPT2Config, Gemma3TextConfig
+    from mobilefinetuner_tpu.core.telemetry import Telemetry
+    from mobilefinetuner_tpu.models import gemma3, gpt2
+    from mobilefinetuner_tpu.serve import (AdapterBank, ServeConfig,
+                                           ServeEngine)
+    if model == "gpt2s":
+        config, family = GPT2Config.gpt2_small(), "gpt2"
+    elif model == "gemma270m":
+        config, family = Gemma3TextConfig.gemma3_270m(), "gemma"
+    elif model == "tiny-gpt2":
+        config, family = GPT2Config.tiny(), "gpt2"
+    elif model == "tiny-gemma":
+        config, family = Gemma3TextConfig.tiny(), "gemma"
+    else:
+        raise SystemExit(f"unknown model {model!r}")
+    mod = gpt2 if family == "gpt2" else gemma3
+    params = mod.init_params(config, jax.random.PRNGKey(seed))
+    bank = None
+    names = []
+    if adapters:
+        trees = rand_adapters(family, config, adapters, seed)
+        bank = AdapterBank(trees[0], capacity=adapters)
+        names = [f"tenant{i}" for i in range(adapters)]
+    cfg = ServeConfig(num_slots=num_slots, block_T=block_T,
+                      num_blocks=num_blocks, max_prompt=max_prompt,
+                      max_new_tokens=max_new, dtype=dtype)
+    eng = ServeEngine(family, config, params, cfg, bank=bank,
+                      telemetry=Telemetry(telemetry_out))
+    if adapters:
+        for n, t in zip(names, trees):
+            eng.load_adapter(n, t)
+    return eng, names
+
+
+def run_load(engine, names, rate: float, n_requests: int, seed: int,
+             prompt_lo: int, prompt_hi: int, max_new: int):
+    """Drive one open-loop Poisson run; returns (finished requests,
+    elapsed seconds). Deterministic given the seed: arrivals, prompt
+    contents/lengths, and tenant routing all come from one rng."""
+    rng = np.random.default_rng(seed)
+    vocab = engine.config.vocab_size
+    gaps = rng.exponential(1.0 / rate, n_requests)
+    prompts = [list(rng.integers(1, vocab, int(n))) for n in
+               rng.integers(prompt_lo, prompt_hi + 1, n_requests)]
+    route = ([names[int(i)] for i in
+              rng.integers(0, len(names), n_requests)]
+             if names else [None] * n_requests)
+    t0 = time.perf_counter()
+    arrivals = t0 + np.cumsum(gaps)
+    done, i = [], 0
+    while i < n_requests or not engine.idle:
+        now = time.perf_counter()
+        while i < n_requests and arrivals[i] <= now:
+            engine.submit(prompts[i], max_new_tokens=max_new,
+                          adapter=route[i])
+            i += 1
+        if engine.idle:
+            time.sleep(min(max(arrivals[i] - now, 0.0), 0.05))
+            continue
+        done.extend(engine.step())
+    return sorted(done, key=lambda r: r.id), time.perf_counter() - t0
+
+
+def row_from(config_name: str, engine, done, elapsed: float,
+             rate: float, adapters: int) -> dict:
+    ttfts = sorted(r.ttft_ms for r in done if r.ttft_ms is not None)
+    tpots = sorted(r.tpot_ms for r in done if r.tpot_ms is not None)
+    gen_tokens = sum(len(r.tokens) for r in done)
+    pct = lambda v: {"p50": percentile(v, 50), "p95": percentile(v, 95),
+                     "p99": percentile(v, 99)}
+    return {
+        "config": config_name,
+        "offered_rps": rate,
+        "requests": len(done),
+        "elapsed_s": round(elapsed, 3),
+        "req_s": round(len(done) / elapsed, 3) if elapsed > 0 else None,
+        "gen_tok_s": (round(gen_tokens / elapsed, 1)
+                      if elapsed > 0 else None),
+        "ttft_ms": pct(ttfts),
+        "tpot_ms": pct(tpots),
+        "adapters_resident": adapters,
+        "num_slots": engine.cfg.num_slots,
+        "block_T": engine.cfg.block_T,
+        "num_blocks": engine.cfg.num_blocks,
+        "decode_steps": engine.decode_steps,
+        "traces": dict(engine.trace_counts),
+    }
+
+
+def run_rows(model: str, rates, n_requests: int, adapters: int,
+             num_slots: int = 8, block_T: int = 16, num_blocks: int = 256,
+             max_prompt: int = 64, max_new: int = 32, dtype: str =
+             "bfloat16", seed: int = 0, prompt_lo: int = 8,
+             prompt_hi: int = 0, telemetry_out: str = "") -> list:
+    """One engine, one warmup request, then one row per offered rate."""
+    prompt_hi = prompt_hi or max_prompt
+    eng, names = build_engine(model, num_slots, block_T, num_blocks,
+                              max_prompt, max_new, adapters, dtype,
+                              telemetry_out=telemetry_out, seed=seed)
+    # warmup: compile prefill + step outside the measured window
+    eng.submit([1] * prompt_lo, max_new_tokens=min(2, max_new),
+               adapter=names[0] if names else None)
+    eng.drain()
+    warm_traces = eng.total_traces()
+    rows = []
+    for rate in rates:
+        done, elapsed = run_load(eng, names, rate, n_requests, seed,
+                                 prompt_lo, prompt_hi, max_new)
+        name = f"{model}_serve_k{max(adapters, 1)}_r{rate:g}"
+        row = row_from(name, eng, done, elapsed, rate, adapters)
+        row["new_traces_after_warmup"] = eng.total_traces() - warm_traces
+        rows.append(row)
+        # percentiles may be None (e.g. max_new=1 leaves no post-first-
+        # token cadence, so every tpot is None)
+        fmt = lambda v, spec="0f": ("n/a" if v is None
+                                    else f"{v:.{spec}}")
+        print(f"{name}: {row['req_s']} req/s ({row['gen_tok_s']} tok/s), "
+              f"TTFT p50/p99 = {fmt(row['ttft_ms']['p50'])}/"
+              f"{fmt(row['ttft_ms']['p99'])} ms, TPOT p50 = "
+              f"{fmt(row['tpot_ms']['p50'], '1f')} ms, "
+              f"{row['new_traces_after_warmup']} retraces")
+    eng.close()
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gpt2s",
+                    choices=["gpt2s", "gemma270m", "tiny-gpt2",
+                             "tiny-gemma"])
+    ap.add_argument("--gemma", action="store_true",
+                    help="shorthand for --model gemma270m")
+    ap.add_argument("--rate", type=float, nargs="*", default=[4.0],
+                    help="offered load(s), requests/second")
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--adapters", type=int, default=0,
+                    help="resident LoRA tenants (0 = base only)")
+    ap.add_argument("--num_slots", type=int, default=8)
+    ap.add_argument("--block_T", type=int, default=16)
+    ap.add_argument("--num_blocks", type=int, default=256)
+    ap.add_argument("--max_prompt", type=int, default=64)
+    ap.add_argument("--max_new", type=int, default=32)
+    ap.add_argument("--prompt_lo", type=int, default=8)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--telemetry_out", default="")
+    ap.add_argument("--out", default="",
+                    help="append rows to this JSON artifact")
+    args = ap.parse_args(argv)
+    model = "gemma270m" if args.gemma else args.model
+    rows = run_rows(model, args.rate, args.requests, args.adapters,
+                    num_slots=args.num_slots, block_T=args.block_T,
+                    num_blocks=args.num_blocks,
+                    max_prompt=args.max_prompt, max_new=args.max_new,
+                    dtype=args.dtype, seed=args.seed,
+                    prompt_lo=args.prompt_lo,
+                    telemetry_out=args.telemetry_out)
+    if args.out:
+        art = {"device": jax.devices()[0].device_kind,
+               "jax": jax.__version__, "rows": []}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                art = json.load(f)
+        art["rows"].extend(rows)
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(art, f, indent=1)
+        os.replace(tmp, args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

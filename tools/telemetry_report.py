@@ -18,6 +18,8 @@ import argparse
 import json
 import sys
 
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
 from mobilefinetuner_tpu.core.telemetry import (partial_goodput,
                                                 validate_event)
 
@@ -135,6 +137,7 @@ def summarize(events, n_invalid=0) -> dict:
                    "macro_accuracy": e.get("macro_accuracy")}
                   for e in by.get("eval", [])],
         "checkpoints": checkpoint_summary(scope),
+        "requests": request_summary(scope),
         "stragglers": straggler_entries(scope),
         "hangs": hang_entries(scope),
         # a killed LATEST run leaves no run_end after its run_start (a
@@ -203,6 +206,55 @@ def checkpoint_lines(ck) -> list:
     if ck["dropped"]:
         line += f", {ck['dropped']} snapshot(s) coalesced away"
     return [line]
+
+
+def request_summary(events) -> dict:
+    """Serving SLOs from the per-request `request` lifecycle events
+    (serve/engine.py): TTFT/TPOT percentiles over FINISHED requests and
+    sustained req/s over the stream's observed request span. None when
+    the stream carries no serving traffic."""
+    reqs = [e for e in events if e.get("event") == "request"]
+    if not reqs:
+        return None
+    fins = [e for e in reqs if e.get("phase") == "finish"]
+    ttfts = sorted(e["ttft_ms"] for e in fins
+                   if e.get("ttft_ms") is not None)
+    tpots = sorted(e["tpot_ms"] for e in fins
+                   if e.get("tpot_ms") is not None)
+    pcts = lambda vals: {"p50": percentile(vals, 50),
+                         "p95": percentile(vals, 95),
+                         "p99": percentile(vals, 99)}
+    span = (max(e["t"] for e in reqs) - min(e["t"] for e in reqs)
+            if len(reqs) > 1 else 0.0)
+    gen = sum(e.get("new_tokens") or 0 for e in fins)
+    return {
+        "submitted": sum(1 for e in reqs if e.get("phase") == "enqueue"),
+        "finished": len(fins),
+        "cancelled": sum(1 for e in reqs if e.get("phase") == "cancel"),
+        "ttft_ms": pcts(ttfts),
+        "tpot_ms": pcts(tpots),
+        "req_s": round(len(fins) / span, 3) if span > 0 else None,
+        "gen_tok_s": round(gen / span, 1) if span > 0 else None,
+    }
+
+
+def request_lines(r) -> list:
+    if not r:
+        return []
+    tt, tp = r["ttft_ms"], r["tpot_ms"]
+    lines = [f"  requests: {r['finished']}/{r['submitted']} finished"
+             + (f", {r['cancelled']} cancelled" if r["cancelled"] else "")
+             + (f"; {r['req_s']:.2f} req/s"
+                if r["req_s"] is not None else "")
+             + (f", {r['gen_tok_s']:.0f} gen tok/s"
+                if r["gen_tok_s"] is not None else "")]
+    if tt["p50"] is not None:
+        lines.append(f"    TTFT p50/p95/p99 = {_fmt(tt['p50'], 1)}/"
+                     f"{_fmt(tt['p95'], 1)}/{_fmt(tt['p99'], 1)} ms")
+    if tp["p50"] is not None:
+        lines.append(f"    TPOT p50/p95/p99 = {_fmt(tp['p50'], 2)}/"
+                     f"{_fmt(tp['p95'], 2)}/{_fmt(tp['p99'], 2)} ms")
+    return lines
 
 
 def straggler_entries(events) -> list:
@@ -306,6 +358,8 @@ def print_summary(s: dict):
             print(f"  eval @ step {e['step']}: loss={_fmt(e['loss'], 4)} "
                   f"ppl={_fmt(e['ppl'])}")
     for line in checkpoint_lines(s["checkpoints"]):
+        print(line)
+    for line in request_lines(s.get("requests")):
         print(line)
     for line in straggler_lines(s.get("stragglers", [])) \
             + hang_lines(s.get("hangs", [])):

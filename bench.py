@@ -251,9 +251,11 @@ def offload_setup(params, budget_bytes=0):
 
 
 def bench_gpt2_lora(B, S, dtype, accum=1, offload=False, impl="auto",
-                    steps=40, size="small", remat=False):
+                    steps=40, size="small", remat=False,
+                    lora_impl="auto"):
     base = {"small": GPT2Config.gpt2_small, "medium": GPT2Config.gpt2_medium,
-            "large": GPT2Config.gpt2_large, "xl": GPT2Config.gpt2_xl}[size]()
+            "large": GPT2Config.gpt2_large, "xl": GPT2Config.gpt2_xl,
+            "tiny": GPT2Config.tiny}[size]()
     # long-context rows past GPT-2's native 1024 positions: the bench
     # trains randomly-initialized weights, so extending the learned
     # position table is shape plumbing, not a semantics change
@@ -274,7 +276,8 @@ def bench_gpt2_lora(B, S, dtype, accum=1, offload=False, impl="auto",
         logits = gpt2.forward(config, p, mb["input_ids"],
                               attention_mask=mb["attention_mask"],
                               lora=lora_t, compute_dtype=dtype,
-                              offload=off, remat=remat)
+                              offload=off, remat=remat,
+                              lora_impl=lora_impl)
         return lm_cross_entropy_sum(logits, mb["labels"])
 
     step_fn = make_train_step(loss_fn, tc, mask=mask, donate=True)
@@ -282,6 +285,7 @@ def bench_gpt2_lora(B, S, dtype, accum=1, offload=False, impl="auto",
     batches, eval_batch = row_batches(config.vocab_size, B * accum, S,
                                       steps)
     r = measure(step_fn, lora, params, opt, batches, eval_batch, steps)
+    r["lora_impl"] = lora_impl
     n_frozen = gpt2.param_count(params)
     n_active = sum(x.size for x in jax.tree.leaves(lora))
     r["flops"] = transformer_flops(n_active, n_frozen, B * accum, S,
@@ -328,7 +332,7 @@ def bench_gpt2_full(B, S, dtype, steps=40):
 
 def bench_gemma_lora(B, S, dtype, accum=1, offload=False, steps=20,
                      loss_chunks=4, size="270m", offload_budget=0,
-                     remat=False, impl="auto"):
+                     remat=False, impl="auto", lora_impl="auto"):
     config = (Gemma3TextConfig.gemma3_1b() if size == "1b"
               else Gemma3TextConfig.gemma3_270m())
     config = dataclasses.replace(config, attention_impl=impl)
@@ -347,16 +351,19 @@ def bench_gemma_lora(B, S, dtype, accum=1, offload=False, steps=20,
         hidden = gemma3.hidden_states(
             config, p2, mb["input_ids"],
             attention_mask=mb["attention_mask"], lora=lora_t,
-            compute_dtype=dtype, block_stream=stream, remat=remat)
+            compute_dtype=dtype, block_stream=stream, remat=remat,
+            lora_impl=lora_impl)
         return chunked_lm_cross_entropy_sum(hidden, p2["embed"],
                                             mb["labels"],
-                                            num_chunks=loss_chunks)
+                                            num_chunks=loss_chunks,
+                                            lora_impl=lora_impl)
 
     step_fn = make_train_step(loss_fn, tc, mask=mask, donate=True)
     opt = init_optimizer(lora, tc, mask)
     batches, eval_batch = row_batches(config.vocab_size, B * accum, S,
                                       steps)
     r = measure(step_fn, lora, params, opt, batches, eval_batch, steps)
+    r["lora_impl"] = lora_impl
     n_frozen = sum(x.size for x in jax.tree.leaves(params))
     n_active = sum(x.size for x in jax.tree.leaves(lora))
     r["flops"] = transformer_flops(
@@ -664,6 +671,9 @@ def finish(name, r, dtype, steps) -> dict:
         # shared stream — comparable across rows of the same model
         "loss": round(r["loss"], 4),
         "loss_tokens_seen": r.get("loss_tokens_seen"),
+        # present on the LoRA rows: which models/lora_apply.py path the
+        # row ran (the lorafused-vs-loranaive pairs are the r12 delta)
+        **({"lora_impl": r["lora_impl"]} if "lora_impl" in r else {}),
     }
 
 
@@ -844,6 +854,20 @@ def main():
             gsteps, B=2, S=2048, impl="flash")
         run("gemma270m_lora_bf16_S2048_xla", bench_gemma_lora, bf16,
             gsteps, B=2, S=2048, impl="xla")
+        # LoRA hot-path rows (r12, DESIGN.md §17): fused (shape-aware
+        # contraction order + Pallas epilogue at eligible sites) vs the
+        # naive oracle, both families, S=512/1024/2048 — the tokens/s
+        # delta of never round-tripping the [N, d_out] adapter delta
+        # through HBM. Parity is pinned by tests/test_lora.py; these
+        # rows price it.
+        for s_len, b_sz in ((512, 16), (1024, 4), (2048, 2)):
+            for li in ("naive", "fused"):
+                run(f"gpt2s_lora_bf16_S{s_len}_lora{li}",
+                    bench_gpt2_lora, bf16, steps, B=b_sz, S=s_len,
+                    lora_impl=li)
+                run(f"gemma270m_lora_bf16_S{s_len}_lora{li}",
+                    bench_gemma_lora, bf16, gsteps,
+                    B=max(b_sz // 2, 2), S=s_len, lora_impl=li)
         # input-pipeline rows (r7): every other row feeds pre-built
         # device arrays, so host-side batch production (streaming-window
         # tokenization + accum assembly + placement) never shows up in

@@ -114,6 +114,21 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
                         "v5e, ops/attention.resolve_impl); 'flash' = "
                         "Pallas block-sparse kernel; 'xla' = plain fused "
                         "attention")
+    g.add_argument("--lora_impl", choices=["auto", "naive", "fused"],
+                   default="auto",
+                   help="LoRA hot-path implementation "
+                        "(models/lora_apply.py, DESIGN.md §17): 'naive' "
+                        "= the parity oracle, fixed (x@A)@B order; "
+                        "'fused' = shape-aware contraction order + the "
+                        "Pallas epilogue kernels at eligible sites (the "
+                        "[N, d_out] adapter delta never round-trips "
+                        "HBM); 'auto' resolves per call site — fused "
+                        "where the kernel is eligible and the delta is "
+                        "memory-bound, else naive. All impls accumulate "
+                        "the rank-r bottleneck in f32; value+grad "
+                        "parity is pinned by tests/test_lora.py. The "
+                        "per-target resolution is logged in the "
+                        "telemetry run_start manifest")
     g.add_argument("--no_model_dropout", action="store_true",
                    help="zero the checkpoint's embd/resid/attn pdrop "
                         "(HF GPT-2 configs carry 0.1; dropout changes "
@@ -492,6 +507,20 @@ def evaluate(eval_step, trainable, frozen, dataset: WikiText2Dataset,
 
 def compute_dtype_from_args(args):
     return jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+
+
+def log_lora_impl_resolution(args, target_dims, rank: int,
+                             compute_dtype) -> None:
+    """Resolve `--lora_impl auto` per target for the run's dominant
+    shapes (models/lora_apply.impl_summary) and stamp the result into
+    args so the telemetry run_start manifest records which path served
+    the run. Shared by the LoRA CLIs — the convention must not drift
+    between them."""
+    from mobilefinetuner_tpu.models.lora_apply import impl_summary
+    args.lora_impl_resolved = impl_summary(
+        target_dims, args.batch_size * args.seq_len, rank,
+        args.lora_impl, jnp.dtype(compute_dtype).itemsize)
+    log.info(f"lora_impl={args.lora_impl} -> {args.lora_impl_resolved}")
 
 
 def maybe_resume_opt_state(args, trainable, tc: TrainConfig, mask=None):

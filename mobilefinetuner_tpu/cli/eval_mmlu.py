@@ -115,7 +115,14 @@ def make_batched_logits_fn(hidden_fn, head_key, compute_dtype, params,
         h = hidden_fn(params, lora, ids)            # [B, S, E]
         head = params[head_key].astype(compute_dtype)
         rows = h[jnp.arange(h.shape[0]), last_idx]  # [B, E]
-        return rows @ head.T                        # [B, V]
+        logits = rows @ head.T                      # [B, V]
+        # hidden_fn applies only the per-layer sites; an lm_head
+        # adapter entry must land at this head projection too, or the
+        # scored model differs from the trained one (DESIGN.md §17)
+        if lora is not None and "lm_head" in lora.get("blocks", {}):
+            from mobilefinetuner_tpu.models.lora_apply import maybe_lora
+            logits = maybe_lora(logits, rows, lora["blocks"]["lm_head"])
+        return logits
 
     def logits_fn(ids: np.ndarray, last: np.ndarray) -> np.ndarray:
         return np.asarray(fwd(params, lora, jnp.asarray(ids),

@@ -96,9 +96,15 @@ def main(argv=None) -> int:
                 config, params, batch["input_ids"],
                 attention_mask=batch["attention_mask"], lora=lora,
                 compute_dtype=compute_dtype)
+            # an lm_head adapter entry rides the chunked CE as
+            # lora_head (hidden_states only applies per-layer sites;
+            # dropping it here would score a different model than the
+            # one trained — DESIGN.md §17)
+            head_entry = (None if lora is None
+                          else lora["blocks"].get("lm_head"))
             return chunked_lm_cross_entropy_sum(
                 hidden, params["embed"], batch["labels"],
-                num_chunks=args.loss_chunks)
+                num_chunks=args.loss_chunks, lora_head=head_entry)
     else:
         from mobilefinetuner_tpu.models import gpt2
         encode, eos_id, pad_id = tok.encode, tok.eos_id, None

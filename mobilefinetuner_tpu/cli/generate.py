@@ -63,6 +63,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="apply the adapter dynamically at every site "
                         "instead of merging — no merged weight copy, so "
                         "many adapters can be served off one base")
+    p.add_argument("--lora_impl", choices=["auto", "naive", "fused"],
+                   default="auto",
+                   help="dynamic-LoRA hot-path implementation "
+                        "(models/lora_apply.py; parity-pinned — 'naive' "
+                        "is the oracle, 'fused' the shape-aware + "
+                        "Pallas-epilogue path, 'auto' resolves per "
+                        "call site)")
     p.add_argument("--max_new_tokens", type=int, default=64)
     p.add_argument("--prefill_chunk", type=int, default=0,
                    help="Gemma long-prompt mode: prefill in W-token "
@@ -160,7 +167,8 @@ def main(argv=None) -> int:
     # jit with params/rng as ARGUMENTS: closing over full-size weights
     # would embed them in the HLO as constants (oversized programs)
     gen_jit = jax.jit(lambda p, l, i, m, r: gen(
-        config, p, i, m, cfg, r, compute_dtype=compute_dtype, lora=l))
+        config, p, i, m, cfg, r, compute_dtype=compute_dtype, lora=l,
+        lora_impl=args.lora_impl))
     out = np.asarray(gen_jit(params, lora, jnp.asarray(ids),
                              jnp.asarray(mask), rng))
     dt = time.time() - t0

@@ -65,8 +65,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lora_dropout", type=float, default=0.0)
     p.add_argument("--lora_targets", default="attn_qkv,attn_proj",
                    help="comma list of attn_qkv,attn_proj,mlp_fc_in,"
-                        "mlp_fc_out,attn_q,attn_k,attn_v (PEFT-aligned "
-                        "default: fused c_attn + c_proj, main.cpp:381-390)")
+                        "mlp_fc_out,attn_q,attn_k,attn_v,lm_head "
+                        "(PEFT-aligned default: fused c_attn + c_proj, "
+                        "main.cpp:381-390; lm_head is a single unstacked "
+                        "site on the tied head — native format only, "
+                        "cannot be merged)")
     p.add_argument("--split_qkv", action="store_true",
                    help="replace the fused attn_qkv target with separate "
                         "q/k/v column-range adapters "
@@ -153,6 +156,11 @@ def main(argv=None) -> int:
     base_rng = (jax.random.PRNGKey(args.seed + 1)
                 if args.lora_dropout > 0 or model_pdrop > 0 else None)
 
+    from mobilefinetuner_tpu.lora.lora import GPT2_TARGETS
+    common.log_lora_impl_resolution(
+        args, {t: GPT2_TARGETS[t](config) for t in spec.targets or []},
+        spec.rank, compute_dtype)
+
     def loss_fn(lora_t, frozen, mb):
         # per-(step, micro-batch) dropout key, threaded via the batch
         rng = mb["dropout_rng"][0] if "dropout_rng" in mb else None
@@ -162,7 +170,8 @@ def main(argv=None) -> int:
                               lora=lora_t, compute_dtype=compute_dtype,
                               remat=args.remat, offload=offload_arg,
                               lora_dropout=args.lora_dropout,
-                              dropout_rng=rng, cp_mesh=cp_mesh)
+                              dropout_rng=rng, cp_mesh=cp_mesh,
+                              lora_impl=args.lora_impl)
         return lm_cross_entropy_sum(logits, mb["labels"])
 
     def nll_fn(lora_t, frozen, mb):
@@ -170,7 +179,8 @@ def main(argv=None) -> int:
         logits = gpt2.forward(config, p, mb["input_ids"],
                               attention_mask=mb["attention_mask"],
                               lora=lora_t, compute_dtype=compute_dtype,
-                              offload=offload_arg, cp_mesh=cp_mesh)
+                              offload=offload_arg, cp_mesh=cp_mesh,
+                              lora_impl=args.lora_impl)
         return lm_cross_entropy_sum(logits, mb["labels"])
 
     if args.align_dump_dir:

@@ -64,7 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lora_targets", default="",
                    help="comma list overriding --targets "
                         "(q_proj,k_proj,v_proj,o_proj,gate_proj,up_proj,"
-                        "down_proj)")
+                        "down_proj,lm_head — lm_head is a single "
+                        "unstacked site on the tied head; its delta "
+                        "rides the chunked-CE epilogue, native adapter "
+                        "format only)")
     p.add_argument("--pretokenized_path", default="",
                    help="pretokenized .bin (train split)")
     p.add_argument("--pretokenized_meta", default="",
@@ -161,6 +164,11 @@ def main(argv=None) -> int:
     ce_mesh = mesh if mesh.size > 1 else None
     ce_sp = cp_mesh is not None
 
+    from mobilefinetuner_tpu.lora.lora import GEMMA_TARGETS
+    common.log_lora_impl_resolution(
+        args, {t: GEMMA_TARGETS[t](config) for t in spec.targets or []},
+        spec.rank, compute_dtype)
+
     def loss_fn(lora_t, frozen, mb):
         p, stream = resolve(frozen)
         # per-(step, micro-batch) dropout key, threaded via the batch
@@ -170,11 +178,19 @@ def main(argv=None) -> int:
             attention_mask=mb["attention_mask"], lora=lora_t,
             compute_dtype=compute_dtype, remat=args.remat,
             lora_dropout=args.lora_dropout, dropout_rng=rng,
-            block_stream=stream, cp_mesh=cp_mesh)
-        # lm_head tied to embeddings; chunked CE avoids [B,S,262k] logits
+            block_stream=stream, cp_mesh=cp_mesh,
+            lora_impl=args.lora_impl)
+        # lm_head tied to embeddings; chunked CE avoids [B,S,262k]
+        # logits — an opt-in "lm_head" adapter rides it as lora_head
+        # (its delta stays chunk-local / in-kernel, DESIGN.md §17),
+        # with --lora_dropout applied to its branch input like every
+        # per-layer site
         return chunked_lm_cross_entropy_sum(
             hidden, p["embed"], mb["labels"], num_chunks=args.loss_chunks,
-            mesh=ce_mesh, sequence_parallel=ce_sp)
+            mesh=ce_mesh, sequence_parallel=ce_sp,
+            lora_head=lora_t["blocks"].get("lm_head"),
+            lora_impl=args.lora_impl,
+            lora_dropout=args.lora_dropout, dropout_rng=rng)
 
     def nll_fn(lora_t, frozen, mb):
         p, stream = resolve(frozen)
@@ -182,10 +198,12 @@ def main(argv=None) -> int:
             config, p, mb["input_ids"],
             attention_mask=mb["attention_mask"], lora=lora_t,
             compute_dtype=compute_dtype, block_stream=stream,
-            cp_mesh=cp_mesh)
+            cp_mesh=cp_mesh, lora_impl=args.lora_impl)
         return chunked_lm_cross_entropy_sum(
             hidden, p["embed"], mb["labels"], num_chunks=args.loss_chunks,
-            mesh=ce_mesh, sequence_parallel=ce_sp)
+            mesh=ce_mesh, sequence_parallel=ce_sp,
+            lora_head=lora_t["blocks"].get("lm_head"),
+            lora_impl=args.lora_impl)
 
     if args.align_dump_dir:
         from mobilefinetuner_tpu.align.dump import run_align_dump

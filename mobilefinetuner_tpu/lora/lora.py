@@ -48,6 +48,13 @@ GPT2_TARGETS = {
     "attn_proj": lambda c: (c.n_embd, c.n_embd),
     "mlp_fc_in": lambda c: (c.n_embd, 4 * c.n_embd),
     "mlp_fc_out": lambda c: (4 * c.n_embd, c.n_embd),
+    # head adapter on the tied lm_head (logits = x @ wte^T): a SINGLE
+    # unstacked site — A [E, r], B [r, V] — applied at the logits
+    # projection. Opt-in (never part of the defaults/presets): its delta
+    # rides the chunked-CE/fused-CE epilogue so [B, S, V] never
+    # materializes in training (DESIGN.md §17); merge is refused (the
+    # table is tied — folding ΔW in would change the input lookup too).
+    "lm_head": lambda c: (c.n_embd, c.vocab_size),
 }
 # column slot of each split target within the fused [E, 3E] c_attn weight
 GPT2_SPLIT_QKV_SLOTS = {"attn_q": 0, "attn_k": 1, "attn_v": 2}
@@ -63,10 +70,16 @@ GEMMA_TARGETS = {
     "gate_proj": lambda c: (c.hidden_size, c.intermediate_size),
     "up_proj": lambda c: (c.hidden_size, c.intermediate_size),
     "down_proj": lambda c: (c.intermediate_size, c.hidden_size),
+    "lm_head": lambda c: (c.hidden_size, c.vocab_size),  # tied embed head
 }
-# Target presets (reference: gemma_lora_injector.h:9-34).
+# targets with ONE site instead of a per-layer stack: A [in, r],
+# B [r, out] (no leading L axis; maybe_lora's ndim checks skip the
+# layer_idx slice for them)
+UNSTACKED_TARGETS = frozenset({"lm_head"})
+# Target presets (reference: gemma_lora_injector.h:9-34). lm_head is
+# opt-in only — "full" keeps the reference's per-layer target set.
 GEMMA_PRESETS = {
-    "full": list(GEMMA_TARGETS),
+    "full": [t for t in GEMMA_TARGETS if t not in UNSTACKED_TARGETS],
     "attn": ["q_proj", "k_proj", "v_proj", "o_proj"],
     "light": ["q_proj", "v_proj"],
 }
@@ -121,6 +134,14 @@ def init_lora(target_dims: Dict[str, Tuple[int, int]], n_layers: int,
     keys = jax.random.split(key, max(len(target_dims), 1))
     for k, name in zip(keys, sorted(target_dims)):
         fan_in, fan_out = target_dims[name]
+        if name in UNSTACKED_TARGETS:  # single site, no layer stack
+            tree[name] = {
+                "A": _init_A(k, (1, fan_in, spec.rank), spec.init,
+                             dtype)[0],
+                "B": jnp.zeros((spec.rank, fan_out), dtype),
+                "scale": jnp.asarray(spec.scale, dtype),
+            }
+            continue
         tree[name] = {
             "A": _init_A(k, (n_layers, fan_in, spec.rank), spec.init, dtype),
             "B": jnp.zeros((n_layers, spec.rank, fan_out), dtype),
@@ -153,20 +174,28 @@ def stack_adapters(loras) -> dict:
     if not loras:
         raise ValueError("stack_adapters needs at least one adapter")
     ref = jax.tree.structure(loras[0])
-    ref_shapes = [x.shape for x in jax.tree.leaves(loras[0])]
+    ref_flat = jax.tree_util.tree_flatten_with_path(loras[0])[0]
     for i, t in enumerate(loras[1:], 1):
         if jax.tree.structure(t) != ref:
+            names = sorted(t.get("blocks", {})) if isinstance(t, dict) \
+                else []
+            ref_names = sorted(loras[0].get("blocks", {}))
             raise ValueError(
                 f"adapter {i} has different targets/structure than "
-                f"adapter 0 (multi-adapter serving needs identical "
-                f"rank + target sets)")
-        shapes = [x.shape for x in jax.tree.leaves(t)]
-        if shapes != ref_shapes:
-            diff = next((a, b) for a, b in zip(ref_shapes, shapes)
-                        if a != b)
-            raise ValueError(
-                f"adapter {i} has different leaf shapes than adapter 0 "
-                f"(e.g. {diff[0]} vs {diff[1]} — rank mismatch?)")
+                f"adapter 0: targets {names} vs {ref_names} "
+                f"(multi-adapter serving needs identical rank + target "
+                f"sets)")
+        flat = jax.tree_util.tree_flatten_with_path(t)[0]
+        for (path, x0), (_, xi) in zip(ref_flat, flat):
+            if x0.shape != xi.shape:
+                # keystr spelling varies across jax versions; build the
+                # path by hand for a stable message
+                leaf = "".join(str(p) for p in path)
+                raise ValueError(
+                    f"adapter {i} leaf {leaf} has shape "
+                    f"{tuple(xi.shape)} but adapter 0 has "
+                    f"{tuple(x0.shape)} (rank/dim mismatch — stacked "
+                    f"serving needs identical shapes)")
     return jax.tree.map(lambda *xs: jnp.stack(xs), *loras)
 
 
@@ -246,6 +275,12 @@ def _merge(params, lora_tree, base_map, sign: float):
     blocks = dict(params["blocks"])
     groups = {g: dict(blocks[g]) for g in {v[0] for v in base_map.values()}}
     for name, entry in lora_tree["blocks"].items():
+        if name not in base_map:
+            raise ValueError(
+                f"target {name!r} cannot be merged into the base "
+                f"weights (the lm_head is TIED to the embedding table — "
+                f"folding its ΔW in would change the input lookup too); "
+                f"serve it dynamically via the lora= argument")
         spec = base_map[name]
         group, leaf = spec[0], spec[1]
         w = groups[group][leaf]

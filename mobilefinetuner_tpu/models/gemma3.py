@@ -93,7 +93,8 @@ from mobilefinetuner_tpu.models.lora_apply import maybe_lora
 
 def _block(c: Gemma3TextConfig, bp, x, padding_mask, masks, ropes,
            is_global, lora_b, i, lora_dropout=0.0, dropout_rng=None,
-           cp_mesh=None, cp_axis="fsdp", collect_kv: bool = False):
+           cp_mesh=None, cp_axis="fsdp", collect_kv: bool = False,
+           lora_impl: str = "auto"):
     """One Gemma-3 block; bp leaves are THIS layer's weights (sliced out of
     the [L, ...] stacks by the scan body); i (traced scalar) indexes the
     still-stacked LoRA leaves, RoPE tables, and masks. collect_kv: also
@@ -108,7 +109,8 @@ def _block(c: Gemma3TextConfig, bp, x, padding_mask, masks, ropes,
         entry = None if lora_b is None else lora_b.get(name)
         return maybe_lora(y, x_in, entry, i, lora_dropout,
                           None if rng is None
-                          else jax.random.fold_in(rng, site))
+                          else jax.random.fold_in(rng, site),
+                          impl=lora_impl)
 
     a = bp["attn"]
 
@@ -197,7 +199,7 @@ def hidden_states(config: Gemma3TextConfig, params, input_ids,
                   offload=None, block_stream=None,
                   collect_layers: bool = False, collect_kv: bool = False,
                   cp_mesh=None, cp_axis: str = "fsdp",
-                  scan_unroll: int = 1):
+                  scan_unroll: int = 1, lora_impl: str = "auto"):
     """offload: optional (plan, shardings) pair matching `params`; offloaded
     block weights stream host->HBM per layer inside the scan (forces remat
     of the block body) — see parallel/offload.py. block_stream: pre-resolved
@@ -254,7 +256,8 @@ def hidden_states(config: Gemma3TextConfig, params, input_ids,
     def body(x, i):
         r = _block(c, slice_layer(i), x, attention_mask, masks, ropes,
                    is_global, lora_b, i, lora_dropout, dropout_rng,
-                   cp_mesh, cp_axis, collect_kv=collect_kv)
+                   cp_mesh, cp_axis, collect_kv=collect_kv,
+                   lora_impl=lora_impl)
         x2, kv = r if collect_kv else (r, None)
         return x2, (kv if collect_kv else (x2 if collect_layers else None))
     if remat or stream is not None:
@@ -278,12 +281,22 @@ def forward(config: Gemma3TextConfig, params, input_ids,
             attention_mask=None, lora=None, compute_dtype=jnp.float32,
             remat: bool = False, lora_dropout: float = 0.0,
             dropout_rng=None, offload=None, cp_mesh=None,
-            cp_axis: str = "fsdp") -> jnp.ndarray:
-    """Logits [B, S, V]; lm_head tied to the embedding table."""
+            cp_axis: str = "fsdp", lora_impl: str = "auto") -> jnp.ndarray:
+    """Logits [B, S, V]; lm_head tied to the embedding table. An
+    "lm_head" adapter entry adds its delta at the logits projection
+    (training paths should prefer the chunked CE's lora_head= instead —
+    this materializes [B, S, V] by construction)."""
     from mobilefinetuner_tpu.parallel.offload import resolve_offload
     params, stream = resolve_offload(params, offload)
     x = hidden_states(config, params, input_ids, attention_mask, lora,
                       compute_dtype, remat, lora_dropout, dropout_rng,
                       block_stream=stream, cp_mesh=cp_mesh,
-                      cp_axis=cp_axis)
-    return x @ params["embed"].astype(compute_dtype).T
+                      cp_axis=cp_axis, lora_impl=lora_impl)
+    logits = x @ params["embed"].astype(compute_dtype).T
+    lora_b = None if lora is None else lora.get("blocks")
+    if lora_b is not None and "lm_head" in lora_b:
+        rng = (None if dropout_rng is None
+               else jax.random.fold_in(dropout_rng, 2000))
+        logits = maybe_lora(logits, x, lora_b["lm_head"], None,
+                            lora_dropout, rng, impl=lora_impl)
+    return logits

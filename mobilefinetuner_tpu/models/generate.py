@@ -41,6 +41,15 @@ from mobilefinetuner_tpu.ops.rope import apply_rope, rope_cos_sin
 NEG_INF = -1e30
 
 
+def _head_lora(logits, h, lora_b, impl):
+    """Apply an optional "lm_head" adapter entry at a logits projection
+    site (decode/prefill shapes are one token per row — the cost model
+    keeps these on the rank-r XLA order)."""
+    if lora_b is None or "lm_head" not in lora_b:
+        return logits
+    return maybe_lora(logits, h, lora_b["lm_head"], None, impl=impl)
+
+
 @dataclasses.dataclass(frozen=True)
 class SampleConfig:
     max_new_tokens: int = 32
@@ -114,7 +123,8 @@ def _col_valid(attention_mask, P, T, t):
 
 def gpt2_generate(config: GPT2Config, params, input_ids, attention_mask,
                   cfg: SampleConfig, rng: Optional[jax.Array] = None,
-                  compute_dtype=jnp.float32, lora=None):
+                  compute_dtype=jnp.float32, lora=None,
+                  lora_impl: str = "auto"):
     """Generate [B, max_new_tokens] ids from LEFT-padded prompts [B, P].
 
     One jittable program: full-forward prefill (collect_kv) + scanned
@@ -148,9 +158,11 @@ def gpt2_generate(config: GPT2Config, params, input_ids, attention_mask,
 
     x, (pk, pv) = gpt2.hidden_states(
         config, params, input_ids, attention_mask, lora=lora,
-        compute_dtype=compute_dtype, collect_kv=True)
-    logits0 = x[:, -1] @ params["wte"].astype(compute_dtype).T  # [B, V]
+        compute_dtype=compute_dtype, collect_kv=True,
+        lora_impl=lora_impl)
     lora_b = None if lora is None else lora.get("blocks")
+    logits0 = x[:, -1] @ params["wte"].astype(compute_dtype).T  # [B, V]
+    logits0 = _head_lora(logits0, x[:, -1], lora_b, lora_impl)
 
     pad_kv = lambda t: jnp.pad(t, ((0, 0), (0, 0), (0, 0), (0, N), (0, 0)))
     kc, vc = pad_kv(pk), pad_kv(pv)                  # [L, B, H, T, D]
@@ -172,7 +184,7 @@ def gpt2_generate(config: GPT2Config, params, input_ids, attention_mask,
 
         def apply_lora(y, x_in, name, i):
             entry = None if lora_b is None else lora_b.get(name)
-            return maybe_lora(y, x_in, entry, i)
+            return maybe_lora(y, x_in, entry, i, impl=lora_impl)
 
         def layer(inner, inp):
             # The [L, B, H, T, D] caches ride the inner CARRY and are
@@ -237,6 +249,7 @@ def gpt2_generate(config: GPT2Config, params, input_ids, attention_mask,
         x = gpt2.layer_norm(x, params["ln_f"]["g"].astype(compute_dtype),
                             params["ln_f"]["b"].astype(compute_dtype), eps)
         logits = x @ params["wte"].astype(compute_dtype).T
+        logits = _head_lora(logits, x, lora_b, lora_impl)
         nxt_raw = _sample(logits.astype(jnp.float32), key, cfg)
         nxt, done = _advance(nxt_raw, done, cfg)
         return (nxt, done, kc, vc), tok
@@ -258,7 +271,8 @@ def gpt2_generate(config: GPT2Config, params, input_ids, attention_mask,
 # ---------------------------------------------------------- Gemma-3 ---------
 
 def _gemma_chunked_prefill(c, params, wb, input_ids, attention_mask,
-                           lora_b, T, compute_dtype, W, apply_rope_fn):
+                           lora_b, T, compute_dtype, W, apply_rope_fn,
+                           lora_impl: str = "auto"):
     """Windowed prefill for LONG prompts: process the prompt in W-token
     windows, each window's attention reading the K/V cache of everything
     before it plus itself — peak score memory is O(W·P) instead of the
@@ -297,7 +311,7 @@ def _gemma_chunked_prefill(c, params, wb, input_ids, attention_mask,
 
     def apply_lora(y, x_in, name, i):
         entry = None if lora_b is None else lora_b.get(name)
-        return maybe_lora(y, x_in, entry, i)
+        return maybe_lora(y, x_in, entry, i, impl=lora_impl)
 
     x_last = None
     for w0 in range(0, P, W):
@@ -375,7 +389,8 @@ def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
                     attention_mask, cfg: SampleConfig,
                     rng: Optional[jax.Array] = None,
                     compute_dtype=jnp.float32, lora=None,
-                    prefill_chunk: Optional[int] = None):
+                    prefill_chunk: Optional[int] = None,
+                    lora_impl: str = "auto"):
     """Gemma-3 generation: GQA cache [L, B, Hkv, T, D], per-layer
     global/local RoPE + sliding-window validity over POSITION ids.
     lora: optional adapter pytree applied dynamically (see
@@ -418,13 +433,16 @@ def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
     if chunked:
         x_last, kc, vc = _gemma_chunked_prefill(
             c, params, wb_pre, input_ids, attention_mask, lora_b, T,
-            compute_dtype, W, apply_rope)
+            compute_dtype, W, apply_rope, lora_impl=lora_impl)
         logits0 = x_last @ params["embed"].astype(compute_dtype).T
+        logits0 = _head_lora(logits0, x_last, lora_b, lora_impl)
     else:
         x, (pk, pv) = gemma3.hidden_states(
             c, params, input_ids, attention_mask, lora=lora,
-            compute_dtype=compute_dtype, collect_kv=True)
+            compute_dtype=compute_dtype, collect_kv=True,
+            lora_impl=lora_impl)
         logits0 = x[:, -1] @ params["embed"].astype(compute_dtype).T
+        logits0 = _head_lora(logits0, x[:, -1], lora_b, lora_impl)
         pad_kv = lambda t: jnp.pad(
             t, ((0, 0), (0, 0), (0, 0), (0, N), (0, 0)))
         kc, vc = pad_kv(pk), pad_kv(pv)
@@ -452,7 +470,7 @@ def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
 
         def apply_lora(y, x_in, name, i):
             entry = None if lora_b is None else lora_b.get(name)
-            return maybe_lora(y, x_in, entry, i)
+            return maybe_lora(y, x_in, entry, i, impl=lora_impl)
 
         def layer(inner, inp):
             # caches ride the inner CARRY (one [1,B,Hkv,1,D] DUS per
@@ -509,6 +527,7 @@ def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
         x = gemma3.rms_norm(x, params["final_norm"].astype(compute_dtype),
                             eps)
         logits = x @ params["embed"].astype(compute_dtype).T
+        logits = _head_lora(logits, x, lora_b, lora_impl)
         nxt_raw = _sample(logits.astype(jnp.float32), key, cfg)
         nxt, done = _advance(nxt_raw, done, cfg)
         return (nxt, done, kc, vc), tok
@@ -555,37 +574,46 @@ def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
 
 
 def gpt2_prefill(config: GPT2Config, params, input_ids, attention_mask,
-                 compute_dtype=jnp.float32, lora=None):
+                 compute_dtype=jnp.float32, lora=None,
+                 lora_impl: str = "auto"):
     """Prefill for serving: [B, P] right-padded prompts -> (next-token
     logits [B, V] f32 at each row's last real position, (k, v) per-layer
     caches [L, B, H, P, D])."""
     params = jax.tree.map(jnp.asarray, params)
     x, (pk, pv) = gpt2.hidden_states(
         config, params, input_ids, attention_mask, lora=lora,
-        compute_dtype=compute_dtype, collect_kv=True)
+        compute_dtype=compute_dtype, collect_kv=True,
+        lora_impl=lora_impl)
     n_real = attention_mask.sum(-1).astype(jnp.int32)
     last = x[jnp.arange(x.shape[0]), n_real - 1]          # [B, E]
     logits = last @ params["wte"].astype(compute_dtype).T
+    lora_b = None if lora is None else lora.get("blocks")
+    logits = _head_lora(logits, last, lora_b, lora_impl)
     return logits.astype(jnp.float32), (pk, pv)
 
 
 def gemma3_prefill(config: Gemma3TextConfig, params, input_ids,
-                   attention_mask, compute_dtype=jnp.float32, lora=None):
+                   attention_mask, compute_dtype=jnp.float32, lora=None,
+                   lora_impl: str = "auto"):
     """Gemma-3 serving prefill (see gpt2_prefill)."""
     params = jax.tree.map(jnp.asarray, params)
     x, (pk, pv) = gemma3.hidden_states(
         config, params, input_ids, attention_mask, lora=lora,
-        compute_dtype=compute_dtype, collect_kv=True)
+        compute_dtype=compute_dtype, collect_kv=True,
+        lora_impl=lora_impl)
     n_real = attention_mask.sum(-1).astype(jnp.int32)
     last = x[jnp.arange(x.shape[0]), n_real - 1]
     logits = last @ params["embed"].astype(compute_dtype).T
+    lora_b = None if lora is None else lora.get("blocks")
+    logits = _head_lora(logits, last, lora_b, lora_impl)
     return logits.astype(jnp.float32), (pk, pv)
 
 
 def gpt2_decode_step_paged(config: GPT2Config, params, pool_k, pool_v,
                            tok, pos, tbl, lora=None,
                            compute_dtype=jnp.float32,
-                           attn_impl: str = "auto"):
+                           attn_impl: str = "auto",
+                           lora_impl: str = "auto"):
     """One continuous-batching decode step over a block-paged KV pool.
 
     pool_k/pool_v [NB, L, H, bT, D]; tok [S] the token each slot feeds;
@@ -623,7 +651,7 @@ def gpt2_decode_step_paged(config: GPT2Config, params, pool_k, pool_v,
 
     def apply_lora(y, x_in, name, i):
         entry = None if lora_b is None else lora_b.get(name)
-        return maybe_lora(y, x_in, entry, i)
+        return maybe_lora(y, x_in, entry, i, impl=lora_impl)
 
     def layer(inner, inp):
         x, pk, pv = inner
@@ -661,13 +689,15 @@ def gpt2_decode_step_paged(config: GPT2Config, params, pool_k, pool_v,
     x = gpt2.layer_norm(x, params["ln_f"]["g"].astype(compute_dtype),
                         params["ln_f"]["b"].astype(compute_dtype), eps)
     logits = x @ params["wte"].astype(compute_dtype).T
+    logits = _head_lora(logits, x, lora_b, lora_impl)
     return logits.astype(jnp.float32), pool_k, pool_v
 
 
 def gemma3_decode_step_paged(config: Gemma3TextConfig, params, pool_k,
                              pool_v, tok, pos, tbl, lora=None,
                              compute_dtype=jnp.float32,
-                             attn_impl: str = "auto"):
+                             attn_impl: str = "auto",
+                             lora_impl: str = "auto"):
     """Gemma-3 paged decode step (see gpt2_decode_step_paged): GQA pool
     [NB, L, Hkv, bT, D], per-layer global/local RoPE, sliding-window
     validity over absolute positions (serve sequences are unpadded, so
@@ -704,7 +734,7 @@ def gemma3_decode_step_paged(config: Gemma3TextConfig, params, pool_k,
 
     def apply_lora(y, x_in, name, i):
         entry = None if lora_b is None else lora_b.get(name)
-        return maybe_lora(y, x_in, entry, i)
+        return maybe_lora(y, x_in, entry, i, impl=lora_impl)
 
     def layer(inner, inp):
         x, pk, pv = inner
@@ -742,6 +772,7 @@ def gemma3_decode_step_paged(config: Gemma3TextConfig, params, pool_k,
     x = gemma3.rms_norm(x, params["final_norm"].astype(compute_dtype),
                         eps)
     logits = x @ params["embed"].astype(compute_dtype).T
+    logits = _head_lora(logits, x, lora_b, lora_impl)
     return logits.astype(jnp.float32), pool_k, pool_v
 
 

@@ -85,7 +85,8 @@ def init_params(config: GPT2Config, key: jax.Array,
 
 def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx,
            lora_dropout=0.0, dropout_rng=None, cp_mesh=None,
-           cp_axis="fsdp", collect_kv: bool = False):
+           cp_axis="fsdp", collect_kv: bool = False,
+           lora_impl: str = "auto"):
     """One pre-LN transformer block. bp leaves are THIS layer's weights
     (already sliced out of the [L, ...] stacks by the scan body); layer_idx
     (traced scalar) indexes the still-stacked LoRA leaves and salts
@@ -103,7 +104,8 @@ def _block(config: GPT2Config, bp, x, padding_mask, lora_b, layer_idx,
         entry = None if lora_b is None else lora_b.get(name)
         return maybe_lora(y, x_in, entry, layer_idx, lora_dropout,
                           None if rng is None
-                          else jax.random.fold_in(rng, site))
+                          else jax.random.fold_in(rng, site),
+                          impl=lora_impl)
 
     # named scopes label the phase in profiler traces AND compiled-HLO
     # op metadata (asserted by tests/test_telemetry.py; DESIGN.md §13)
@@ -164,7 +166,8 @@ def hidden_states(config: GPT2Config, params, input_ids,
                   lora_dropout: float = 0.0, dropout_rng=None,
                   offload=None, block_stream=None,
                   collect_layers: bool = False, collect_kv: bool = False,
-                  cp_mesh=None, cp_axis: str = "fsdp"):
+                  cp_mesh=None, cp_axis: str = "fsdp",
+                  lora_impl: str = "auto"):
     """Final-LN hidden states [B, S, E] (pre lm_head).
 
     offload: optional (plan, shardings) pytree pair matching `params`
@@ -211,7 +214,7 @@ def hidden_states(config: GPT2Config, params, input_ids,
     def body(x, i):
         r = _block(config, slice_layer(i), x, padding_mask, lora_b, i,
                    lora_dropout, dropout_rng, cp_mesh, cp_axis,
-                   collect_kv=collect_kv)
+                   collect_kv=collect_kv, lora_impl=lora_impl)
         x2, kv = r if collect_kv else (r, None)
         return x2, (kv if collect_kv else (x2 if collect_layers else None))
     if remat or stream is not None:
@@ -230,20 +233,28 @@ def hidden_states(config: GPT2Config, params, input_ids,
 def forward(config: GPT2Config, params, input_ids, attention_mask=None,
             lora=None, compute_dtype=jnp.float32, remat: bool = False,
             lora_dropout: float = 0.0, dropout_rng=None,
-            offload=None, cp_mesh=None,
-            cp_axis: str = "fsdp") -> jnp.ndarray:
+            offload=None, cp_mesh=None, cp_axis: str = "fsdp",
+            lora_impl: str = "auto") -> jnp.ndarray:
     """Logits [B, S, V]. Tied lm_head: x @ wte^T (gpt2_model.cpp:421-440).
 
     The reference caches wte^T when embeddings are frozen (SURVEY.md
     §2.12.5); under XLA the transpose is a free layout change, so no cache.
+    An "lm_head" adapter entry (lora/lora.py UNSTACKED_TARGETS) adds its
+    delta at the logits projection.
     """
     from mobilefinetuner_tpu.parallel.offload import resolve_offload
     params, stream = resolve_offload(params, offload)
     x = hidden_states(config, params, input_ids, attention_mask, lora,
                       compute_dtype, remat, lora_dropout, dropout_rng,
                       block_stream=stream, cp_mesh=cp_mesh,
-                      cp_axis=cp_axis)
+                      cp_axis=cp_axis, lora_impl=lora_impl)
     logits = x @ params["wte"].astype(compute_dtype).T
+    lora_b = None if lora is None else lora.get("blocks")
+    if lora_b is not None and "lm_head" in lora_b:
+        rng = (None if dropout_rng is None
+               else jax.random.fold_in(dropout_rng, 2000))
+        logits = maybe_lora(logits, x, lora_b["lm_head"], None,
+                            lora_dropout, rng, impl=lora_impl)
     return logits
 
 

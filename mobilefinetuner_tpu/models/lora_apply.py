@@ -12,36 +12,234 @@ along a LEADING adapter axis (lora.stack_adapters + assign_adapters);
 each row's delta uses its own adapter's factors via a per-row gather —
 N adapters serve one batch without materializing merged weight copies,
 and the models stay unchanged (the entry itself carries the routing).
+
+Implementation selector (DESIGN.md §17) — `impl`, mirroring the flash
+backward's `bwd_impl=auto|merged|split` discipline:
+
+  naive  the parity ORACLE: fixed (x@A)@B contraction, per-row adapter
+         gather on the ids-routed path, pure XLA. Since round 12 the
+         oracle itself accumulates the rank-r bottleneck in f32
+         (`preferred_element_type`) with the A/B/scale casts hoisted —
+         the old per-call bf16-accumulate chain lost ~2 decimal digits
+         at S=2048 (pinned by tests/test_lora.py).
+  fused  shape-aware compute graph: contraction order picked per call
+         site by the FLOPs+bytes cost model below, the k-adapter
+         ids-routed path switched between the per-row GATHER order and
+         the DENSE all-k + one-hot-route order by the same model, and
+         the delta folded into a Pallas epilogue pass
+         (ops/lora_fused.lora_epilogue) at eligible sites so the
+         [N, d_out] delta never round-trips HBM. Ineligible sites fall
+         back to the cost-model XLA order — same numerics contract.
+  auto   resolve per call site: `fused` where the epilogue kernel is
+         eligible AND the delta is large enough to be memory-bound
+         (resolve_lora_impl), else `naive`. Off-TPU auto is always
+         naive. The resolution is a pure function of static shapes, so
+         it happens once per traced call site; the LoRA CLIs log the
+         per-target resolution string into the telemetry run_start
+         manifest (impl_summary).
+
+Contraction-order cost model (Run LoRA Run, PAPERS.md): with rank
+r ≪ d, (x@A)@B costs 2·N·r·(d_in+d_out) FLOPs while x@(A@B) pays the
+merged [d_in, d_out] product — 2·r·d_in·d_out + 2·N·d_in·d_out. Merged
+could only win when r·(d_in+d_out) > d_in·d_out, i.e. r above the
+harmonic mean of the dims — never at LoRA ranks; pick_order ASSERTS
+that instead of silently materializing a [d, d] product.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+LORA_IMPLS = ("auto", "naive", "fused")
 
-def _multi_lora(y, x, entry, layer_idx, dropout, rng):
+# v5e machine balance: ~197e12 bf16 FLOP/s over ~819 GB/s HBM — the
+# FLOPs-per-byte equivalence the cost model uses to weigh the two
+# resources on one axis (the exact chip hardly matters: every TPU
+# generation sits within 2x of this ratio, and the decisions below are
+# order-of-magnitude ones).
+FLOPS_PER_BYTE = 240.0
+
+# auto engages the fused epilogue only when the delta it eliminates is
+# at least this many bytes — below it the tensor lives in registers/
+# cache through XLA fusion anyway and the kernel's per-tile loop
+# overhead is all cost (the fused-CE kernel history, DESIGN.md §5a).
+FUSED_MIN_DELTA_BYTES = 1 << 20
+
+
+def validate_lora_impl(impl: str) -> str:
+    if impl not in LORA_IMPLS:
+        raise ValueError(
+            f"lora_impl must be one of {'/'.join(LORA_IMPLS)}, "
+            f"got {impl!r}")
+    return impl
+
+
+def order_costs(n_tok: int, d_in: int, d_out: int,
+                r: int, itemsize: int = 2) -> Dict[str, float]:
+    """Byte-equivalent cost of each single-adapter contraction order
+    (FLOPs/FLOPS_PER_BYTE + HBM bytes moved beyond the unavoidable
+    x/y traffic). Exposed for tests and DESIGN.md §17."""
+    # (x@A)@B: two rank-r matmuls; extra traffic = A, B, and the [N, r]
+    # bottleneck written+read between them (zero when fused).
+    xa_b = (2.0 * n_tok * r * (d_in + d_out) / FLOPS_PER_BYTE
+            + (r * (d_in + d_out) + 2 * n_tok * r) * itemsize)
+    # x@(A@B): materialize the merged [d_in, d_out] product, then a full
+    # dense matmul — only conceivably profitable when r exceeds the
+    # harmonic mean of the dims.
+    x_ab = ((2.0 * r * d_in * d_out + 2.0 * n_tok * d_in * d_out)
+            / FLOPS_PER_BYTE
+            + (r * (d_in + d_out) + d_in * d_out) * itemsize)
+    return {"xA_B": xa_b, "x_AB": x_ab}
+
+
+def pick_order(n_tok: int, d_in: int, d_out: int, r: int,
+               itemsize: int = 2) -> str:
+    """Single-adapter contraction order for this call site: always
+    (x@A)@B. Merged x@(A@B) could only pay when the rank-r factor pair
+    does MORE work than the dense product it expands to — i.e. when
+    r·(d_in+d_out) > d_in·d_out, rank above the harmonic mean of the
+    dims. That never holds at LoRA ranks, so instead of implementing a
+    merged path no shape reaches, this ASSERTS the criterion (a
+    [d_in, d_out] temp at every adapter site would be a silent OOM
+    machine; a rank that big should be merged offline via
+    lora.merge_gpt2/merge_gemma3)."""
+    if r * (d_in + d_out) > d_in * d_out:
+        raise AssertionError(
+            f"r={r} exceeds the harmonic-mean bound for d_in={d_in}, "
+            f"d_out={d_out} (r*(d_in+d_out)={r * (d_in + d_out)} > "
+            f"{d_in * d_out}): the factored form does more work than "
+            f"the dense product — merge the adapter instead "
+            f"(lora.merge_gpt2/merge_gemma3)")
+    return "xA_B"
+
+
+def multi_order_costs(n_rows: int, n_tok: int, d_in: int, d_out: int,
+                      r: int, k: int,
+                      itemsize: int = 2) -> Dict[str, float]:
+    """Byte-equivalent cost of the two ids-routed k-adapter orders.
+
+    gather  per-row A/B gather ([n_rows, d_in, r] + [n_rows, r, d_out]
+            copies through HBM), then two batched rank-r matmuls.
+    dense   compute ALL k adapters' deltas (k× the rank-r FLOPs and a
+            [k, n_tok, d_out] f32 intermediate) and one-hot-route rows —
+            no per-row factor copies; wins only when n_tok is tiny
+            (decode: one token per slot) and k modest.
+    """
+    per_pair = r * (d_in + d_out)
+    gather = (2.0 * n_tok * per_pair / FLOPS_PER_BYTE
+              + n_rows * per_pair * itemsize)
+    dense = (2.0 * k * n_tok * per_pair / FLOPS_PER_BYTE
+             + k * per_pair * itemsize          # read the bank once
+             + k * n_tok * d_out * 4)           # routed f32 intermediate
+    return {"gather": gather, "dense": dense}
+
+
+def resolve_multi_order(n_rows: int, n_tok: int, d_in: int, d_out: int,
+                        r: int, k: int, itemsize: int = 2) -> str:
+    costs = multi_order_costs(n_rows, n_tok, d_in, d_out, r, k, itemsize)
+    return "dense" if costs["dense"] < costs["gather"] else "gather"
+
+
+def resolve_lora_impl(n_tok: int, d_in: int, d_out: int, r: int,
+                      itemsize: int = 2,
+                      backend: Optional[str] = None) -> str:
+    """The `auto` rule for ONE call site (static shapes -> resolved once
+    per trace): `fused` when the Pallas epilogue is shape-eligible on a
+    TPU backend and the eliminated [n_tok, d_out] delta round-trip is
+    big enough to be memory-bound, else `naive`. Kept as one function so
+    the models, the serve engine, and the manifest summary all resolve
+    through the same gate (the acceptance bar: auto never selects an
+    ineligible fused site)."""
+    from mobilefinetuner_tpu.ops.lora_fused import lora_epilogue_eligible
+    if backend is None:
+        backend = jax.default_backend()
+    if backend != "tpu":
+        return "naive"
+    if not lora_epilogue_eligible(n_tok, d_out, r, itemsize):
+        return "naive"
+    if n_tok * d_out * itemsize < FUSED_MIN_DELTA_BYTES:
+        return "naive"
+    return "fused"
+
+
+def impl_summary(target_dims: Dict[str, Tuple[int, int]], n_tok: int,
+                 r: int, impl: str, itemsize: int = 2,
+                 backend: Optional[str] = None) -> str:
+    """'target=impl,...' — the per-call-site resolution of `auto` for
+    the run's dominant shapes, logged into the telemetry run_start
+    manifest by the LoRA CLIs (forced impls summarize as themselves)."""
+    validate_lora_impl(impl)
+    parts = []
+    for name in sorted(target_dims):
+        d_in, d_out = target_dims[name]
+        site = impl
+        if impl == "auto":
+            site = resolve_lora_impl(n_tok, d_in, d_out, r, itemsize,
+                                     backend=backend)
+        parts.append(f"{name}={site}")
+    return ",".join(parts)
+
+
+def _finish(y, scale, delta):
+    """y + scale·delta with the accumulation kept f32 until the single
+    cast back to y's dtype (scale arrives f32, delta f32-accumulated)."""
+    return y + (scale * delta).astype(y.dtype)
+
+
+def _multi_lora(y, x, entry, layer_idx, dropout, rng, impl):
     """Per-row adapter routing: A [N,(L,)in,r], B [N,(L,)r,out],
-    scale [N], ids [B] -> row b's delta uses adapter ids[b]."""
+    scale [N], ids [B] -> row b's delta uses adapter ids[b]. Order
+    (gather vs dense) picked by the cost model under fused/auto; naive
+    pins the per-row gather as the oracle."""
     from mobilefinetuner_tpu.ops.dropout import inverted_dropout
     ids = entry["ids"]
     A, B = entry["A"], entry["B"]
     if layer_idx is not None and A.ndim == 4:
         A, B = A[:, layer_idx], B[:, layer_idx]
-    A_rows = A[ids].astype(x.dtype)                  # [B, in, r]
-    B_rows = B[ids].astype(x.dtype)                  # [B, r, out]
-    xb = inverted_dropout(x, dropout, rng)
-    delta = jnp.einsum("b...i,bir->b...r", xb, A_rows)
-    delta = jnp.einsum("b...r,bro->b...o", delta, B_rows)
+    A = A.astype(x.dtype)                            # [k, in, r] (hoisted)
+    B = B.astype(x.dtype)                            # [k, r, out]
+    k, d_in, r = A.shape
+    d_out = B.shape[-1]
+    n_rows = ids.shape[0]
+    n_tok = y.size // d_out
     scale = jax.lax.stop_gradient(
-        jnp.asarray(entry["scale"]).astype(y.dtype))[ids]   # [B]
-    return y + scale.reshape((-1,) + (1,) * (y.ndim - 1)) * delta
+        jnp.asarray(entry["scale"]).astype(jnp.float32))[ids]   # [B]
+    scale = scale.reshape((-1,) + (1,) * (y.ndim - 1))
+    xb = inverted_dropout(x, dropout, rng)
+    # auto follows the module contract (off-TPU auto is always naive —
+    # the cost-model constants are TPU machine balance); an explicit
+    # `fused` exercises the cost-model order on any backend (the parity
+    # tests pin the dense order against the gather oracle on CPU)
+    order = "gather"
+    if impl == "fused" or (impl == "auto"
+                           and jax.default_backend() == "tpu"):
+        order = resolve_multi_order(n_rows, n_tok, d_in, d_out, r, k,
+                                    x.dtype.itemsize)
+    if order == "dense":
+        # all-k compute + one-hot routing: reads the bank once instead
+        # of gathering [n_rows, in, r]+[n_rows, r, out] factor copies —
+        # the decode-shape win (n_tok == n_rows == slots)
+        route = jax.nn.one_hot(ids, k, dtype=jnp.float32)        # [B, k]
+        t1 = jnp.einsum("b...i,kir->kb...r", xb, A,
+                        preferred_element_type=jnp.float32)
+        t2 = jnp.einsum("kb...r,kro->kb...o", t1.astype(x.dtype), B,
+                        preferred_element_type=jnp.float32)
+        delta = jnp.einsum("kb...o,bk->b...o", t2, route)
+    else:
+        A_rows = A[ids]                              # [B, in, r]
+        B_rows = B[ids]                              # [B, r, out]
+        t1 = jnp.einsum("b...i,bir->b...r", xb, A_rows,
+                        preferred_element_type=jnp.float32)
+        delta = jnp.einsum("b...r,bro->b...o", t1.astype(x.dtype),
+                           B_rows, preferred_element_type=jnp.float32)
+    return _finish(y, scale, delta)
 
 
 def maybe_lora(y, x, lora_entry, layer_idx=None, dropout: float = 0.0,
-               rng: Optional[jax.Array] = None):
+               rng: Optional[jax.Array] = None, impl: str = "auto"):
     """Add the LoRA delta to y if an entry exists.
 
     lora_entry: {"A": [in,r] or [L,in,r], "B": [r,out] or [L,r,out],
@@ -49,17 +247,38 @@ def maybe_lora(y, x, lora_entry, layer_idx=None, dropout: float = 0.0,
     scalar under lax.scan). dropout>0 with rng!=None enables train-mode
     inverted dropout on the branch input. An entry with an "ids" leaf is
     a MULTI-adapter stack routed per batch row (see module docstring).
+    impl: auto|naive|fused (module docstring); both matmuls accumulate
+    f32 via preferred_element_type on EVERY impl, with the A/B/scale
+    casts hoisted to one site.
     """
     if lora_entry is None:
         return y
+    validate_lora_impl(impl)
     if "ids" in lora_entry:
-        return _multi_lora(y, x, lora_entry, layer_idx, dropout, rng)
+        return _multi_lora(y, x, lora_entry, layer_idx, dropout, rng,
+                           impl)
     A, B = lora_entry["A"], lora_entry["B"]
     if layer_idx is not None and A.ndim == 3:
         A, B = A[layer_idx], B[layer_idx]
+    A = A.astype(x.dtype)                            # [in, r]  (hoisted)
+    B = B.astype(x.dtype)                            # [r, out]
+    d_in, r = A.shape
+    d_out = B.shape[-1]
+    n_tok = y.size // d_out
+    scale = jax.lax.stop_gradient(
+        jnp.asarray(lora_entry["scale"]).astype(jnp.float32))
+    if impl == "auto":
+        impl = resolve_lora_impl(n_tok, d_in, d_out, r, x.dtype.itemsize)
+    pick_order(n_tok, d_in, d_out, r, x.dtype.itemsize)  # asserts xA_B
     from mobilefinetuner_tpu.ops.dropout import inverted_dropout
     xb = inverted_dropout(x, dropout, rng)
-    delta = (xb @ A.astype(x.dtype)) @ B.astype(x.dtype)
-    scale = jax.lax.stop_gradient(
-        jnp.asarray(lora_entry["scale"]).astype(y.dtype))
-    return y + scale * delta
+    xa = jnp.einsum("...i,ir->...r", xb, A,
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+    if impl == "fused":
+        from mobilefinetuner_tpu.ops.lora_fused import (
+            lora_epilogue_add, lora_epilogue_eligible)
+        if lora_epilogue_eligible(n_tok, d_out, r, x.dtype.itemsize):
+            return lora_epilogue_add(y, xa, B, scale)
+    delta = jnp.einsum("...r,ro->...o", xa, B,
+                       preferred_element_type=jnp.float32)
+    return _finish(y, scale, delta)

@@ -58,7 +58,7 @@ _VMEM_BUDGET = 14 * 2 ** 20   # leave headroom under the 16 MB scoped limit
 
 
 def pick_block_v(V: int, R: int = 512, H: int = 1152,
-                 itemsize: int = 2) -> Optional[int]:
+                 itemsize: int = 2, r_pad: int = 0) -> Optional[int]:
     """Largest lane-aligned vocab tile dividing V that fits the VMEM
     budget (None = ineligible). Resident per grid step of the dh kernel
     (the largest of the three): the [R, H] hidden block in the STORAGE
@@ -68,11 +68,19 @@ def pick_block_v(V: int, R: int = 512, H: int = 1152,
     accumulator scratch AND output block. Budget calibrated on v5e:
     (R=1024, H=640, bv=1024) counts 13.4 MB here, compiles and runs;
     bv=2048 at the same shape counts 20.2 MB (actual scoped allocation
-    failed at 16.8 MB) and is rejected."""
-    fixed = R * H * itemsize + 2 * R * H * 4 + 6 * R * 4
+    failed at 16.8 MB) and is rejected.
+
+    r_pad > 0 is the head-adapter epilogue variant (DESIGN.md §17): it
+    adds the [R, r_pad] xa slab plus the dh kernel's [R, r_pad] f32 axa
+    accumulator scratch AND dxa output block (fixed — the same
+    scratch+output double-count as the base dh accounting above), and
+    the double-buffered [BV, r_pad] bt tile + [BV, r_pad] f32 dbt output
+    (per tile)."""
+    fixed = R * H * itemsize + 2 * R * H * 4 + 6 * R * 4 \
+        + r_pad * (R * itemsize + 2 * R * 4)
+    per_bv = 2 * H * itemsize + R * 4 + r_pad * (2 * itemsize + 4)
     for bv in (2048, 1024, 512, 256, 128):
-        if V % bv == 0 and \
-                fixed + 2 * bv * H * itemsize + R * bv * 4 <= _VMEM_BUDGET:
+        if V % bv == 0 and fixed + bv * per_bv <= _VMEM_BUDGET:
             return bv
     return None
 
@@ -82,6 +90,20 @@ def fused_ce_eligible(R: int, V: int, H: int = 1152,
     """Rows must be sublane-aligned; V must tile lane-aligned within the
     VMEM budget for this (R, H, storage itemsize)."""
     return R % 8 == 0 and pick_block_v(V, R, H, itemsize) is not None
+
+
+# rank dim of the head-adapter operands padded to one lane tile (the
+# same alignment trick as ops/lora_fused.R_PAD; r <= 128 covers every
+# LoRA rank in this tree)
+LORA_R_PAD = 128
+
+
+def fused_ce_lora_eligible(R: int, V: int, H: int = 1152, r: int = 8,
+                           itemsize: int = 2) -> bool:
+    """Eligibility of the head-adapter epilogue variant: the base gate
+    plus rank ≤ the lane pad and the xa/bt slabs fitting the budget."""
+    return (R % 8 == 0 and 0 < r <= LORA_R_PAD
+            and pick_block_v(V, R, H, itemsize, LORA_R_PAD) is not None)
 
 
 def _pick_block_v_or_raise(V, R, H, itemsize) -> int:
@@ -306,15 +328,332 @@ def _vjp_bwd(res, cts):
 fused_ce_rows.defvjp(_vjp_fwd, _vjp_bwd)
 
 
-def fused_ce_nll_sum(hidden, lm_head_w, labels,
-                     ignore_index: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+# -------------------- head-adapter epilogue variant --------------------------
+#
+# LoRA on the lm_head (DESIGN.md §17): logits = h @ Wᵀ + scale·(h@A)@B.
+# The rank-r bottleneck xa = scale·(h@A) [R, r] is computed by XLA (it is
+# tiny); the [R, V] delta — hundreds of MB at Gemma's 262k vocab — folds
+# into this kernel's vocab-tile loop instead of ever being materialized:
+# each grid step adds xa @ bt_tileᵀ (bt = Bᵀ [V, r], row-tiled like W) to
+# its logits tile in VMEM. The backward mirrors the base kernels: the dh
+# pass also accumulates dxa = Σ coef @ bt_tile in a [R, r] scratch, and
+# the dw pass additionally writes its [BV, r] tile of dbt = coefᵀ @ xa.
+# The rank dim is zero-padded to LORA_R_PAD lanes (see ops/lora_fused).
+
+
+def _lora_logits(logits, xa, bt):
+    return logits + jax.lax.dot_general(
+        xa, bt, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _fwd_kernel_lora(h_ref, w_ref, xa_ref, bt_ref, lab_ref, lse_ref,
+                     gold_ref, m_sc, s_sc, g_sc, *, block_v, n_tiles):
+    vi = pl.program_id(0)
+    col0 = vi * block_v
+
+    @pl.when(vi == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, -jnp.inf)
+        s_sc[:] = jnp.zeros_like(s_sc)
+        g_sc[:] = jnp.zeros_like(g_sc)
+
+    h = h_ref[:]
+    w = w_ref[:]
+    logits = jax.lax.dot_general(
+        h, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    logits = _lora_logits(logits, xa_ref[:], bt_ref[:])
+    R, BV = logits.shape
+    cols = jax.lax.broadcasted_iota(jnp.int32, (R, BV), 1) + col0
+    hit = cols == lab_ref[:]
+    m = m_sc[:]
+    m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+    s_sc[:] = s_sc[:] * jnp.exp(m - m_new) \
+        + jnp.sum(jnp.exp(logits - m_new), axis=-1, keepdims=True)
+    m_sc[:] = m_new
+    g_sc[:] = g_sc[:] + jnp.sum(jnp.where(hit, logits, 0.0), axis=-1,
+                                keepdims=True)
+
+    @pl.when(vi == n_tiles - 1)
+    def _fin():
+        lse_ref[:] = m_sc[:] + jnp.log(s_sc[:])
+        gold_ref[:] = g_sc[:]
+
+
+def _dh_kernel_lora(h_ref, w_ref, xa_ref, bt_ref, lab_ref, lse_ref,
+                    dlse_ref, dgold_ref, dh_ref, dxa_ref, acc_sc, axa_sc,
+                    *, block_v, n_tiles):
+    vi = pl.program_id(0)
+    col0 = vi * block_v
+
+    @pl.when(vi == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        axa_sc[:] = jnp.zeros_like(axa_sc)
+
+    h = h_ref[:]
+    w = w_ref[:]
+    bt = bt_ref[:]
+    logits = jax.lax.dot_general(
+        h, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    logits = _lora_logits(logits, xa_ref[:], bt)
+    R, BV = logits.shape
+    p = jnp.exp(logits - lse_ref[:])
+    cols = jax.lax.broadcasted_iota(jnp.int32, (R, BV), 1) + col0
+    hit = cols == lab_ref[:]
+    coef = dlse_ref[:] * p + jnp.where(hit, dgold_ref[:], 0.0)
+    coef_s = coef.astype(w.dtype)
+    acc_sc[:] = acc_sc[:] + jax.lax.dot_general(
+        coef_s, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)         # [R, H]
+    axa_sc[:] = axa_sc[:] + jax.lax.dot_general(
+        coef_s, bt, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)         # [R, r_pad]
+
+    @pl.when(vi == n_tiles - 1)
+    def _fin():
+        dh_ref[:] = acc_sc[:]
+        dxa_ref[:] = axa_sc[:]
+
+
+def _dw_kernel_lora(h_ref, w_ref, xa_ref, bt_ref, lab_ref, lse_ref,
+                    dlse_ref, dgold_ref, dw_ref, dbt_ref, *, block_v):
+    vi = pl.program_id(0)
+    col0 = vi * block_v
+    h = h_ref[:]
+    w = w_ref[:]
+    xa = xa_ref[:]
+    logits = jax.lax.dot_general(
+        h, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    logits = _lora_logits(logits, xa, bt_ref[:])
+    R, BV = logits.shape
+    p = jnp.exp(logits - lse_ref[:])
+    cols = jax.lax.broadcasted_iota(jnp.int32, (R, BV), 1) + col0
+    hit = cols == lab_ref[:]
+    coef = dlse_ref[:] * p + jnp.where(hit, dgold_ref[:], 0.0)
+    dw_ref[:] = jax.lax.dot_general(
+        coef.astype(h.dtype), h, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)         # [BV, H]
+    dbt_ref[:] = jax.lax.dot_general(
+        coef.astype(xa.dtype), xa, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)         # [BV, r_pad]
+
+
+def _pad_lora(xa, bt, dtype):
+    rp = LORA_R_PAD - xa.shape[1]
+    return (jnp.pad(xa.astype(dtype), ((0, 0), (0, rp))),
+            jnp.pad(bt.astype(dtype), ((0, 0), (0, rp))))
+
+
+def _pick_lora_bv_or_raise(V, R, H, itemsize) -> int:
+    bv = pick_block_v(V, R, H, itemsize, LORA_R_PAD)
+    if bv is None:
+        raise ValueError(
+            f"fused CE lora kernel ineligible for R={R}, V={V}, H={H}, "
+            f"itemsize={itemsize} (check fused_ce_lora_eligible before "
+            f"calling)")
+    return bv
+
+
+def _fwd_lora(h2, w, xa, bt, labels2):
+    R, H = h2.shape
+    V = w.shape[0]
+    bv = _pick_lora_bv_or_raise(V, R, H, h2.dtype.itemsize)
+    n = V // bv
+    xa_p, bt_p = _pad_lora(xa, bt, h2.dtype)
+    kernel = functools.partial(_fwd_kernel_lora, block_v=bv, n_tiles=n)
+    row = lambda vi: (0, 0)
+    call = pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((R, H), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((bv, H), lambda vi: (vi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, LORA_R_PAD), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((bv, LORA_R_PAD), lambda vi: (vi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, 1), jnp.float32),
+        ],
+        **tpu_call_params("arbitrary"),
+    )
+    with jax.named_scope("loss"), jax.named_scope("fused_ce_lora_fwd"):
+        lse, gold = call(h2, w, xa_p, bt_p, labels2)
+    return lse[:, 0], gold[:, 0]
+
+
+def _bwd_dh_lora(h2, w, xa, bt, labels2, lse2, dlse2, dgold2):
+    R, H = h2.shape
+    V = w.shape[0]
+    bv = _pick_lora_bv_or_raise(V, R, H, h2.dtype.itemsize)
+    n = V // bv
+    xa_p, bt_p = _pad_lora(xa, bt, h2.dtype)
+    kernel = functools.partial(_dh_kernel_lora, block_v=bv, n_tiles=n)
+    row = lambda vi: (0, 0)
+    call = pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((R, H), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((bv, H), lambda vi: (vi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, LORA_R_PAD), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((bv, LORA_R_PAD), lambda vi: (vi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((R, H), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, LORA_R_PAD), row, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, H), jnp.float32),
+            jax.ShapeDtypeStruct((R, LORA_R_PAD), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((R, H), jnp.float32),
+            pltpu.VMEM((R, LORA_R_PAD), jnp.float32),
+        ],
+        **tpu_call_params("arbitrary"),
+    )
+    with jax.named_scope("loss"), jax.named_scope("fused_ce_lora_bwd_dh"):
+        dh, dxa_p = call(h2, w, xa_p, bt_p, labels2, lse2, dlse2, dgold2)
+    return dh, dxa_p[:, :xa.shape[1]]
+
+
+def _bwd_dw_lora(h2, w, xa, bt, labels2, lse2, dlse2, dgold2):
+    R, H = h2.shape
+    V = w.shape[0]
+    bv = _pick_lora_bv_or_raise(V, R, H, h2.dtype.itemsize)
+    n = V // bv
+    xa_p, bt_p = _pad_lora(xa, bt, h2.dtype)
+    kernel = functools.partial(_dw_kernel_lora, block_v=bv)
+    row = lambda vi: (0, 0)
+    call = pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((R, H), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((bv, H), lambda vi: (vi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, LORA_R_PAD), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((bv, LORA_R_PAD), lambda vi: (vi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, 1), row, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((bv, H), lambda vi: (vi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bv, LORA_R_PAD), lambda vi: (vi, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((V, H), jnp.float32),
+            jax.ShapeDtypeStruct((V, LORA_R_PAD), jnp.float32),
+        ],
+        **tpu_call_params("arbitrary"),
+    )
+    with jax.named_scope("loss"), jax.named_scope("fused_ce_lora_bwd_dw"):
+        dw, dbt_p = call(h2, w, xa_p, bt_p, labels2, lse2, dlse2, dgold2)
+    return dw, dbt_p[:, :xa.shape[1]]
+
+
+@jax.custom_vjp
+def fused_ce_rows_lora(hidden2d, w, labels, xa,
+                       bt) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """fused_ce_rows with the head-adapter delta folded into the tile
+    loop: logits_tile = h @ w_tileᵀ + xa @ bt_tileᵀ. xa [R, r] is the
+    SCALE-FOLDED rank-r bottleneck (scale·(h@A), compute dtype); bt = Bᵀ
+    [V, r]. Differentiable in hidden2d, w, xa, and bt — the A/B/scale
+    chain outside composes through plain XLA autodiff."""
+    lse, gold = _fwd_lora(hidden2d, w, xa, bt, labels.reshape(-1, 1))
+    return lse, gold
+
+
+def _vjp_fwd_lora(hidden2d, w, labels, xa, bt):
+    labels2 = labels.reshape(-1, 1)
+    lse, gold = _fwd_lora(hidden2d, w, xa, bt, labels2)
+    return (lse, gold), (hidden2d, w, labels2, lse, xa, bt)
+
+
+def _vjp_bwd_lora(res, cts):
+    hidden2d, w, labels2, lse, xa, bt = res
+    dlse, dgold = cts
+    lse2 = lse.reshape(-1, 1)
+    dlse2 = dlse.reshape(-1, 1).astype(jnp.float32)
+    dgold2 = dgold.reshape(-1, 1).astype(jnp.float32)
+    dh, dxa = _bwd_dh_lora(hidden2d, w, xa, bt, labels2, lse2, dlse2,
+                           dgold2)
+    dw, dbt = _bwd_dw_lora(hidden2d, w, xa, bt, labels2, lse2, dlse2,
+                           dgold2)
+    return (dh.astype(hidden2d.dtype), dw.astype(w.dtype), None,
+            dxa.astype(xa.dtype), dbt.astype(bt.dtype))
+
+
+fused_ce_rows_lora.defvjp(_vjp_fwd_lora, _vjp_bwd_lora)
+
+
+def head_bottleneck(hidden2d, lora_head):
+    """(xa, bt) kernel operands from a head-adapter entry {A [H, r],
+    B [r, V], scale}: xa = scale·(h@A) f32-accumulated then cast to the
+    compute dtype, bt = Bᵀ. ONE copy of the scale-folding/stop-gradient
+    convention (models/lora_apply semantics) shared by the kernel path
+    and ops/loss.py's XLA fallback."""
+    A = lora_head["A"].astype(hidden2d.dtype)
+    B = lora_head["B"]
+    scale = jax.lax.stop_gradient(
+        jnp.asarray(lora_head["scale"]).astype(jnp.float32))
+    xa = jnp.einsum("rh,hk->rk", hidden2d, A,
+                    preferred_element_type=jnp.float32)
+    xa = (xa * scale).astype(hidden2d.dtype)
+    return xa, B.T.astype(hidden2d.dtype)
+
+
+def fused_ce_nll_sum(hidden, lm_head_w, labels, ignore_index: int,
+                     lora_head=None,
+                     branch_hidden=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(sum_nll, valid_count) over ONE already-shifted chunk
-    [B, chunk, H] / [B, chunk] — the scan-body form ops/loss.py uses."""
+    [B, chunk, H] / [B, chunk] — the scan-body form ops/loss.py uses.
+    lora_head: optional {A, B, scale} head-adapter entry folded into the
+    kernel's vocab-tile loop (the [R, V] delta never materializes).
+    branch_hidden: the adapter branch's input when it differs from
+    `hidden` — train-mode LoRA dropout drops the branch copy only, PEFT
+    semantics (models/lora_apply docstring); base logits always read
+    the undropped hidden."""
     B, C, H = hidden.shape
     R = B * C
     lab = labels.reshape(R)
     valid = lab != ignore_index
     safe = jnp.where(valid, lab, 0)
-    lse, gold = fused_ce_rows(hidden.reshape(R, H), lm_head_w, safe)
+    h2 = hidden.reshape(R, H)
+    if lora_head is None:
+        lse, gold = fused_ce_rows(h2, lm_head_w, safe)
+    else:
+        hb2 = h2 if branch_hidden is None \
+            else branch_hidden.reshape(R, H)
+        xa, bt = head_bottleneck(hb2, lora_head)
+        lse, gold = fused_ce_rows_lora(h2, lm_head_w, safe, xa, bt)
     nll = jnp.where(valid, lse - gold, 0.0)
     return nll.sum(), valid.sum()

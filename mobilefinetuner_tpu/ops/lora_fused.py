@@ -1,0 +1,153 @@
+"""Pallas LoRA epilogue: fold the adapter delta into the projection's
+output pass so it never round-trips HBM.
+
+The XLA spelling of a LoRA site is
+
+    y = x @ W            (the base projection)
+    y = y + s * (xa @ B)  with  xa = x @ A   (rank-r bottleneck)
+
+A Note on LoRA (PAPERS.md) observes the delta is MEMORY-bound: at rank
+r ≪ d the second matmul does ~2·N·r·d_out FLOPs but XLA materializes the
+[N, d_out] delta and re-reads y to add it — two extra HBM round-trips of
+a y-sized tensor for a matmul the MXU finishes in a corner of one tile
+pass. This kernel computes `y + xa @ B` in ONE tiled pass over y: per
+(row-block, col-block) grid step it reads the y tile once, adds the
+rank-r product computed in VMEM with f32 accumulation, and writes the
+result. xa arrives pre-scaled (scale is folded outside, where its
+stop_gradient lives — models/lora_apply.py).
+
+Alignment: the rank dim (8..64 in practice) is far below the 128-lane
+tile, so the wrapper zero-pads xa/B to R_PAD=128 lanes — 16x pad FLOPs
+on a matmul that is ~r/d of the site's work, i.e. noise, in exchange for
+clean tiling on every jax version. The custom_vjp backward is plain XLA
+(dy = g passthrough; dxa = g @ Bᵀ; dB = xaᵀ @ g, all f32-accumulated):
+the backward has no y-sized temp to eliminate, so a kernel would only
+add launch overhead there.
+
+Eligibility (lora_epilogue_eligible): rows sublane-aligned (N % 8),
+lanes tile-aligned (d_out % 128), r ≤ R_PAD, and a (bn, bd) tile pair
+within the VMEM budget. Ineligible sites fall back to the XLA order in
+maybe_lora — same numerics (tests/test_lora_fused.py pins parity and
+grads against the naive oracle, interpret mode on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mobilefinetuner_tpu.ops.pallas_util import tpu_call_params
+
+R_PAD = 128                  # rank dim padded to one lane tile
+_VMEM_BUDGET = 14 * 2 ** 20  # headroom under the 16 MB scoped limit
+
+
+def pick_tiles(N: int, d_out: int,
+               itemsize: int = 2) -> Optional[Tuple[int, int]]:
+    """Largest (row, col) tile pair dividing [N, d_out] that fits the
+    VMEM budget (None = ineligible). Resident per grid step: the y and
+    out tiles (double-buffered), the [bn, R_PAD] xa slab, the
+    [R_PAD, bd] B slab (double-buffered), and the f32 accumulator."""
+    for bn in (512, 256, 128, 64, 32, 16, 8):
+        if N % bn:
+            continue
+        for bd in (512, 256, 128):
+            if d_out % bd:
+                continue
+            need = (2 * 2 * bn * bd * itemsize      # y in + out, buffered
+                    + 2 * bn * R_PAD * itemsize     # xa slab
+                    + 2 * R_PAD * bd * itemsize     # B slab
+                    + bn * bd * 4)                  # f32 accumulator
+            if need <= _VMEM_BUDGET:
+                return bn, bd
+    return None
+
+
+def lora_epilogue_eligible(N: int, d_out: int, r: int,
+                           itemsize: int = 2) -> bool:
+    """Shape gate consulted by maybe_lora and resolve_lora_impl."""
+    return (N % 8 == 0 and d_out % 128 == 0 and 0 < r <= R_PAD
+            and pick_tiles(N, d_out, itemsize) is not None)
+
+
+def _epilogue_kernel(y_ref, xa_ref, b_ref, o_ref):
+    acc = jax.lax.dot_general(
+        xa_ref[:], b_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)        # [bn, bd] f32
+    o_ref[:] = (y_ref[:].astype(jnp.float32) + acc).astype(o_ref.dtype)
+
+
+def _call(y2, xa2, b2):
+    N, d_out = y2.shape
+    tiles = pick_tiles(N, d_out, y2.dtype.itemsize)
+    if tiles is None:
+        raise ValueError(
+            f"lora epilogue ineligible for N={N}, d_out={d_out} (check "
+            f"lora_epilogue_eligible before calling)")
+    bn, bd = tiles
+    call = pl.pallas_call(
+        _epilogue_kernel,
+        grid=(N // bn, d_out // bd),
+        in_specs=[
+            pl.BlockSpec((bn, bd), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, R_PAD), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R_PAD, bd), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bn, bd), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((N, d_out), y2.dtype),
+        **tpu_call_params("parallel", "parallel"),
+    )
+    with jax.named_scope("lora_epilogue"):
+        return call(y2, xa2, b2)
+
+
+@jax.custom_vjp
+def _epilogue2(y2, xa2, b2):
+    """y2 + xa2 @ b2 over padded 2-D operands (xa2 [N, R_PAD] already
+    scale-folded, b2 [R_PAD, d_out]). The pad/scale plumbing lives in
+    lora_epilogue_add so its transposes come from plain XLA autodiff."""
+    return _call(y2, xa2, b2)
+
+
+def _vjp_fwd(y2, xa2, b2):
+    return _call(y2, xa2, b2), (xa2, b2)
+
+
+def _vjp_bwd(res, g):
+    xa2, b2 = res
+    gf = g
+    dxa = jax.lax.dot_general(
+        gf, b2, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(xa2.dtype)
+    db = jax.lax.dot_general(
+        xa2, gf, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(b2.dtype)
+    return g, dxa, db
+
+
+_epilogue2.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def lora_epilogue_add(y, xa, B, scale):
+    """y + scale·(xa @ B) through the fused tile pass.
+
+    y [..., d_out] (any leading shape), xa [..., r] the rank-r
+    bottleneck in the compute dtype, B [r, d_out], scale a (stop-
+    gradiented) f32 scalar. Returns y's shape and dtype."""
+    d_out = y.shape[-1]
+    r = xa.shape[-1]
+    N = y.size // d_out
+    xs = (xa.astype(jnp.float32) * scale).astype(y.dtype)
+    xa2 = jnp.pad(xs.reshape(N, r), ((0, 0), (0, R_PAD - r)))
+    b2 = jnp.pad(B.astype(y.dtype), ((0, R_PAD - r), (0, 0)))
+    out = _epilogue2(y.reshape(N, d_out), xa2, b2)
+    return out.reshape(y.shape)

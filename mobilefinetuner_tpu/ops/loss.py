@@ -249,7 +249,8 @@ def vp_embed_lookup(table, ids, mesh, *, vocab_axis: str = "fsdp",
         out_specs=P(ba, vocab_axis, None), check_vma=False)(table, ids)
 
 
-def _use_fused_ce(use_fused_kernel, R, V, H, itemsize=2) -> bool:
+def _use_fused_ce(use_fused_kernel, R, V, H, itemsize=2, lora_r=0,
+                  lora_impl="naive") -> bool:
     """Resolve the fused-head-kernel dispatch. "auto" currently resolves
     to the XLA path on every shape: measured on v5e (r4), the Pallas
     fused head (ops/fused_ce.py) is ~6% SLOWER than XLA's consumer-fused
@@ -257,28 +258,40 @@ def _use_fused_ce(use_fused_kernel, R, V, H, itemsize=2) -> bool:
     Gemma-1B — XLA already keeps the chunk logits out of HBM well enough
     that the kernel's per-tile overhead has nothing to win back
     (DESIGN.md §5a). True forces the kernel (tests; future re-measure
-    when the compiler or shapes change)."""
-    from mobilefinetuner_tpu.ops.fused_ce import fused_ce_eligible
+    when the compiler or shapes change).
+
+    lora_r > 0 is the head-ADAPTER case (DESIGN.md §17): under
+    lora_impl="fused" the kernel engages whenever the epilogue variant
+    is eligible (the adapter delta is the HBM traffic the base kernel
+    never had to win back); "auto"/"naive" keep the XLA chunk path
+    pending a TPU measurement."""
+    from mobilefinetuner_tpu.ops.fused_ce import (fused_ce_eligible,
+                                                  fused_ce_lora_eligible)
+    eligible = (fused_ce_lora_eligible(R, V, H, lora_r, itemsize)
+                if lora_r else fused_ce_eligible(R, V, H, itemsize))
     if use_fused_kernel == "auto":
-        return False
+        return bool(lora_r) and lora_impl == "fused" and eligible
     if not use_fused_kernel:
         return False
-    if not fused_ce_eligible(R, V, H, itemsize):
+    if not eligible:
         # forcing must be loud: a silent XLA fallback would let a future
         # re-measure record XLA numbers as kernel numbers
         raise ValueError(
             f"use_fused_kernel=True but the fused CE kernel cannot run "
-            f"R={R}, V={V}, H={H} (alignment or VMEM budget — "
-            f"fused_ce.pick_block_v); use 'auto' for dispatch")
+            f"R={R}, V={V}, H={H}, lora_r={lora_r} (alignment or VMEM "
+            f"budget — fused_ce.pick_block_v); use 'auto' for dispatch")
     return True
 
 
 @partial(jax.jit, static_argnames=("ignore_index", "num_chunks", "mesh",
                                    "batch_axis", "vocab_axis",
-                                   "use_fused_kernel", "sequence_parallel"))
+                                   "use_fused_kernel", "sequence_parallel",
+                                   "lora_impl", "lora_dropout"))
 def _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks,
                      mesh=None, batch_axis="data", vocab_axis="fsdp",
-                     use_fused_kernel="auto", sequence_parallel=False):
+                     use_fused_kernel="auto", sequence_parallel=False,
+                     lora_head=None, lora_impl="naive",
+                     lora_dropout=0.0, dropout_rng=None):
     if mesh is not None:
         V = lm_head_w.shape[0]
         B, S = hidden.shape[0], hidden.shape[1]
@@ -294,6 +307,14 @@ def _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks,
                 raise ValueError(
                     "use_fused_kernel=True is not available under the "
                     "vocab-parallel mesh path (shard_map CE)")
+            if lora_head is not None:
+                # a head adapter under the vocab-parallel CE would need
+                # B column-sharded inside the shard_map — not built this
+                # round; refusing beats silently dropping the delta
+                raise ValueError(
+                    "lora_head (lm_head adapter) is not supported under "
+                    "the vocab-parallel CE path; run with mesh=None or "
+                    "drop the lm_head target")
             return _vp_chunked_nll_sum(hidden, lm_head_w, labels,
                                        ignore_index, num_chunks, mesh,
                                        batch_axis, vocab_axis,
@@ -325,30 +346,72 @@ def _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index, num_chunks,
         lm_head_w = lm_head_w.astype(hidden.dtype)
     hs, ls = _shift_and_chunk(hidden, labels, ignore_index, num_chunks)
     nc, B, chunk, H = hs.shape
+    # Train-mode LoRA dropout on the head adapter's branch input (PEFT
+    # semantics: the branch copy only, never the base logits). Masked
+    # over the FULL hidden with the same fold_in(rng, 2000) site key as
+    # the models' full-logits lm_head sites (gpt2/gemma3.forward), then
+    # chunked alongside — bit-identical branch input to the unchunked
+    # path, uncorrelated with every per-layer site mask.
+    hbs = None
+    if (lora_head is not None and lora_dropout > 0.0
+            and dropout_rng is not None):
+        from mobilefinetuner_tpu.ops.dropout import inverted_dropout
+        dropped = inverted_dropout(
+            hidden, lora_dropout, jax.random.fold_in(dropout_rng, 2000))
+        hbs, _ = _shift_and_chunk(dropped, labels, ignore_index,
+                                  num_chunks)
+    lora_r = 0 if lora_head is None else int(lora_head["A"].shape[-1])
+
+    # xs only grows the branch-hidden leaf when dropout is live — the
+    # base graph (and every no-dropout caller's trace) is unchanged
+    def unpack(xs):
+        if hbs is None:
+            h, lab = xs
+            return h, h, lab
+        return xs
+
     if _use_fused_ce(use_fused_kernel, B * chunk, lm_head_w.shape[0], H,
-                     lm_head_w.dtype.itemsize):
+                     lm_head_w.dtype.itemsize, lora_r=lora_r,
+                     lora_impl=lora_impl):
         # Pallas fused head (ops/fused_ce.py): the [B, chunk, V] logits
         # block stays in VMEM tiles instead of being written + twice-read
-        # in HBM per chunk (and again in the checkpointed backward)
+        # in HBM per chunk (and again in the checkpointed backward) —
+        # with a head adapter, its delta folds into the same tile loop
         from mobilefinetuner_tpu.ops.fused_ce import fused_ce_nll_sum
 
         def body(carry, xs):
             total, count = carry
-            h, lab = xs
-            s, c = fused_ce_nll_sum(h, lm_head_w, lab, ignore_index)
+            h, hb, lab = unpack(xs)
+            s, c = fused_ce_nll_sum(h, lm_head_w, lab, ignore_index,
+                                    lora_head=lora_head,
+                                    branch_hidden=hb)
             return (total + s, count + c), None
     else:
         def body(carry, xs):
             total, count = carry
-            h, lab = xs
+            h, hb, lab = unpack(xs)
             logits = jax.lax.dot_general(
                 h, lm_head_w, (((2,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)    # [B, chunk, V] f32
+            if lora_head is not None:
+                # chunk-local head-adapter delta (the contraction-order
+                # rule: (h@A)@B, f32-accumulated; scale-folding shared
+                # with the kernel path via head_bottleneck) — only a
+                # [B, chunk, V] block ever exists, like the base logits
+                from mobilefinetuner_tpu.ops.fused_ce import \
+                    head_bottleneck
+                xa, bt = head_bottleneck(hb.reshape(B * chunk, H),
+                                         lora_head)
+                logits = logits + jnp.einsum(
+                    "rk,vk->rv", xa, bt,
+                    preferred_element_type=jnp.float32) \
+                    .reshape(B, chunk, -1)
             nll, valid = _token_nll(logits, lab, ignore_index)
             return (total + nll.sum(), count + valid.sum()), None
 
     (total, count), _ = jax.lax.scan(
-        jax.checkpoint(body), (jnp.float32(0.0), jnp.int32(0)), (hs, ls))
+        jax.checkpoint(body), (jnp.float32(0.0), jnp.int32(0)),
+        (hs, ls) if hbs is None else (hs, hbs, ls))
     return total, count
 
 
@@ -359,7 +422,11 @@ def chunked_lm_cross_entropy(hidden: jnp.ndarray, lm_head_w: jnp.ndarray,
                              batch_axis: str = "data",
                              vocab_axis: str = "fsdp",
                              use_fused_kernel="auto",
-                             sequence_parallel: bool = False) -> jnp.ndarray:
+                             sequence_parallel: bool = False,
+                             lora_head=None,
+                             lora_impl: str = "naive",
+                             lora_dropout: float = 0.0,
+                             dropout_rng=None) -> jnp.ndarray:
     """Mean causal-LM loss computed without materializing [B,S,V] logits.
 
     hidden: [B, S, H] final hidden states; lm_head_w: [V, H] (HF layout);
@@ -374,13 +441,25 @@ def chunked_lm_cross_entropy(hidden: jnp.ndarray, lm_head_w: jnp.ndarray,
     gathers each hidden chunk over that axis before the vocab-parallel
     softmax, so the long-context configuration keeps the no-table-gather
     guarantee (round-5 verdict item 2).
+
+    lora_head: optional lm_head adapter entry {A [H, r], B [r, V],
+    scale}; its delta is applied chunk-locally (XLA) or folded into the
+    fused kernel's tile loop (lora_impl="fused" when eligible) — the
+    full [B, S, V] delta never materializes either way (DESIGN.md §17).
+    lora_dropout/dropout_rng: train-mode inverted dropout on the head
+    adapter's branch input (PEFT semantics, same fold_in(rng, 2000)
+    site key as the models' full-logits lm_head sites) — pass the train
+    CLI's --lora_dropout and per-micro-batch rng so the lm_head target
+    regularizes like every per-layer site.
     """
     with jax.named_scope("loss"):
         total, count = _chunked_nll_sum(hidden, lm_head_w, labels,
                                         ignore_index, num_chunks, mesh,
                                         batch_axis, vocab_axis,
                                         use_fused_kernel,
-                                        sequence_parallel)
+                                        sequence_parallel, lora_head,
+                                        lora_impl, lora_dropout,
+                                        dropout_rng)
         return total / jnp.maximum(count, 1).astype(jnp.float32)
 
 
@@ -388,15 +467,20 @@ def chunked_lm_cross_entropy_sum(
         hidden: jnp.ndarray, lm_head_w: jnp.ndarray, labels: jnp.ndarray,
         ignore_index: int = IGNORE_INDEX, num_chunks: int = 8, mesh=None,
         batch_axis: str = "data", vocab_axis: str = "fsdp",
-        use_fused_kernel="auto",
-        sequence_parallel: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        use_fused_kernel="auto", sequence_parallel: bool = False,
+        lora_head=None, lora_impl: str = "naive",
+        lora_dropout: float = 0.0,
+        dropout_rng=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(sum_nll, valid_token_count) form of the chunked loss — the
     accumulation-friendly contract the train step uses (trainer.py).
-    mesh/sequence_parallel: see chunked_lm_cross_entropy."""
+    mesh/sequence_parallel/lora_head/lora_dropout: see
+    chunked_lm_cross_entropy."""
     with jax.named_scope("loss"):
         return _chunked_nll_sum(hidden, lm_head_w, labels, ignore_index,
                                 num_chunks, mesh, batch_axis, vocab_axis,
-                                use_fused_kernel, sequence_parallel)
+                                use_fused_kernel, sequence_parallel,
+                                lora_head, lora_impl, lora_dropout,
+                                dropout_rng)
 
 
 def perplexity_from_loss(loss) -> float:

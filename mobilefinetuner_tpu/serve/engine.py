@@ -68,8 +68,12 @@ class ServeConfig:
     max_new_tokens: int = 64  # per-request generation cap
     dtype: str = "float32"    # compute + cache dtype
     attn_impl: str = "auto"   # auto | xla | pallas (paged attention path)
+    lora_impl: str = "auto"   # auto | naive | fused (models/lora_apply)
 
     def validate(self) -> None:
+        from mobilefinetuner_tpu.models.lora_apply import \
+            validate_lora_impl
+        validate_lora_impl(self.lora_impl)
         if self.max_prompt % self.block_T:
             raise ValueError(
                 f"max_prompt ({self.max_prompt}) must be a multiple of "
@@ -185,6 +189,7 @@ class ServeEngine:
         # count executables, not calls.
         self.trace_counts: collections.Counter = collections.Counter()
         dt, impl = self.dtype, cfg.attn_impl
+        l_impl = cfg.lora_impl
         prefill_raw, step_raw = self._prefill_fn, self._step_fn
         conf = config
 
@@ -192,7 +197,8 @@ class ServeEngine:
             self.trace_counts["prefill"] += 1
             lora = self._route(bank_tree, aid)
             logits, (pk, pv) = prefill_raw(conf, params, ids, mask,
-                                           compute_dtype=dt, lora=lora)
+                                           compute_dtype=dt, lora=lora,
+                                           lora_impl=l_impl)
             tok0 = jnp.argmax(logits[0], -1).astype(jnp.int32)
             return tok0, pk[:, 0], pv[:, 0]
 
@@ -201,7 +207,8 @@ class ServeEngine:
             lora = self._route(bank_tree, aid)
             logits, pk, pv = step_raw(conf, params, pool_k, pool_v, tok,
                                       pos, tbl, lora=lora,
-                                      compute_dtype=dt, attn_impl=impl)
+                                      compute_dtype=dt, attn_impl=impl,
+                                      lora_impl=l_impl)
             return jnp.argmax(logits, -1).astype(jnp.int32), pk, pv
 
         def write_py(pool_k, pool_v, k, v, block_ids):
@@ -217,12 +224,30 @@ class ServeEngine:
         self._write = jax.jit(write_py,
                               donate_argnums=(0, 1) if donate else ())
 
+        # the lora_impl resolution is a pure function of the engine's
+        # static shapes — resolve the decode-step site once and stamp it
+        # into the manifest so a reader of the stream knows which path
+        # served the run (train CLIs do the same per target)
+        lora_impl_resolved = None
+        if bank is not None:
+            from mobilefinetuner_tpu.models.lora_apply import impl_summary
+            # per-target map, not one arbitrary target: d_out differs
+            # across targets, so boundary shapes can resolve differently
+            # per site (same convention as the train CLIs' manifest)
+            dims = {name: (int(e["A"].shape[-2]), int(e["B"].shape[-1]))
+                    for name, e in bank.tree["blocks"].items()}
+            rank = int(next(iter(
+                bank.tree["blocks"].values()))["A"].shape[-1])
+            lora_impl_resolved = impl_summary(
+                dims, S, rank, cfg.lora_impl, self.dtype.itemsize)
         self.telemetry = telemetry or Telemetry("")
         self.telemetry.emit("run_start", **run_manifest({
             "serve_family": family, "num_slots": S,
             "block_T": cfg.block_T, "num_blocks": cfg.num_blocks,
             "max_prompt": cfg.max_prompt,
             "max_new_tokens": cfg.max_new_tokens, "dtype": cfg.dtype,
+            "lora_impl": cfg.lora_impl,
+            "lora_impl_resolved": lora_impl_resolved,
             "adapter_slots": bank.capacity if bank else 0}))
 
     # ------------------------------------------------------------ helpers ---

@@ -10,3 +10,10 @@ force_host_devices(8)
 import jax  # noqa: E402
 
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the budgeted tier-1 run (-m 'not slow'); "
+        "run explicitly for the full acceptance matrices")

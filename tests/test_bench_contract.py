@@ -109,24 +109,53 @@ def test_bench_decode_row_contract():
     """tools/bench_decode.py rows (round 11): TPOT (= the marginal
     ms/token the tool always measured), TTFT (max_new_tokens=1 e2e
     wall), and the --adapters k stacked-bank mode — schema pinned on the
-    tiny CPU config, base vs k=2 both."""
+    tiny CPU config, base vs k=2 both. Round 12 adds the lora_impl
+    column: every row names the models/lora_apply.py path it ran, and a
+    forced non-auto impl lands in the config name."""
     import sys
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))), "tools"))
     import jax.numpy as jnp
     import bench_decode as bd
-    for k in (0, 2):
+    for k, li in ((0, "auto"), (2, "fused")):
         row = bd.bench_model(False, B=2, P=8, dtype=jnp.float32,
                              pipeline=1, adapters=k, tiny=True,
-                             n_pair=(2, 4))
+                             n_pair=(2, 4), lora_impl=li)
         assert row["adapters"] == k
-        assert row["config"].endswith("_k2") == (k == 2)
+        assert row["lora_impl"] == li
+        assert row["config"].endswith("_k2") == (k == 2 and li == "auto")
+        assert ("_lorafused" in row["config"]) == (li == "fused")
         for key in ("ttft_ms", "sustained_tok_s", "wall_ms_lo",
                     "wall_ms_hi"):
             assert isinstance(row[key], (int, float)) and row[key] > 0, key
         assert isinstance(row["tpot_ms"], (int, float))  # marginal: may
         # jitter near 0 on CPU at tiny sizes, but must be present/finite
         assert row["wall_ms_hi"] >= row["wall_ms_lo"] * 0.5
+
+
+def test_bench_lora_impl_rows_tiny_cpu(monkeypatch):
+    """bench.py's r12 lorafused-vs-loranaive row pairs: the REAL
+    bench_gpt2_lora in tiny CPU mode, both impls — finish() carries the
+    lora_impl column and the pair's losses agree (the bench rows
+    measure speed over an identical compute graph contract)."""
+    import bench as b
+    import jax.numpy as jnp
+    monkeypatch.setattr(b, "LOSS_MARK_TOKENS", 512)  # 4 steps at B2 S64
+    rows = {}
+    for li in ("naive", "fused"):
+        r = b.bench_gpt2_lora(B=2, S=64, dtype=jnp.float32, steps=2,
+                              size="tiny", lora_impl=li)
+        assert r["lora_impl"] == li
+        row = b.finish(f"gpt2s_tiny_lora{li}", r, "float32", 2)
+        assert row["lora_impl"] == li
+        assert row["tokens_per_sec_per_chip"] > 0
+        rows[li] = row
+    # parity contract: same seeded stream, same graph semantics
+    assert abs(rows["naive"]["loss"] - rows["fused"]["loss"]) < 1e-3
+    # non-LoRA rows carry no lora_impl key (schema unchanged for them)
+    fake = {"dt": 1.0, "loss": 1.0, "peak_bytes": 0, "flops": 1,
+            "tokens": 10}
+    assert "lora_impl" not in b.finish("x", fake, "float32", 1)
 
 
 def test_serve_bench_row_contract(tmp_path):

@@ -1,7 +1,16 @@
 """LoRA correctness: zero-impact at init, merge/unmerge idempotence, native
 adapter round-trip, PEFT export verified against real HF PEFT.
 (Reference analogs: test_lora_correctness.cpp, test_lora_roundtrip.cpp,
-nn/test_lora_linear.cpp.)"""
+nn/test_lora_linear.cpp.)
+
+Round 12 adds the lora_impl contract (DESIGN.md §17): the fused
+(shape-aware order + Pallas epilogue) path value+grad parity-pinned to
+the naive oracle across dtypes, both families, dropout on/off, and
+single/stacked-adapter routing; the f32-accumulation numerics pin at
+r=8 S=2048; the stack_adapters mismatch diagnostics; and zero retraces
+when serve hot-swaps adapters under lora_impl=fused."""
+
+import dataclasses
 
 import jax
 import jax.numpy as jnp
@@ -9,9 +18,11 @@ import numpy as np
 import pytest
 import torch
 
-from mobilefinetuner_tpu.core.config import GPT2Config
-from mobilefinetuner_tpu.lora.lora import (LoRASpec, init_lora_gpt2,
-                                           merge_gpt2, num_trainable,
+from mobilefinetuner_tpu.core.config import GPT2Config, Gemma3TextConfig
+from mobilefinetuner_tpu.lora.lora import (LoRASpec, assign_adapters,
+                                           init_lora_gemma3,
+                                           init_lora_gpt2, merge_gpt2,
+                                           num_trainable, stack_adapters,
                                            trainable_mask, unmerge_gpt2)
 from mobilefinetuner_tpu.lora.peft_io import (export_peft, import_peft,
                                               load_adapter, save_adapter)
@@ -129,3 +140,310 @@ def test_peft_export_loads_in_hf_peft(tmp_path):
         np.testing.assert_allclose(
             np.asarray(lora["blocks"][name]["A"]),
             np.asarray(back["blocks"][name]["A"]), atol=1e-6)
+
+
+# ------------------- round 12: lora_impl=auto|naive|fused --------------------
+
+from mobilefinetuner_tpu.models import gemma3
+from mobilefinetuner_tpu.models.lora_apply import (impl_summary, maybe_lora,
+                                                   multi_order_costs,
+                                                   order_costs, pick_order,
+                                                   resolve_lora_impl,
+                                                   resolve_multi_order)
+
+GEMMA_TINY = Gemma3TextConfig.tiny()
+
+
+def _rand_lora(init_fn, config, targets, seed, rank=4):
+    """Adapter with REAL (nonzero) B so the delta path does work."""
+    spec = LoRASpec(rank=rank, alpha=2.0 * rank, targets=targets)
+    lora = init_fn(config, spec, jax.random.PRNGKey(seed))
+    leaves, td = jax.tree.flatten(lora)
+    keys = jax.random.split(jax.random.PRNGKey(seed + 99), len(leaves))
+    return jax.tree.unflatten(td, [
+        l if l.ndim == 0 else 0.05 * jax.random.normal(k, l.shape)
+        for l, k in zip(leaves, keys)])
+
+
+_FAMILY_CACHE = {}
+
+
+def _family(name):
+    """Per-family setup + the naive-grad magnitude scale, cached at
+    module scope (re-init per matrix case would redo first-call jits)."""
+    if name in _FAMILY_CACHE:
+        return _FAMILY_CACHE[name]
+    if name == "gpt2":
+        params = gpt2.init_params(CFG, jax.random.PRNGKey(0))
+        lora = _rand_lora(init_lora_gpt2, CFG,
+                          ["attn_qkv", "attn_proj", "mlp_fc_in",
+                           "mlp_fc_out", "lm_head"], seed=3)
+        ids = jnp.asarray(np.random.default_rng(1).integers(
+            0, CFG.vocab_size, (2, 16)))
+        fwd = lambda lo, **kw: gpt2.forward(CFG, params, ids, lora=lo,
+                                            **kw)
+    else:
+        params = gemma3.init_params(GEMMA_TINY, jax.random.PRNGKey(0))
+        lora = _rand_lora(init_lora_gemma3, GEMMA_TINY,
+                          ["q_proj", "o_proj", "gate_proj", "down_proj",
+                           "lm_head"], seed=4)
+        ids = jnp.asarray(np.random.default_rng(2).integers(
+            0, GEMMA_TINY.vocab_size, (2, 16)))
+        fwd = lambda lo, **kw: gemma3.forward(GEMMA_TINY, params, ids,
+                                              lora=lo, **kw)
+    out0 = fwd(lora).astype(jnp.float32)
+    ct = jax.random.normal(jax.random.PRNGKey(5), out0.shape)
+    # one reference naive value+grad (f32, no dropout) fixes the scale
+    # every matrix case's tolerances are relative to
+    vn, gn = jax.value_and_grad(
+        lambda lo: jnp.vdot(fwd(lo, lora_impl="naive")
+                            .astype(jnp.float32), ct))(lora)
+    gmax = max(float(jnp.abs(x).max()) for x in jax.tree.leaves(gn))
+    _FAMILY_CACHE[name] = (lora, fwd, ct, abs(float(vn)), gmax)
+    return _FAMILY_CACHE[name]
+
+
+def _parity_case(family, dtype, tol, dropout):
+    """ONE vjp through the DIFFERENCE naive(lora) - fused(lora): same
+    dropout rng => identical masks, so the difference isolates the
+    compute-graph change, and its value AND cotangents must vanish to
+    tolerance (relative to the cached naive reference magnitudes)."""
+    lora, fwd, ct, vscale, gmax = _family(family)
+    drng = jax.random.PRNGKey(7) if dropout else None
+
+    def run(lo, impl):
+        out = fwd(lo, compute_dtype=dtype, lora_dropout=dropout,
+                  dropout_rng=drng, lora_impl=impl).astype(jnp.float32)
+        return jnp.vdot(out, ct)
+
+    vd, gd = jax.value_and_grad(
+        lambda lo: run(lo, "naive") - run(lo, "fused"))(lora)
+    assert abs(float(vd)) <= tol * max(vscale, 1.0), float(vd)
+    for leaf in jax.tree.leaves(gd):
+        assert float(jnp.abs(leaf).max()) <= tol * max(gmax, 1.0)
+
+
+@pytest.mark.parametrize("family", ["gpt2", "gemma"])
+def test_lora_impl_parity_smoke(family):
+    """Tier-1 slice of the matrix: fused == naive in value AND grads
+    through the real model, both families, f32 (the full dtype×dropout
+    matrix runs as test_lora_impl_parity_matrix, marked slow — CPU
+    tier-1 carries a 870 s budget)."""
+    _parity_case(family, jnp.float32, 1e-5, 0.0)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", ["gpt2", "gemma"])
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 1e-5),
+                                       (jnp.bfloat16, 2e-2)])
+@pytest.mark.parametrize("dropout", [0.0, 0.1])
+def test_lora_impl_parity_matrix(family, dtype, tol, dropout):
+    """The full acceptance matrix: fused == naive in value AND grads,
+    both families, fp32/bf16, dropout on/off — incl. the unstacked
+    lm_head site (see _parity_case)."""
+    _parity_case(family, dtype, tol, dropout)
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_multi_adapter_impl_parity(k):
+    """Stacked-[k,...] ids-routed path: the fused order (gather or
+    dense, cost-model picked) matches the naive per-row gather in value
+    and grads (one vjp through the difference, same discipline as the
+    matrix above)."""
+    params = gpt2.init_params(CFG, jax.random.PRNGKey(0))
+    adapters = [_rand_lora(init_lora_gpt2, CFG,
+                           ["attn_qkv", "attn_proj"], seed=10 + i)
+                for i in range(k)]
+    stacked = stack_adapters(adapters)
+    ids = jnp.asarray(np.random.default_rng(3).integers(
+        0, CFG.vocab_size, (4, 8)))
+    row_ids = [i % k for i in range(4)]
+
+    def run(st, impl):
+        lo = assign_adapters(st, row_ids)
+        out = gpt2.forward(CFG, params, ids, lora=lo,
+                           lora_impl=impl).astype(jnp.float32)
+        return jnp.sum(out * out) / out.size
+
+    vn, gn = jax.value_and_grad(lambda st: run(st, "naive"))(stacked)
+    vd, gd = jax.value_and_grad(
+        lambda st: run(st, "naive") - run(st, "fused"))(stacked)
+    gmax = max(float(jnp.abs(x).max()) for x in jax.tree.leaves(gn))
+    assert abs(float(vd)) <= 1e-5 * max(abs(float(vn)), 1.0)
+    for leaf in jax.tree.leaves(gd):
+        assert float(jnp.abs(leaf).max()) <= 1e-5 * max(gmax, 1.0)
+
+
+def test_naive_fp32_accum_r8_s2048():
+    """Satellite: the naive path must carry preferred_element_type=f32
+    on BOTH adapter matmuls (the old per-call bf16-accumulate chain is
+    the regression this pins, structurally — CPU may emulate bf16
+    matmuls in f32, so a purely numeric check could pass vacuously) and
+    land near the f32 oracle at the r=8, S=2048 shape."""
+    rng = np.random.default_rng(0)
+    x32 = rng.normal(size=(1, 2048, 256)).astype(np.float32)
+    A32 = (rng.normal(size=(256, 8)) * 0.1).astype(np.float32)
+    B32 = (rng.normal(size=(8, 256)) * 0.1).astype(np.float32)
+    entry16 = {"A": jnp.asarray(A32, jnp.bfloat16),
+               "B": jnp.asarray(B32, jnp.bfloat16),
+               "scale": jnp.float32(2.0)}
+
+    def f(x):
+        return maybe_lora(jnp.zeros(x.shape, jnp.bfloat16), x, entry16,
+                          impl="naive")
+
+    jaxpr = jax.make_jaxpr(f)(jnp.asarray(x32, jnp.bfloat16))
+    dots = [e for e in jaxpr.jaxpr.eqns if e.primitive.name ==
+            "dot_general"]
+    assert len(dots) >= 2, jaxpr
+    for e in dots:
+        pet = e.params.get("preferred_element_type")
+        assert pet is not None and np.dtype(pet) == np.float32, e
+    # numeric sanity vs the exact f32 oracle
+    got = np.asarray(f(jnp.asarray(x32, jnp.bfloat16)), np.float32)
+    want = 2.0 * (x32 @ A32) @ B32
+    err = np.abs(got - want).max() / np.abs(want).max()
+    assert err < 2e-2, err  # bf16 INPUT rounding only, not accumulation
+
+
+def test_pick_order_asserts_merged_never_wins():
+    """The cost model picks (x@A)@B at every LoRA-rank shape; a rank
+    above the harmonic mean of the dims trips the assertion instead of
+    silently materializing [d_in, d_out]."""
+    for n_tok, d_in, d_out, r in ((8, 640, 640, 8), (4096, 768, 2304, 8),
+                                  (2048, 640, 262144, 64), (8, 64, 64, 16)):
+        assert pick_order(n_tok, d_in, d_out, r) == "xA_B"
+        c = order_costs(n_tok, d_in, d_out, r)
+        assert c["xA_B"] < c["x_AB"]
+    with pytest.raises(AssertionError, match="merge the adapter"):
+        pick_order(16, 8, 8, 64)  # r >> harmonic mean of dims
+
+
+def test_resolve_lora_impl_gates():
+    """`auto` never selects an ineligible fused site: off-TPU always
+    naive; on TPU fused only when the epilogue is shape-eligible AND the
+    delta is memory-bound."""
+    # big aligned site on TPU -> fused
+    assert resolve_lora_impl(4096, 640, 640, 8, 2,
+                             backend="tpu") == "fused"
+    # off-TPU -> naive regardless
+    assert resolve_lora_impl(4096, 640, 640, 8, 2,
+                             backend="cpu") == "naive"
+    # misaligned d_out -> ineligible -> naive
+    assert resolve_lora_impl(4096, 640, 100, 8, 2,
+                             backend="tpu") == "naive"
+    # tiny delta (decode: one token per slot) -> naive
+    assert resolve_lora_impl(8, 640, 640, 8, 2, backend="tpu") == "naive"
+    s = impl_summary({"q_proj": (640, 640), "o_proj": (640, 100)},
+                     4096, 8, "auto", 2, backend="tpu")
+    assert s == "o_proj=naive,q_proj=fused"
+    assert impl_summary({"q_proj": (640, 640)}, 4096, 8, "naive",
+                        2) == "q_proj=naive"
+
+
+def test_resolve_multi_order_decode_vs_train():
+    """Dense all-k routing wins only where the per-row factor gather
+    dominates (tiny n_tok, small k); the train shapes keep gather."""
+    # train shape: huge n_tok -> gather
+    assert resolve_multi_order(16, 16 * 2048, 640, 640, 8, 8, 2) == \
+        "gather"
+    # decode shape, k=2 resident adapters -> dense beats the gather
+    c = multi_order_costs(8, 8, 640, 640, 8, 2, 2)
+    assert resolve_multi_order(8, 8, 640, 640, 8, 2, 2) == \
+        ("dense" if c["dense"] < c["gather"] else "gather")
+    assert c["dense"] < c["gather"]
+
+
+def test_multi_lora_auto_stays_gather_off_tpu():
+    """The module contract: off-TPU `auto` is always naive — on the
+    ids-routed path too. At a decode shape where the cost model picks
+    dense, auto on this CPU backend must still emit the gather graph
+    (== naive), while an explicit `fused` exercises the dense order."""
+    assert jax.default_backend() != "tpu"
+    k, d, r, rows = 2, 640, 8, 8
+    key = jax.random.PRNGKey(0)
+    entry = {"A": jax.random.normal(key, (k, d, r)),
+             "B": jax.random.normal(key, (k, r, d)),
+             "scale": jnp.ones((k,)), "ids": jnp.zeros((rows,), jnp.int32)}
+    y = jnp.zeros((rows, 1, d))
+    x = jnp.ones((rows, 1, d))
+    jp = {impl: str(jax.make_jaxpr(
+        lambda yy, xx: maybe_lora(yy, xx, entry, impl=impl))(y, x))
+        for impl in ("auto", "naive", "fused")}
+    assert resolve_multi_order(rows, rows, d, d, r, k, 4) == "dense"
+    assert jp["auto"] == jp["naive"]
+    assert jp["fused"] != jp["naive"]
+
+
+def test_stack_adapters_names_index_path_and_shapes():
+    """Satellite: a mismatched adapter names the offending index, leaf
+    path, and BOTH shapes."""
+    a0 = init_lora_gpt2(CFG, LoRASpec(rank=4, targets=["attn_proj"]),
+                        jax.random.PRNGKey(0))
+    a_rank = init_lora_gpt2(CFG, LoRASpec(rank=8, targets=["attn_proj"]),
+                            jax.random.PRNGKey(1))
+    with pytest.raises(ValueError) as ei:
+        stack_adapters([a0, a0, a_rank])
+    msg = str(ei.value)
+    assert "adapter 2" in msg and "attn_proj" in msg and "A" in msg
+    assert str((CFG.n_layer, CFG.n_embd, 4)) in msg
+    assert str((CFG.n_layer, CFG.n_embd, 8)) in msg
+    # different target sets -> structure error naming both sets
+    a_tgt = init_lora_gpt2(CFG, LoRASpec(rank=4, targets=["attn_qkv"]),
+                           jax.random.PRNGKey(2))
+    with pytest.raises(ValueError, match="attn_qkv"):
+        stack_adapters([a0, a_tgt])
+
+
+def test_lm_head_target_unstacked_and_merge_refused():
+    """lm_head is a single unstacked site: A [E, r], B [r, V]; applying
+    it changes logits; merging is refused (tied embedding)."""
+    params = gpt2.init_params(CFG, jax.random.PRNGKey(0))
+    lora = _rand_lora(init_lora_gpt2, CFG, ["lm_head"], seed=6)
+    e = lora["blocks"]["lm_head"]
+    assert e["A"].shape == (CFG.n_embd, 4)
+    assert e["B"].shape == (4, CFG.vocab_size)
+    ids = jnp.asarray(np.random.default_rng(4).integers(
+        0, CFG.vocab_size, (2, 8)))
+    base = gpt2.forward(CFG, params, ids)
+    with_head = gpt2.forward(CFG, params, ids, lora=lora)
+    assert float(jnp.abs(with_head - base).max()) > 1e-4
+    with pytest.raises(ValueError, match="lm_head"):
+        merge_gpt2(params, lora)
+
+
+def test_serve_hot_swap_zero_retrace_under_fused():
+    """Satellite: lora_impl=fused threads through the serve engine as a
+    STATIC config — adapter hot-swaps stay data, zero new traces after
+    warmup (the r11 compile-stability invariant, now under the fused
+    path)."""
+    from mobilefinetuner_tpu.serve import AdapterBank, ServeConfig, \
+        ServeEngine
+    cfg = dataclasses.replace(CFG, n_positions=64)
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    mk = lambda s: _rand_lora(init_lora_gpt2, cfg,
+                              ["attn_qkv", "attn_proj"], seed=s)
+    bank = AdapterBank(mk(1), capacity=2)
+    eng = ServeEngine(
+        "gpt2", cfg, params,
+        ServeConfig(num_slots=2, block_T=8, num_blocks=32, max_prompt=16,
+                    max_new_tokens=6, lora_impl="fused"),
+        bank=bank)
+    try:
+        eng.load_adapter("t1", mk(2))
+        rng = np.random.default_rng(0)
+        eng.submit(list(rng.integers(1, 250, 5)), max_new_tokens=4,
+                   adapter="t1")
+        eng.submit(list(rng.integers(1, 250, 9)), max_new_tokens=4)
+        eng.drain()
+        warm = eng.total_traces()
+        # hot-swap: evict + load a new tenant, serve through it
+        eng.evict_adapter("t1")
+        eng.load_adapter("t2", mk(3))
+        eng.submit(list(rng.integers(1, 250, 7)), max_new_tokens=4,
+                   adapter="t2")
+        eng.submit(list(rng.integers(1, 250, 3)), max_new_tokens=4)
+        eng.drain()
+        assert eng.total_traces() - warm == 0
+    finally:
+        eng.close()

@@ -1,0 +1,248 @@
+"""Pallas LoRA epilogue kernels vs the XLA oracle (DESIGN.md §17):
+ops/lora_fused.lora_epilogue (projection sites) and the fused-CE
+head-adapter variant (ops/fused_ce.fused_ce_rows_lora) — forward values,
+gradients through every differentiable operand, eligibility gates, and
+the chunked-CE integration. Interpret mode on CPU (ops/pallas_util)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mobilefinetuner_tpu.models.lora_apply import maybe_lora
+from mobilefinetuner_tpu.ops.fused_ce import (fused_ce_lora_eligible,
+                                              fused_ce_nll_sum,
+                                              fused_ce_rows_lora,
+                                              head_bottleneck,
+                                              pick_block_v)
+from mobilefinetuner_tpu.ops.lora_fused import (lora_epilogue_add,
+                                                lora_epilogue_eligible,
+                                                pick_tiles)
+from mobilefinetuner_tpu.ops.loss import (_token_nll,
+                                          chunked_lm_cross_entropy_sum)
+
+
+# ------------------------------ eligibility ----------------------------------
+
+def test_epilogue_eligibility_gates():
+    # aligned train-shaped site fits
+    assert pick_tiles(4096, 640, 2) is not None
+    assert lora_epilogue_eligible(4096, 640, 8, 2)
+    # rows must be sublane-aligned, lanes tile-aligned, rank <= the pad
+    assert not lora_epilogue_eligible(4095, 640, 8, 2)
+    assert not lora_epilogue_eligible(4096, 100, 8, 2)
+    assert not lora_epilogue_eligible(4096, 640, 256, 2)
+    # tiny aligned CPU-test shape is eligible (interpret-mode coverage)
+    assert lora_epilogue_eligible(16, 128, 4, 4)
+
+
+def test_fused_ce_lora_eligibility_adds_rank_terms():
+    # the adapter slabs shrink (or keep) the viable vocab tile
+    base = pick_block_v(262144, R=512, H=640)
+    with_lora = pick_block_v(262144, R=512, H=640, r_pad=128)
+    assert base is not None and with_lora is not None
+    assert with_lora <= base
+    assert fused_ce_lora_eligible(512, 262144, 640, 8)
+    assert not fused_ce_lora_eligible(512, 262144, 640, 256)  # r > pad
+    assert not fused_ce_lora_eligible(511, 262144, 640, 8)    # rows
+
+
+# --------------------------- projection epilogue -----------------------------
+
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 1e-5),
+                                       (jnp.bfloat16, 2e-2)])
+def test_lora_epilogue_matches_oracle_with_grads(dtype, tol):
+    rng = np.random.default_rng(0)
+    N, d_out, r = 16, 128, 4
+    y = jnp.asarray(rng.normal(size=(2, 8, d_out)), dtype)
+    xa = jnp.asarray(rng.normal(size=(2, 8, r)), dtype)
+    B = jnp.asarray(rng.normal(size=(r, d_out)) * 0.1, dtype)
+    scale = jnp.float32(2.0)
+
+    def kernel_fn(ops):
+        yy, xx, bb = ops
+        return jnp.sum(lora_epilogue_add(yy, xx, bb, scale)
+                       .astype(jnp.float32) ** 2)
+
+    def oracle_fn(ops):
+        yy, xx, bb = ops
+        out = yy.astype(jnp.float32) + 2.0 * (
+            xx.astype(jnp.float32) @ bb.astype(jnp.float32))
+        return jnp.sum(out.astype(dtype).astype(jnp.float32) ** 2)
+
+    vk, gk = jax.value_and_grad(kernel_fn)((y, xa, B))
+    vo, go = jax.value_and_grad(oracle_fn)((y, xa, B))
+    np.testing.assert_allclose(float(vk), float(vo), rtol=tol)
+    for a, b in zip(jax.tree.leaves(gk), jax.tree.leaves(go)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=tol, atol=tol * 10)
+
+
+def test_maybe_lora_fused_engages_the_kernel_at_aligned_shapes():
+    """At an eligible site, impl='fused' routes through pallas_call;
+    impl='naive' never does (the oracle stays pure XLA)."""
+    entry = {"A": jnp.zeros((128, 4)), "B": jnp.zeros((4, 128)),
+             "scale": jnp.float32(1.0)}
+    x = jnp.zeros((2, 8, 128))
+    y = jnp.zeros((2, 8, 128))
+
+    def prims(impl):
+        # the kernel sits inside the custom_vjp sub-jaxpr: search the
+        # whole rendered program, not just the top-level eqns
+        return str(jax.make_jaxpr(
+            lambda yy, xx: maybe_lora(yy, xx, entry, impl=impl))(y, x))
+
+    assert "pallas_call" in prims("fused")
+    assert "pallas_call" not in prims("naive")
+    # ineligible site (d_out not lane-aligned): fused falls back to XLA
+    entry_bad = {"A": jnp.zeros((128, 4)), "B": jnp.zeros((4, 100)),
+                 "scale": jnp.float32(1.0)}
+    jaxpr = jax.make_jaxpr(
+        lambda yy, xx: maybe_lora(yy, xx, entry_bad, impl="fused"))(
+            jnp.zeros((2, 8, 100)), x)
+    assert "pallas_call" not in str(jaxpr)
+
+
+# ------------------------------ fused-CE lora --------------------------------
+
+def _ce_case(dtype=jnp.float32, R=16, V=256, H=96, r=4, seed=0):
+    rng = np.random.default_rng(seed)
+    h = jnp.asarray(rng.normal(size=(R, H)), dtype)
+    w = jnp.asarray(rng.normal(size=(V, H)) * 0.05, dtype)
+    A = jnp.asarray(rng.normal(size=(H, r)) * 0.1, dtype)
+    B = jnp.asarray(rng.normal(size=(r, V)) * 0.1, dtype)
+    lab = jnp.asarray(rng.integers(0, V, (R,)), jnp.int32)
+    return h, w, A, B, lab
+
+
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 2e-5),
+                                       (jnp.bfloat16, 2e-2)])
+def test_fused_ce_rows_lora_matches_oracle(dtype, tol):
+    h, w, A, B, lab = _ce_case(dtype)
+    entry = {"A": A, "B": B, "scale": jnp.float32(2.0)}
+    xa, bt = head_bottleneck(h, entry)
+    lse, gold = jax.jit(fused_ce_rows_lora)(h, w, lab, xa, bt)
+    logits = (h.astype(jnp.float32) @ w.astype(jnp.float32).T
+              + 2.0 * (h.astype(jnp.float32) @ A.astype(jnp.float32))
+              @ B.astype(jnp.float32))
+    lse_o = jax.nn.logsumexp(logits, axis=-1)
+    gold_o = jnp.take_along_axis(logits, lab[:, None], -1)[:, 0]
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_o),
+                               rtol=tol, atol=tol)
+    np.testing.assert_allclose(np.asarray(gold), np.asarray(gold_o),
+                               rtol=tol, atol=tol)
+
+
+def test_fused_ce_lora_grads_match_xla_oracle():
+    """Gradients through hidden, W, A, AND B of the full nll chain —
+    the dh/dxa and dw/dbt kernel outputs composed with the outside
+    A/B/scale chain must equal plain XLA autodiff."""
+    h, w, A, B, lab = _ce_case()
+    hidden = h.reshape(2, 8, -1)
+    labels = lab.reshape(2, 8)
+
+    def loss_kernel(ops):
+        hh, ww, AA, BB = ops
+        s, _ = fused_ce_nll_sum(hh, ww, labels, -100,
+                                lora_head={"A": AA, "B": BB,
+                                           "scale": jnp.float32(2.0)})
+        return s
+
+    def loss_oracle(ops):
+        hh, ww, AA, BB = ops
+        logits = jnp.einsum("bch,vh->bcv", hh, ww) \
+            + 2.0 * jnp.einsum("bch,hr->bcr", hh, AA) @ BB
+        nll, _ = _token_nll(logits, labels, -100)
+        return nll.sum()
+
+    gk = jax.grad(loss_kernel)((hidden, w, A, B))
+    go = jax.grad(loss_oracle)((hidden, w, A, B))
+    for a, b, name in zip(jax.tree.leaves(gk), jax.tree.leaves(go),
+                          "hwAB"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-5, atol=3e-5, err_msg=name)
+
+
+def test_chunked_ce_lora_head_xla_and_kernel_match_full_logits():
+    """The chunked-CE integration: lora_head through the XLA chunk path
+    (lora_impl=naive) and through the kernel (lora_impl=fused, eligible)
+    both equal the full-logits oracle — the [B, S, V] delta never needs
+    to exist."""
+    h, w, A, B, lab = _ce_case(R=32)
+    hidden = h.reshape(2, 16, -1)
+    labels = lab.reshape(2, 16)
+    entry = {"A": A, "B": B, "scale": jnp.float32(2.0)}
+    logits = jnp.einsum("bch,vh->bcv", hidden, w) \
+        + 2.0 * jnp.einsum("bch,hr->bcr", hidden, A) @ B
+    nll, valid = _token_nll(logits[:, :-1], labels[:, 1:], -100)
+    want = float(nll.sum())
+    for impl in ("naive", "fused"):
+        s, c = chunked_lm_cross_entropy_sum(
+            hidden, w, labels, num_chunks=2, lora_head=entry,
+            lora_impl=impl)
+        np.testing.assert_allclose(float(s), want, rtol=3e-5,
+                                   err_msg=impl)
+        assert int(c) == int(valid.sum())
+
+
+def test_chunked_ce_lora_head_applies_branch_dropout():
+    """--lora_dropout must reach the lm_head adapter riding the chunked
+    CE (the per-layer sites get it inside the models; silently training
+    the head adapter without it is the regression this pins). The branch
+    mask is the models' full-logits convention — inverted dropout over
+    the FULL hidden under fold_in(rng, 2000) — so the chunked loss (and
+    its adapter grads) must equal the full-logits oracle bit-for-mask,
+    through BOTH the XLA chunk path and the fused kernel."""
+    from mobilefinetuner_tpu.ops.dropout import inverted_dropout
+    h, w, A, B, lab = _ce_case(R=32)
+    hidden = h.reshape(2, 16, -1)
+    labels = lab.reshape(2, 16)
+    p, rng = 0.5, jax.random.PRNGKey(11)
+
+    def oracle(entry):
+        hb = inverted_dropout(hidden, p, jax.random.fold_in(rng, 2000))
+        logits = jnp.einsum("bch,vh->bcv", hidden, w) \
+            + 2.0 * jnp.einsum("bch,hr->bcr", hb, entry["A"]) @ entry["B"]
+        nll, _ = _token_nll(logits[:, :-1], labels[:, 1:], -100)
+        return nll.sum()
+
+    def chunked(entry, impl):
+        s, _ = chunked_lm_cross_entropy_sum(
+            hidden, w, labels, num_chunks=2, lora_head=entry,
+            lora_impl=impl, lora_dropout=p, dropout_rng=rng)
+        return s
+
+    entry = {"A": A, "B": B, "scale": jnp.float32(2.0)}
+    want, gw = jax.value_and_grad(oracle)(entry)
+    for impl in ("naive", "fused"):
+        got, gg = jax.value_and_grad(
+            lambda e: chunked(e, impl))(entry)
+        np.testing.assert_allclose(float(got), float(want), rtol=3e-5,
+                                   err_msg=impl)
+        for a, b, name in zip(jax.tree.leaves(gg), jax.tree.leaves(gw),
+                              ("A", "B", "scale")):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-5, atol=3e-5,
+                                       err_msg=f"{impl}:{name}")
+    # dropout demonstrably engaged: the no-dropout loss differs
+    s0, _ = chunked_lm_cross_entropy_sum(
+        hidden, w, labels, num_chunks=2, lora_head=entry,
+        lora_impl="naive")
+    assert abs(float(s0) - float(want)) > 1e-3
+
+
+def test_use_fused_ce_dispatch_with_lora():
+    from mobilefinetuner_tpu.ops.loss import _use_fused_ce
+    # auto + head adapter: kernel only under lora_impl=fused + eligible
+    assert _use_fused_ce("auto", 512, 262144, 640, 2, lora_r=8,
+                         lora_impl="fused")
+    assert not _use_fused_ce("auto", 512, 262144, 640, 2, lora_r=8,
+                             lora_impl="naive")
+    assert not _use_fused_ce("auto", 512, 262144, 640, 2, lora_r=8,
+                             lora_impl="auto")
+    # base path unchanged: auto stays XLA
+    assert not _use_fused_ce("auto", 512, 262144, 640, 2)
+    # forcing at an ineligible lora shape is loud
+    with pytest.raises(ValueError, match="lora_r"):
+        _use_fused_ce(True, 512, 262144, 640, 2, lora_r=256)

@@ -64,9 +64,12 @@ def marginal_ms(make_f, n_lo, n_hi, pipeline=8):
 
 
 def bench_model(gemma: bool, B: int, P: int, dtype, pipeline: int,
-                adapters: int = 0, tiny: bool = False, n_pair=(16, 64)):
+                adapters: int = 0, tiny: bool = False, n_pair=(16, 64),
+                lora_impl: str = "auto"):
     """One decode row; returns the row dict (contract-tested by
-    tests/test_bench_contract.py via tiny=True on CPU)."""
+    tests/test_bench_contract.py via tiny=True on CPU). lora_impl
+    selects the models/lora_apply.py path for the stacked-bank decode
+    (--adapters k): the fused-vs-naive TPOT delta is the r12 column."""
     from mobilefinetuner_tpu.models import gemma3, gpt2
     from mobilefinetuner_tpu.models.generate import (SampleConfig,
                                                      gemma3_generate,
@@ -104,7 +107,8 @@ def bench_model(gemma: bool, B: int, P: int, dtype, pipeline: int,
     def make_f(n):
         cfg = SampleConfig(max_new_tokens=n, greedy=True, eos_id=None)
         f = jax.jit(lambda p, l, i, m: gen(config, p, i, m, cfg, lora=l,
-                                           compute_dtype=dtype))
+                                           compute_dtype=dtype,
+                                           lora_impl=lora_impl))
         return lambda: f(params, lora, ids, mask)
 
     ms, walls = marginal_ms(make_f, n_lo, n_hi, pipeline=pipeline)
@@ -115,8 +119,10 @@ def bench_model(gemma: bool, B: int, P: int, dtype, pipeline: int,
     sustained = B * n_hi / walls[n_hi]
     row = {
         "config": f"{name}_decode_B{B}"
-                  + (f"_k{adapters}" if adapters else ""),
+                  + (f"_k{adapters}" if adapters else "")
+                  + (f"_lora{lora_impl}" if lora_impl != "auto" else ""),
         "B": B, "P": P, "adapters": adapters,
+        "lora_impl": lora_impl,
         "dtype": str(jnp.dtype(dtype)),
         "tpot_ms": round(ms, 4),                    # marginal ms/token
         "ttft_ms": round(ttft_ms, 3),
@@ -148,6 +154,12 @@ def main():
     ap.add_argument("--adapters", type=int, default=0,
                     help="stacked-bank decode with k adapters routed "
                          "per batch row (0 = base model)")
+    ap.add_argument("--lora_impl", choices=["auto", "naive", "fused"],
+                    default="auto",
+                    help="LoRA hot-path implementation for the decode "
+                         "program (models/lora_apply.py; naive = the "
+                         "parity oracle, fused = cost-model order + "
+                         "Pallas epilogue at eligible sites)")
     ap.add_argument("--tiny", action="store_true",
                     help="tiny config (CPU contract mode)")
     ap.add_argument("--json", action="store_true", dest="json_out",
@@ -163,7 +175,7 @@ def main():
     for b in args.B:
         row = bench_model(args.gemma, b, P, dtype, args.pipeline,
                           adapters=args.adapters, tiny=args.tiny,
-                          n_pair=n_pair)
+                          n_pair=n_pair, lora_impl=args.lora_impl)
         if args.json_out:
             print(json.dumps(row))
     if args.kernel:

@@ -25,6 +25,7 @@ import numpy as np
 
 from mobilefinetuner_tpu.core.logging import (JSONLWriter, MetricsLogger,
                                               get_logger)
+from mobilefinetuner_tpu.core.preempt import EXIT_PREEMPTED, PreemptionGuard
 from mobilefinetuner_tpu.core.telemetry import (GoodputMeter, HangWatchdog,
                                                 SpikeConfig, SpikeDetector,
                                                 Telemetry, device_peak_flops,
@@ -208,6 +209,31 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
     g.add_argument("--straggler_mult", type=float, default=1.5,
                    help="straggler threshold: host median step time vs "
                         "fleet median")
+    # elastic fleet (DESIGN.md §18)
+    g.add_argument("--on_preempt", choices=["drain", "off"],
+                   default="drain",
+                   help="SIGTERM/SIGINT handling (core/preempt.py): "
+                        "'drain' (default) finishes the step in flight, "
+                        "takes ONE final atomic checkpoint through the "
+                        "async checkpointer, ends the telemetry stream "
+                        "with run_end{reason=preempted}, and exits with "
+                        f"the resumable code {EXIT_PREEMPTED} — a "
+                        "preemption notice costs one step plus one "
+                        "drain instead of the steps since the last "
+                        "periodic save (a second signal aborts the "
+                        "drain). 'off' keeps default signal behavior")
+    g.add_argument("--data_retries", type=int, default=3,
+                   help="bounded retry budget for transient I/O errors "
+                        "on the streaming data refetch path (shared-"
+                        "filesystem hiccups under a fleet): each retry "
+                        "backs off exponentially with jitter and emits "
+                        "an anomaly{kind=data_retry} telemetry event; "
+                        "after the budget the original error raises. "
+                        "0 = fail fast")
+    g.add_argument("--data_backoff_s", type=float, default=0.5,
+                   help="base backoff for --data_retries (doubles per "
+                        "attempt, +25%% jitter to desynchronize a fleet "
+                        "retrying the same filesystem)")
 
 
 def add_align_flags(p: argparse.ArgumentParser):
@@ -527,17 +553,75 @@ def maybe_resume_opt_state(args, trainable, tc: TrainConfig, mask=None):
     """(opt_state, start_step) from the .opt sidecar next to
     --resume_from, or (None, 0). The sidecar carries Adam m/v AND the step
     counter — restoring both is an improvement over the reference, which
-    never wires Adam::save/load into any CLI (SURVEY.md §5)."""
+    never wires Adam::save/load into any CLI (SURVEY.md §5).
+
+    The restored tree is HOST numpy and the template is abstract
+    (jax.eval_shape — shapes/dtypes only, no device zeros allocated just
+    to be overwritten): nothing here commits the state to any device, so
+    the SAME sidecar loads at any mesh shape — the caller places it (the
+    full-FT CLIs via `place_opt_state` at their mesh, the LoRA path via
+    run_training's replication), which is what makes `--resume_from`
+    mesh-shape-agnostic (elastic resume, DESIGN.md §18)."""
     from mobilefinetuner_tpu.optim import adam as adam_mod
     from mobilefinetuner_tpu.train.trainer import init_optimizer
     path = getattr(args, "resume_from", "")
     if not path or not os.path.exists(path + ".opt"):
         return None, 0
-    template = init_optimizer(trainable, tc, mask)
-    opt_state, _ = adam_mod.load_state(path + ".opt", template)
+    # trainable rides as the abstracted ARGUMENT (not a closure constant:
+    # eval_shape only abstracts arguments — a closed-over concrete tree
+    # would make zeros_like allocate real device zeros during tracing)
+    template = jax.eval_shape(lambda t: init_optimizer(t, tc, mask),
+                              trainable)
+    opt_state, _ = adam_mod.load_state(path + ".opt", template,
+                                       to_host=True)
     start_step = int(opt_state["step"])
     log.info(f"restored optimizer state @ step {start_step}")
     return opt_state, start_step
+
+
+def place_opt_state(opt_state, mesh):
+    """Place a host-side resumed Adam tree onto THIS run's mesh with the
+    same FSDP rule as the params (`mesh.shard_params`): m/v leaves share
+    the param shapes, so they land on the param specs by construction —
+    ZeRO's optimizer-state partitioning survives a mesh reshape — while
+    the step scalar and masked zero-size placeholders replicate. With
+    the sidecar holding full tensors (writers gather before saving),
+    this is the whole elastic-resume placement story: save at mesh
+    (1,N), load + re-shard at (1,M), byte-identical values
+    (tests/test_elastic.py pins the round trip)."""
+    from mobilefinetuner_tpu.parallel.mesh import shard_params
+    return shard_params(opt_state, mesh)
+
+
+def data_retry_kwargs(args) -> dict:
+    """WT2Config kwargs for the bounded-retry streaming refetch
+    (--data_retries/--data_backoff_s) — one place, so the four train
+    CLIs cannot drift. Applied to the TRAIN and EVAL datasets alike (a
+    mid-run eval refetch over the same flaky filesystem deserves the
+    same budget)."""
+    return {"retries": max(getattr(args, "data_retries", 0), 0),
+            "retry_backoff_s": getattr(args, "data_backoff_s", 0.5)}
+
+
+def make_data_retry_sink(tel, cur_step: dict):
+    """The WikiText2Dataset.event_sink adapter: render a survived-retry
+    report (`_io_retry`'s kind/attempt/error/what/backoff_s kwargs) as
+    an `anomaly`{kind=data_retry} telemetry event plus a log line.
+    Module-level (not an inline closure) so the wiring is unit-testable
+    against the real payload shape — the dataset swallows sink
+    exceptions by design, which would otherwise hide an argument
+    mismatch here forever. `cur_step` is the loop's mutable
+    latest-step cell; the stamp is approximate by design (the retry
+    happens BETWEEN steps on the producer thread)."""
+    def sink(**fields):
+        kind = fields.pop("kind", "data_retry")
+        tel.emit("anomaly", step=cur_step["step"] + 1, kind=kind,
+                 loss=None, ema=None, zscore=None, **fields)
+        log.warning(
+            f"data retry #{fields.get('attempt')}: "
+            f"{fields.get('error')} (backing off "
+            f"{fields.get('backoff_s')}s)")
+    return sink
 
 
 class EMA:
@@ -604,8 +688,9 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     governor = None  # assigned in setup; end_run late-binds the local
     wd = None        # assigned in setup; the outer finally stops it
     ckpt = None      # async checkpointer; end_run drains it
+    guard = None     # preemption guard; the outer finally uninstalls it
 
-    def end_run(exit_name: str, steps: int):
+    def end_run(exit_name: str, steps: int, **extra_fields):
         """Terminate the stream exactly once on any exit path: run_end
         carries the goodput buckets (plus the governor's own run-total
         sleep counter — an independently-clocked cross-check of the
@@ -618,7 +703,7 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
         mask the exception that brought us down)."""
         if ckpt is not None:
             ckpt.close(raise_errors=False)
-        extra = {}
+        extra = dict(extra_fields)
         if governor is not None:
             extra["governor_slept_ms"] = round(governor.total_slept_ms, 1)
         tel.emit("run_end", steps=steps,
@@ -634,6 +719,27 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     try:
         governor = governor_from_args(
             args, event_sink=lambda p: tel.emit("throttle", **p))
+        # preemption drain (core/preempt.py, DESIGN.md §18): SIGTERM/
+        # SIGINT flips a flag the loop checks at every step boundary —
+        # finish the step, one final atomic save, run_end{reason=
+        # preempted}, exit EXIT_PREEMPTED. Main-thread only (signal
+        # semantics); embedded runs degrade to default behavior.
+        if getattr(args, "on_preempt", "drain") == "drain":
+            guard = PreemptionGuard().install()
+            if not guard.installed:
+                guard = None
+        # streaming-data retry telemetry: the datasets' bounded-retry
+        # refetch (data/wikitext2.py _io_retry) reports each survived
+        # I/O error as an anomaly{kind=data_retry} through this sink.
+        # cur_step is the loop's latest dispatched step — the producer
+        # thread runs a batch or two ahead, so the stamp is approximate
+        # by design (the retry has no exact step; it happens BETWEEN
+        # steps on the producer side).
+        cur_step = {"step": start_step}
+        _data_retry_sink = make_data_retry_sink(tel, cur_step)
+        for _ds in (train_ds, valid_ds):
+            if _ds is not None and getattr(_ds, "event_sink", None) is None:
+                _ds.event_sink = _data_retry_sink
         # snapshot-then-write checkpointing (io/async_ckpt.py): the save
         # hooks snapshot on the loop thread (blocking, batched D2H) and
         # hand the disk write to this checkpointer's background thread;
@@ -679,6 +785,12 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 grace_s=getattr(args, "watchdog_min_s", 60.0),
                 stacks_file=(tel.path + ".stacks") if tel.path else "",
                 abort=wd_mode == 2,
+                # before an abort's os._exit(113): flush + newline-
+                # terminate the stream so the shard a post-mortem reads
+                # ends with the complete hang record, not a truncated
+                # line (the flush serializes against any emit mid-write
+                # on the step loop's thread)
+                flush_fn=tel.flush_tail,
                 probe_fn=lambda: jax.device_put(
                     jnp.zeros(())).block_until_ready(),
                 on_hang=lambda p: (
@@ -729,10 +841,6 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
         eval_mesh = mesh if (mesh is not None and multiproc) else None
         eval_sp = getattr(args, "sequence_parallel", False)
 
-        # step_builder: alternate step factory with make_train_step's contract
-        # (the optimizer-offload path, optim/opt_offload.py, plugs in here)
-        step_fn = (step_builder or make_train_step)(loss_fn, tc, mask=mask,
-                                                    donate=True)
         eval_step = make_eval_step(nll_fn)
         if opt_state is None:
             opt_state = init_optimizer(trainable, tc, mask)
@@ -745,6 +853,42 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 lambda x: device_put_global(x, repl), trainable)
             opt_state = jax.tree.map(
                 lambda x: device_put_global(x, repl), opt_state)
+
+        # step_builder: alternate step factory with make_train_step's contract
+        # (the optimizer-offload path, optim/opt_offload.py, plugs in here).
+        # On a mesh, the compiled step's trainable/opt OUTPUTS are pinned to
+        # their INPUT shardings (metrics replicate): the loop runs ONE
+        # AOT-compiled executable with donated buffers, and a compiler-chosen
+        # output sharding that drifts from the input sharding would make the
+        # very next call reject its own donated outputs (seen on the
+        # (1,N)-mesh full-FT path: replicated bias inputs came back
+        # fsdp-sharded). Pinning makes the step a sharding fixed point by
+        # construction. The offload step_builder manages its own placements.
+        out_shardings = None
+        if mesh is not None and step_builder is None:
+            from jax.sharding import NamedSharding
+            from mobilefinetuner_tpu.parallel.mesh import params_shardings
+            tr_on_mesh = all(
+                isinstance(getattr(x, "sharding", None), NamedSharding)
+                and x.sharding.mesh == mesh
+                for x in jax.tree.leaves(trainable))
+            if tr_on_mesh:
+                # trainable: keep exactly its input shardings. opt m/v:
+                # the same FSDP RULE as the params (a fresh
+                # init_optimizer's eager zeros sit uncommitted on one
+                # device — their .sharding is not the intent; a resumed
+                # tree arrives via place_opt_state, which IS this rule).
+                repl = replicated_sharding(mesh)
+                out_shardings = (
+                    jax.tree.map(lambda x: x.sharding, trainable),
+                    repl if replicate_trainable
+                    else params_shardings(opt_state, mesh),
+                    repl)  # prefix: every metrics leaf replicates
+        if step_builder is not None:
+            step_fn = step_builder(loss_fn, tc, mask=mask, donate=True)
+        else:
+            step_fn = make_train_step(loss_fn, tc, mask=mask, donate=True,
+                                      out_shardings=out_shardings)
 
         ema = EMA(args.ema_beta)
         # async input pipeline: micro-batch assembly (tokenization, streaming
@@ -1029,6 +1173,37 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 slept_ms += governor.throttle(step)
                 meter.enter("step")
                 done_steps = step + 1 - start_step
+                cur_step["step"] = step + 1
+
+                if guard is not None and guard.triggered:
+                    # preemption drain: the step in flight is done —
+                    # flush the metrics buffer, take ONE final atomic
+                    # checkpoint (final=True drains the async writer:
+                    # the process must not exit before the recovery
+                    # point is durable), end the stream with a
+                    # schema-valid run_end{reason=preempted}, and exit
+                    # with the RESUMABLE code. `--resume_from` the final
+                    # artifact continues at step+1 with the data stream
+                    # fast-forwarded (skip_steps) — the preemption cost
+                    # is this one drain, not the steps since the last
+                    # periodic save.
+                    log.warning(
+                        f"{guard.signal_name} received: draining at step "
+                        f"{step + 1} (final save, then exit "
+                        f"{EXIT_PREEMPTED})")
+                    flush_metrics(emit_log=False)
+                    tel.emit("preempt", step=step + 1,
+                             signal=guard.signal_name or "SIGTERM")
+                    if save_hook is not None:
+                        meter.enter("checkpoint")
+                        with pause():  # a slow drain save is not a hang
+                            save_hook(step + 1, trainable, opt_state,
+                                      final=True, ckpt=ckpt)
+                    meter.enter("shutdown")
+                    if metrics_csv:
+                        metrics_csv.close()
+                    end_run("preempted", done_steps, reason="preempted")
+                    raise SystemExit(EXIT_PREEMPTED)
         except BaseException as e:
             # the stream records HOW the run ended before the exception
             # propagates — a crashed run's tail is run_start..last flush +
@@ -1108,6 +1283,10 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
         # reached an end_run, e.g. a failure inside end_run itself
         if ckpt is not None:
             ckpt.close(raise_errors=False)
+        # restore the process's previous signal handlers: repeated
+        # in-process runs (tests, notebooks) must not stack handlers
+        if guard is not None:
+            guard.uninstall()
 
 
 def setup_frozen_params(args, params, mesh):

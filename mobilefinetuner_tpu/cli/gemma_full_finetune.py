@@ -37,7 +37,7 @@ from mobilefinetuner_tpu.io.checkpoints import (gemma3_params_from_hf,
 from mobilefinetuner_tpu.models import gemma3
 from mobilefinetuner_tpu.ops.loss import chunked_lm_cross_entropy_sum
 from mobilefinetuner_tpu.optim import adam as adam_mod
-from mobilefinetuner_tpu.parallel.mesh import params_shardings
+from mobilefinetuner_tpu.parallel.mesh import shard_params
 
 log = get_logger()
 
@@ -101,13 +101,15 @@ def main(argv=None) -> int:
     tok = GemmaTokenizer.from_pretrained(args.model_dir)
     encode = lambda s: tok.encode(s, add_bos=False)
     wt2 = WT2Config(seq_len=args.seq_len, batch_size=args.batch_size,
-                    data_fraction=args.data_fraction, seed=args.seed)
+                    data_fraction=args.data_fraction, seed=args.seed,
+                    **common.data_retry_kwargs(args))
     train_ds = WikiText2Dataset(args.data_dir, "train", wt2, encode,
                                 tok.eos_id, pad_id=tok.pad_id)
     valid_ds = None
     if args.eval_interval:
         wt2_eval = WT2Config(seq_len=args.seq_len,
-                             batch_size=args.eval_batch_size, shuffle=False)
+                             batch_size=args.eval_batch_size, shuffle=False,
+                             **common.data_retry_kwargs(args))
         valid_ds = WikiText2Dataset(args.data_dir, "valid", wt2_eval,
                                     encode, tok.eos_id, pad_id=tok.pad_id)
 
@@ -164,9 +166,13 @@ def main(argv=None) -> int:
             args, params, tc, None)
         # Full FT: params themselves are the trainable tree — FSDP-shard
         # them (and thus Adam m/v) over the mesh; no host offload of
-        # trainables.
-        shardings = params_shardings(params, mesh)
-        params = jax.device_put(params, shardings)
+        # trainables. Checkpoint + sidecar hold FULL host tensors, so
+        # this placement is where ANY mesh shape re-shards a resume
+        # (elastic resume, DESIGN.md §18; shard_params is multi-host
+        # safe, unlike a raw device_put).
+        params = shard_params(params, mesh)
+        if opt_state is not None:
+            opt_state = common.place_opt_state(opt_state, mesh)
 
     # vocab-parallel CE on multi-device meshes (ops/loss.py): with the
     # tied embed TRAINABLE, this also keeps its gradient V-sharded

@@ -33,7 +33,7 @@ from mobilefinetuner_tpu.io.checkpoints import (gpt2_params_from_hf,
 from mobilefinetuner_tpu.models import gpt2
 from mobilefinetuner_tpu.ops.loss import lm_cross_entropy_sum
 from mobilefinetuner_tpu.optim import adam as adam_mod
-from mobilefinetuner_tpu.parallel.mesh import params_shardings
+from mobilefinetuner_tpu.parallel.mesh import shard_params
 
 log = get_logger()
 
@@ -72,13 +72,15 @@ def main(argv=None) -> int:
 
     tok = GPT2BPETokenizer.from_pretrained(args.pretrained_dir)
     wt2 = WT2Config(seq_len=args.seq_len, batch_size=args.batch_size,
-                    data_fraction=args.data_fraction, seed=args.seed)
+                    data_fraction=args.data_fraction, seed=args.seed,
+                    **common.data_retry_kwargs(args))
     train_ds = WikiText2Dataset(args.data_dir, "train", wt2, tok.encode,
                                 tok.eos_id)
     valid_ds = None
     if args.eval_interval:
         wt2_eval = WT2Config(seq_len=args.seq_len,
-                             batch_size=args.eval_batch_size, shuffle=False)
+                             batch_size=args.eval_batch_size, shuffle=False,
+                             **common.data_retry_kwargs(args))
         valid_ds = WikiText2Dataset(args.data_dir, "valid", wt2_eval,
                                     tok.encode, tok.eos_id)
 
@@ -99,8 +101,14 @@ def main(argv=None) -> int:
                     f"ring attention; attention-probs dropout is OFF in "
                     f"sequence-parallel mode (--no_model_dropout "
                     f"silences this)")
-    shardings = params_shardings(params, mesh)
-    params = jax.device_put(params, shardings)
+    # mesh-shape-agnostic placement (elastic resume, DESIGN.md §18): the
+    # checkpoint + sidecar hold FULL host tensors, so whatever mesh THIS
+    # run built re-shards them here — a save at (1,N) resumes at (1,M)
+    # with the Adam m/v landing on the same FSDP specs as the params
+    # (shard_params is multi-host safe, unlike a raw device_put).
+    params = shard_params(params, mesh)
+    if opt_state is not None:
+        opt_state = common.place_opt_state(opt_state, mesh)
     compute_dtype = common.compute_dtype_from_args(args)
     model_pdrop = max(config.embd_pdrop, config.resid_pdrop,
                       config.attn_pdrop)

@@ -123,13 +123,15 @@ def main(argv=None) -> int:
 
     tok = GPT2BPETokenizer.from_pretrained(args.pretrained_dir)
     wt2 = WT2Config(seq_len=args.seq_len, batch_size=args.batch_size,
-                    data_fraction=args.data_fraction, seed=args.seed)
+                    data_fraction=args.data_fraction, seed=args.seed,
+                    **common.data_retry_kwargs(args))
     train_ds = WikiText2Dataset(args.data_dir, "train", wt2, tok.encode,
                                 tok.eos_id)
     valid_ds = None
     if args.eval_interval:
         wt2_eval = WT2Config(seq_len=args.seq_len,
-                             batch_size=args.eval_batch_size, shuffle=False)
+                             batch_size=args.eval_batch_size, shuffle=False,
+                             **common.data_retry_kwargs(args))
         valid_ds = WikiText2Dataset(args.data_dir, "valid", wt2_eval,
                                     tok.encode, tok.eos_id)
 

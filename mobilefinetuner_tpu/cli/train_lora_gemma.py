@@ -120,7 +120,8 @@ def main(argv=None) -> int:
     tok = GemmaTokenizer.from_pretrained(args.model_dir)
     encode = lambda s: tok.encode(s, add_bos=False)
     wt2 = WT2Config(seq_len=args.seq_len, batch_size=args.batch_size,
-                    data_fraction=args.data_fraction, seed=args.seed)
+                    data_fraction=args.data_fraction, seed=args.seed,
+                    **common.data_retry_kwargs(args))
     train_ds = WikiText2Dataset(
         args.data_dir, "train", wt2, encode, tok.eos_id,
         pad_id=tok.pad_id,
@@ -128,7 +129,8 @@ def main(argv=None) -> int:
     valid_ds = None
     if args.eval_interval and args.data_dir:
         wt2_eval = WT2Config(seq_len=args.seq_len,
-                             batch_size=args.eval_batch_size, shuffle=False)
+                             batch_size=args.eval_batch_size, shuffle=False,
+                             **common.data_retry_kwargs(args))
         valid_ds = WikiText2Dataset(args.data_dir, "valid", wt2_eval,
                                     encode, tok.eos_id, pad_id=tok.pad_id)
 

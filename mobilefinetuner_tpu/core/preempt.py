@@ -1,0 +1,85 @@
+"""Preemption drain: turn SIGTERM/SIGINT into an orderly last-step save.
+
+The reference's whole loop is built to be interrupted — the energy
+governor suspends training on battery/thermal signals and the app
+lifecycle can kill the process at any time (PAPER.md); the TPU-fleet
+analog is the preemption notice. Without a handler, SIGTERM kills the
+run wherever it happens to be: the steps since the last periodic save
+are lost, the telemetry stream ends truncated, and the fleet controller
+cannot tell a preemption from a crash.
+
+`PreemptionGuard` converts the signal into a *per-step drain flag* that
+`cli/common.run_training` checks at every step boundary: the step in
+flight completes, the metrics buffer flushes, one final atomic
+checkpoint lands (through the existing `AsyncCheckpointer` — the drain
+blocks until the write is durable), the stream ends with a schema-valid
+`run_end` carrying `exit="preempted"`/`reason="preempted"`, and the
+process exits with `EXIT_PREEMPTED` — a DISTINCT, resumable exit code
+the fleet controller (tools/fleet_controller.py) recognizes as "clean
+drain, resume me" rather than "crashed, count against the restart
+budget". A preemption notice therefore costs one step plus one drain
+instead of a lost run (DESIGN.md §18).
+
+A SECOND signal during the drain aborts it (KeyboardInterrupt): the
+operator — or the platform's hard-kill escalation — always wins over a
+wedged save.
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Dict, Optional, Tuple
+
+# EX_TEMPFAIL: "temporary failure, retry later" — the resumable-exit
+# contract shared by run_training's drain path, the simulated fleet
+# workers (tools/multihost_smoke.py --sim_worker), and the controller's
+# restart policy. Distinct from the watchdog's abort (113 = wedged, the
+# host needs a restart) and from ordinary crashes (count against the
+# restart budget).
+EXIT_PREEMPTED = 75
+
+
+class PreemptionGuard:
+    """Signal handler -> drain flag (installed only on the main thread —
+    Python restricts `signal.signal` to it; elsewhere `install()` leaves
+    `installed` False and the caller degrades to default signal
+    behavior). `uninstall()` restores the previous handlers so repeated
+    in-process runs (tests, notebooks) never leak handler state."""
+
+    def __init__(self, signals: Tuple[int, ...] = (signal.SIGTERM,
+                                                   signal.SIGINT)):
+        self.signals = signals
+        self.triggered = False
+        self.signal_name: Optional[str] = None
+        self.installed = False
+        self._prev: Dict[int, object] = {}
+
+    def _handler(self, signum, frame):
+        if self.triggered:
+            # a second signal mid-drain: stop draining NOW — the
+            # operator (or the platform's kill escalation) outranks a
+            # slow final save
+            raise KeyboardInterrupt(
+                f"second {signal.Signals(signum).name} during drain")
+        self.triggered = True
+        self.signal_name = signal.Signals(signum).name
+
+    def install(self) -> "PreemptionGuard":
+        try:
+            for s in self.signals:
+                self._prev[s] = signal.signal(s, self._handler)
+            self.installed = True
+        except ValueError:
+            # not the main thread (embedded runs): restore whatever we
+            # managed to install and report unavailable
+            self.uninstall()
+        return self
+
+    def uninstall(self) -> None:
+        for s, h in self._prev.items():
+            try:
+                signal.signal(s, h)
+            except (ValueError, TypeError, OSError):
+                pass
+        self._prev.clear()
+        self.installed = False

@@ -114,10 +114,14 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "source": (str,),           # "schedule" | "telemetry"
     },
     # host-side loss-spike / divergence detector fired (loss is null
-    # exactly for kind=nonfinite_loss — strict-JSON rule)
+    # exactly for kind=nonfinite_loss — strict-JSON rule), OR a
+    # transient-but-survived incident: kind=data_retry (the streaming
+    # data pipeline hit an I/O error and is backing off instead of
+    # killing the run; extra fields carry attempt/error/backoff_s)
     "anomaly": {
         "step": (int,),
         "kind": (str,),             # "loss_spike" | "nonfinite_loss"
+                                    # | "data_retry"
         "loss": _OPT_NUM,
         "ema": _OPT_NUM,
         "zscore": _OPT_NUM,
@@ -195,7 +199,32 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "ttft_ms": _OPT_NUM,        # enqueue -> first token
         "tpot_ms": _OPT_NUM,        # mean per-token after the first
     },
-    # one per run on orderly exit; exit != "ok" names the exception type.
+    # preemption drain began (core/preempt.py + cli/common.run_training):
+    # a SIGTERM/SIGINT was observed at a step boundary; what follows is
+    # the final flush, one atomic checkpoint, and a run_end with
+    # reason="preempted" — then exit EXIT_PREEMPTED (75, resumable).
+    "preempt": {
+        "step": (int,),             # the drain step (last completed + 1)
+        "signal": (str,),           # "SIGTERM" | "SIGINT"
+    },
+    # one fleet-controller decision (tools/fleet_controller.py, written
+    # to <telemetry_base>.controller): the recovery layer's own
+    # timeline, rendered by fleet_report next to the goodput buckets so
+    # recovery cost is a visible line, not a mystery gap in step reach.
+    "controller": {
+        "action": (str,),           # launch|down|restart|lost|shrink|
+                                    # drain|give_up|stop
+        "worker": (int, type(None)),  # subject host index; None = fleet
+        "reason": _OPT_STR,         # hang | exit:<code> | preempted |
+                                    # sigterm | lost worker <k> | ...
+        "attempt": _OPT_NUM,        # restart attempt count for `worker`
+        "backoff_s": _OPT_NUM,      # exponential backoff before relaunch
+        "step": _OPT_NUM,           # worker's last observed step
+        "recovery_s": _OPT_NUM,     # down-observed -> relaunched wall s
+    },
+    # one per run on orderly exit; exit != "ok" names the exception type
+    # (or "preempted" for a drained run — reason carries it too, for
+    # consumers that filter on a dedicated field).
     # goodput: wall-clock bucket totals (seconds) from GoodputMeter — the
     # buckets sum to the run's wall time by construction (None on entry
     # points without a metered loop, e.g. the eval CLIs).
@@ -204,6 +233,7 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "wall_s": _NUM,
         "exit": (str,),
         "goodput": (dict, type(None)),
+        "reason": _OPT_STR,         # "preempted" on the drain path
     },
 }
 
@@ -214,7 +244,7 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
 # when present they are type-checked as usual.
 OPTIONAL_FIELDS: Dict[str, frozenset] = {
     "step_stats": frozenset({"host_step_ms"}),
-    "run_end": frozenset({"goodput"}),
+    "run_end": frozenset({"goodput", "reason"}),
     "checkpoint": frozenset({"snapshot_ms", "write_ms", "bytes", "mb_s",
                              "async"}),
 }
@@ -264,6 +294,15 @@ def shard_path(path: str, host: int) -> str:
     if not path or host == 0:
         return path
     return f"{path}.host{host}"
+
+
+def controller_path(path: str) -> str:
+    """The fleet controller's own event stream lives NEXT TO the worker
+    shards, never interleaved with them (two processes appending to one
+    file would collide seq numbers and corrupt the (host, seq) merge
+    key): `<base>.controller`. fleet_report discovers and renders it as
+    the recovery timeline beside the per-host shards (DESIGN.md §18)."""
+    return f"{path}.controller" if path else path
 
 
 def _scan_existing(path: str, trailing: int = 256):
@@ -371,6 +410,32 @@ class Telemetry:
             self._f.write(json.dumps(rec) + "\n")
             self._f.flush()
             return rec
+
+    def flush_tail(self):
+        """Best-effort durability barrier before a hard exit
+        (`os._exit` skips every Python-level cleanup): take the emit
+        lock — so no write is mid-flight in another thread — flush the
+        Python buffer through to the OS, fsync, and newline-terminate
+        the file if its last byte is not '\\n'. After this returns, the
+        stream's tail is a complete line: a reader (fleet_report) never
+        has to skip a truncated record from an aborted process, and the
+        last event emitted (the watchdog's `hang`) is durable."""
+        with self._lock:
+            if self._f is None or not self.enabled:
+                return
+            try:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                with open(self.path, "rb+") as rf:
+                    rf.seek(0, os.SEEK_END)
+                    if rf.tell() > 0:
+                        rf.seek(-1, os.SEEK_END)
+                        if rf.read(1) != b"\n":
+                            rf.write(b"\n")
+                            rf.flush()
+                            os.fsync(rf.fileno())
+            except OSError:
+                pass  # best-effort: the abort proceeds regardless
 
     def close(self):
         with self._lock:
@@ -655,7 +720,8 @@ class HangWatchdog:
                  stacks_file: str = "", abort: bool = False,
                  probe_fn: Optional[Callable[[], Any]] = None,
                  abort_fn: Optional[Callable[[int], Any]] = None,
-                 window: int = 31, probe_timeout_s: float = 5.0):
+                 window: int = 31, probe_timeout_s: float = 5.0,
+                 flush_fn: Optional[Callable[[], Any]] = None):
         self.mult = float(mult)
         self.min_deadline_s = float(min_deadline_s)
         self.grace_s = float(grace_s)
@@ -665,6 +731,7 @@ class HangWatchdog:
         self.abort = bool(abort)
         self._probe_fn = probe_fn
         self._abort_fn = abort_fn or os._exit
+        self._flush_fn = flush_fn
         self._probe_timeout_s = float(probe_timeout_s)
         self._clock = StepClock(window=window)
         self._lock = threading.Lock()
@@ -809,8 +876,19 @@ class HangWatchdog:
                     pass  # reporting failure must not kill the watchdog
             if self.abort:
                 # a wedged collective cannot be unwound by raising in
-                # another thread; hard-exit is the honest abort (the
-                # stacks + hang event are already durable)
+                # another thread; hard-exit is the honest abort. But
+                # os._exit skips every buffer flush, so FIRST run the
+                # caller's flush barrier (Telemetry.flush_tail): it
+                # serializes against any emit mid-write in the step
+                # loop's thread and newline-terminates the stream — the
+                # shard a post-mortem reads back ends with the complete
+                # hang record, not a truncated line fleet_report must
+                # skip.
+                if self._flush_fn is not None:
+                    try:
+                        self._flush_fn()
+                    except Exception:
+                        pass  # the abort proceeds regardless
                 self._abort_fn(113)
                 return
             with self._lock:

@@ -29,6 +29,8 @@ import bisect
 import dataclasses
 import json
 import os
+import random
+import time
 from typing import Callable, Iterator, List, Optional
 
 import numpy as np
@@ -65,6 +67,12 @@ class WT2Config:
     seed: int = 42
     streaming: bool = False
     window_tokens: int = 100_000  # streaming-mode resident window
+    # transient-I/O resilience for the streaming refetch (--data_retries/
+    # --data_backoff_s): a fleet's shared filesystem hiccup (NFS/GCS
+    # stall, ESTALE) must cost a bounded backoff, not the run. 0 = fail
+    # fast (pre-round-13 behavior).
+    retries: int = 0
+    retry_backoff_s: float = 0.5
 
 
 class WikiText2Dataset:
@@ -78,6 +86,12 @@ class WikiText2Dataset:
         self.encode_fn = encode_fn
         self._tokens: Optional[np.ndarray] = None
         self._epoch = 0
+        # retry telemetry hook: run_training points this at a closure
+        # emitting `anomaly`{kind=data_retry} events so a surviving I/O
+        # hiccup leaves a record instead of being invisible. Called from
+        # whatever thread runs the fetch (the prefetch producer);
+        # Telemetry.emit is lock-serialized, so that is safe.
+        self.event_sink: Optional[Callable[..., None]] = None
 
         if pretokenized_bin is not None:
             meta_path = pretokenized_bin + ".meta.json"
@@ -133,7 +147,7 @@ class WikiText2Dataset:
         keeping tokens (wikitext2_dataset.cpp:230-249 semantics)."""
         offsets = [0]
         lines_pos: List[int] = []
-        with open(file, encoding="utf-8") as f:
+        with self._open_text(file) as f:
             pos = f.tell()
             for line in iter(f.readline, ""):
                 stripped = line.rstrip("\n")
@@ -148,9 +162,49 @@ class WikiText2Dataset:
         self._win_start = 0
         self._win_tokens = np.empty(0, dtype=np.int32)
 
+    def _open_text(self, path: str):
+        """Source-file open, factored so tests can inject transient I/O
+        faults (and so an alternative storage layer can interpose)."""
+        return open(path, encoding="utf-8")
+
+    def _io_retry(self, fn, what: str):
+        """Run `fn` under the bounded-retry policy (`config.retries`,
+        exponential backoff with jitter): a transient I/O error on the
+        streaming refetch path — a shared-filesystem stall under a
+        whole fleet rereading the same corpus — costs a backoff and an
+        `anomaly`{kind=data_retry} event instead of killing the run.
+        The jitter desynchronizes a fleet whose hosts all hit the same
+        hiccup at once. After the budget, the ORIGINAL error raises."""
+        cfg = self.config
+        first_err: Optional[OSError] = None
+        for attempt in range(max(cfg.retries, 0) + 1):
+            try:
+                return fn()
+            except OSError as e:
+                # keep the FIRST error: it names the root cause (an
+                # ESTALE), while later attempts often fail with
+                # follow-on noise (the mount is simply gone)
+                first_err = first_err or e
+                if attempt >= max(cfg.retries, 0):
+                    raise first_err
+                delay = cfg.retry_backoff_s * (2 ** attempt)
+                delay *= 1.0 + 0.25 * random.random()
+                if self.event_sink is not None:
+                    try:
+                        self.event_sink(
+                            kind="data_retry", attempt=attempt + 1,
+                            error=f"{type(e).__name__}: {e}", what=what,
+                            backoff_s=round(delay, 3))
+                    except Exception:
+                        pass  # telemetry must never break the pipeline
+                time.sleep(delay)
+
     def _window_fetch(self, start: int, end: int) -> np.ndarray:
         """Return tokens[start:end] by re-tokenizing the covering lines,
-        keeping a bounded resident window."""
+        keeping a bounded resident window. The refetch I/O retries
+        transient errors under `_io_retry` (each attempt restarts the
+        window read from scratch — partial token lists never leak into
+        the resident window)."""
         ws, we = self._win_start, self._win_start + len(self._win_tokens)
         if start >= ws and end <= we:
             return self._win_tokens[start - ws:end - ws]
@@ -158,15 +212,20 @@ class WikiText2Dataset:
         li = bisect.bisect_right(self._line_offsets, start) - 1
         win_start_tok = self._line_offsets[li]
         want = max(end - win_start_tok, self.config.window_tokens)
-        toks: List[int] = []
-        with open(self._file, encoding="utf-8") as f:
-            j = li
-            while j < len(self._line_pos) and len(toks) < want:
-                f.seek(self._line_pos[j])
-                line = f.readline().rstrip("\n")
-                toks.extend(self.encode_fn(line))
-                toks.append(self.eos_id)
-                j += 1
+
+        def read_window() -> List[int]:
+            toks: List[int] = []
+            with self._open_text(self._file) as f:
+                j = li
+                while j < len(self._line_pos) and len(toks) < want:
+                    f.seek(self._line_pos[j])
+                    line = f.readline().rstrip("\n")
+                    toks.extend(self.encode_fn(line))
+                    toks.append(self.eos_id)
+                    j += 1
+            return toks
+
+        toks = self._io_retry(read_window, what="window_fetch")
         self._win_start = win_start_tok
         self._win_tokens = np.asarray(toks, dtype=np.int32)
         ws = self._win_start

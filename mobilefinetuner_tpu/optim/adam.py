@@ -170,8 +170,16 @@ def save_state(path: str, state: dict, config: AdamConfig):
     save_safetensors(path, flat, metadata=md)
 
 
-def load_state(path: str, state_template: dict) -> Tuple[dict, AdamConfig]:
-    """Restore optimizer state into the template's structure."""
+def load_state(path: str, state_template: dict,
+               to_host: bool = False) -> Tuple[dict, AdamConfig]:
+    """Restore optimizer state into the template's structure. The
+    template only contributes tree structure + leaf shape/dtype, so
+    `jax.eval_shape` ShapeDtypeStructs work — no device allocation
+    needed to describe the target. to_host=True keeps the restored
+    leaves as HOST numpy (the elastic-resume path: the caller places
+    them onto THIS run's mesh afterwards — `cli/common.place_opt_state`
+    — so a sidecar saved at mesh (1,N) re-shards at any (1,M) instead
+    of landing committed to the default device)."""
     from mobilefinetuner_tpu.io.safetensors_io import SafeTensorsReader
     reader = SafeTensorsReader(path)
     raw = reader.load_all()
@@ -180,7 +188,10 @@ def load_state(path: str, state_template: dict) -> Tuple[dict, AdamConfig]:
     for path_keys, leaf in leaves:
         key = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
                        for k in path_keys)
-        arr = jnp.asarray(raw[key]).astype(leaf.dtype).reshape(leaf.shape)
+        if to_host:
+            arr = np.asarray(raw[key]).astype(leaf.dtype).reshape(leaf.shape)
+        else:
+            arr = jnp.asarray(raw[key]).astype(leaf.dtype).reshape(leaf.shape)
         out.append(arr)
     md = reader.metadata
     cfg = AdamConfig(

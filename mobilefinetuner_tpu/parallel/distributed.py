@@ -41,6 +41,7 @@ sharded device_put.
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -61,7 +62,9 @@ def env_int(name: str) -> Optional[int]:
 
 def initialize(coordinator: str = "", num_processes: Optional[int] = None,
                process_id: Optional[int] = None,
-               force: bool = False) -> bool:
+               force: bool = False, connect_retries: int = 4,
+               connect_backoff_s: float = 1.0,
+               connect_backoff_cap_s: float = 10.0) -> bool:
     """Start the JAX distributed runtime when a multi-process run is
     requested; returns True iff it was (or already had been) started.
 
@@ -75,6 +78,15 @@ def initialize(coordinator: str = "", num_processes: Optional[int] = None,
 
     A plain single-process invocation (no flag, no env, pod size 1) is a
     no-op so the CLI entry points never hang waiting for phantom peers.
+
+    Explicitly-addressed connections RETRY with capped exponential
+    backoff (`connect_retries`/`connect_backoff_s`) before failing: at
+    fleet-restart time — exactly when the elastic controller relaunches
+    everything at once — the workers race the coordinator coming back
+    up, and failing fast on that race turns one recovered host into a
+    second fleet restart. Every attempt is logged; after the budget the
+    ORIGINAL error raises (not a wrapper), so the operator sees the
+    real failure, not the retry machinery.
     """
     global _INITIALIZED
     if _INITIALIZED:
@@ -87,16 +99,32 @@ def initialize(coordinator: str = "", num_processes: Optional[int] = None,
     want = force or bool(coordinator) or (num_processes or 1) > 1
     if not want:
         return False
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator or None,
-            num_processes=num_processes, process_id=process_id)
-    except Exception as e:
-        if coordinator or (num_processes or 1) > 1:
-            raise  # explicit addressing that fails is a real error
-        log.warning(f"--multihost: auto-detection failed ({e}); "
-                    f"continuing single-process")
-        return False
+    explicit = bool(coordinator) or (num_processes or 1) > 1
+    budget = max(connect_retries, 0) if explicit else 0
+    first_err: Optional[BaseException] = None
+    for attempt in range(budget + 1):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator or None,
+                num_processes=num_processes, process_id=process_id)
+            break
+        except Exception as e:
+            if not explicit:
+                # --multihost with nothing to address: degrade to
+                # single-process (dev-box behavior), never retry
+                log.warning(f"--multihost: auto-detection failed ({e}); "
+                            f"continuing single-process")
+                return False
+            first_err = first_err or e
+            if attempt >= budget:
+                raise first_err
+            delay = min(connect_backoff_s * (2 ** attempt),
+                        connect_backoff_cap_s)
+            log.warning(
+                f"distributed: coordinator connect attempt "
+                f"{attempt + 1}/{budget + 1} failed "
+                f"({type(e).__name__}: {e}); retrying in {delay:.1f}s")
+            time.sleep(delay)
     _INITIALIZED = True
     log.info(f"distributed: process {jax.process_index()}"
              f"/{jax.process_count()} up, "

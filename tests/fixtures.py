@@ -54,12 +54,19 @@ def train_tiny_gpt2_tokenizer(d: str):
         return len(json.load(f))
 
 
-def write_tiny_gpt2_dir(d: str, seed: int = 0) -> GPT2Config:
+def write_tiny_gpt2_dir(d: str, seed: int = 0,
+                        **config_overrides) -> GPT2Config:
     """HF-format GPT-2 checkpoint dir: config.json + model.safetensors
-    (HF GPT2LMHeadModel keys, Conv1D [in, out] layout) + tokenizer files."""
+    (HF GPT2LMHeadModel keys, Conv1D [in, out] layout) + tokenizer files.
+    config_overrides replace GPT2Config.tiny fields — the elastic-resume
+    mesh tests use n_embd=128 so the stacked per-layer leaves exceed the
+    FSDP min_size and actually re-shard across mesh shapes."""
+    import dataclasses
     os.makedirs(d, exist_ok=True)
     vocab_size = train_tiny_gpt2_tokenizer(d)
     config = GPT2Config.tiny(vocab_size=vocab_size)
+    if config_overrides:
+        config = dataclasses.replace(config, **config_overrides)
     with open(os.path.join(d, "config.json"), "w") as f:
         json.dump({"model_type": "gpt2", "vocab_size": config.vocab_size,
                    "n_positions": config.n_positions,

@@ -1,0 +1,371 @@
+"""Fleet controller e2e (DESIGN.md §18): the acceptance loop — kill a
+simulated worker mid-run, the controller restarts it, training resumes
+from the last ATOMIC checkpoint with the correct step counter, and the
+merged telemetry carries the `controller` recovery timeline that
+fleet_report renders next to the goodput buckets. Plus: the mesh-shrink
+relaunch on a lost worker, the one-SIGTERM fleet drain, and the
+--dry_run decision contract over recorded incident shards."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mobilefinetuner_tpu.core.preempt import EXIT_PREEMPTED
+from mobilefinetuner_tpu.core.telemetry import (Telemetry, controller_path,
+                                                validate_event)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+CONTROLLER = os.path.join(REPO, "tools", "fleet_controller.py")
+SMOKE = os.path.join(REPO, "tools", "multihost_smoke.py")
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def read_events(path):
+    out = []
+    with open(path) as f:
+        for line in f.read().splitlines():
+            if line.strip():
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass  # a killed worker's truncated tail is expected
+    return out
+
+
+def _worker_cmd(tmp_path, steps, extra=""):
+    return (f"{sys.executable} {SMOKE} --sim_worker --host {{host}} "
+            f"--hosts {{hosts}} --steps {steps} "
+            f"--telemetry {tmp_path}/run.jsonl "
+            f"--ckpt {tmp_path}/w{{host}}.safetensors "
+            f"--step_ms 25 {{resume}} {extra}")
+
+
+def _run_controller(tmp_path, cmd, hosts=2, budget=2, extra=()):
+    return subprocess.run(
+        [sys.executable, CONTROLLER, "--hosts", str(hosts),
+         "--telemetry", str(tmp_path / "run.jsonl"),
+         "--restart_budget", str(budget), "--backoff_s", "0.1",
+         "--max_wall_s", "120", "--cmd", cmd, *extra],
+        capture_output=True, text=True, env=_env(), cwd=REPO,
+        timeout=180)
+
+
+# --------------------------- injected-failure e2e ---------------------------
+
+def test_controller_restarts_killed_worker_e2e(tmp_path):
+    """The acceptance criterion: worker 1 is hard-killed at step 4; the
+    controller restarts it; the relaunched worker resumes from the
+    atomic checkpoint at step 4 and completes steps 5..10 — the merged
+    trajectory covers exactly 1..10 with no replays — and the
+    controller stream records down+restart with recovery accounting."""
+    # only worker 1 carries the fault: worker 0's marker pre-exists
+    open(str(tmp_path / "w0.safetensors.injected"), "w").write("off")
+    r = _run_controller(tmp_path,
+                        _worker_cmd(tmp_path, 10, "--inject kill:4"))
+    assert r.returncode == 0, (r.stdout, r.stderr)
+
+    # worker 1's shard: two runs appended (crash + resumed), the merged
+    # step sequence is exactly 1..10 — the step counter survived the
+    # restart because the checkpoint carried it
+    shard1 = read_events(str(tmp_path / "run.jsonl.host1"))
+    assert [e["event"] for e in shard1].count("run_start") == 2
+    steps = [e["step"] for e in shard1 if e["event"] == "step_stats"]
+    assert steps == list(range(1, 11))
+    assert shard1[-1]["event"] == "run_end" \
+        and shard1[-1]["exit"] == "ok"
+    second_start = [e for e in shard1 if e["event"] == "run_start"][1]
+    assert second_start["config"]["start_step"] == 4  # resumed, not 0
+
+    # the controller timeline: down + restart for worker 1 only, with
+    # recovery accounting; every event schema-valid
+    ctrl = read_events(controller_path(str(tmp_path / "run.jsonl")))
+    for e in ctrl:
+        assert validate_event(e) is None, (e, validate_event(e))
+    acts = [(e["action"], e.get("worker")) for e in ctrl]
+    assert ("down", 1) in acts and ("restart", 1) in acts
+    assert ("down", 0) not in acts
+    restart = next(e for e in ctrl if e["action"] == "restart")
+    assert restart["reason"] == "exit:86"
+    assert restart["attempt"] == 1 and restart["recovery_s"] > 0
+    assert acts[-1] == ("stop", None)
+
+    # fleet_report renders the recovery next to the goodput buckets
+    import fleet_report
+    from telemetry_report import load_events
+    shards = {h: load_events(p) for h, p in
+              fleet_report.discover_shards(
+                  str(tmp_path / "run.jsonl")).items()}
+    ctrl_events, _ = load_events(
+        controller_path(str(tmp_path / "run.jsonl")))
+    s = fleet_report.fleet_summary(shards, controller=ctrl_events)
+    assert s["controller"]["restarts"] == 1
+    assert s["controller"]["recovery_s"] > 0
+    assert fleet_report.main([str(tmp_path / "run.jsonl")]) == 0
+
+    # and the dry-run replay of the RESOLVED incident decides "none"
+    import fleet_controller
+    d = fleet_controller.decide_worker(shards[1][0])
+    assert d["decision"] == "none" and d["reason"] == "ok"
+
+
+def test_controller_restarts_hung_worker_exit113(tmp_path):
+    """hang:<step> = the watchdog abort path: durable `hang` event,
+    exit 113 — the controller restarts with reason=hang."""
+    open(str(tmp_path / "w0.safetensors.injected"), "w").write("off")
+    r = _run_controller(tmp_path,
+                        _worker_cmd(tmp_path, 8, "--inject hang:3"))
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    shard1 = read_events(str(tmp_path / "run.jsonl.host1"))
+    assert any(e["event"] == "hang" for e in shard1)
+    steps = [e["step"] for e in shard1 if e["event"] == "step_stats"]
+    assert steps == list(range(1, 9))
+    ctrl = read_events(controller_path(str(tmp_path / "run.jsonl")))
+    restart = next(e for e in ctrl if e["action"] == "restart")
+    assert restart["worker"] == 1 and restart["reason"] == "hang"
+
+
+# --------------------------- shrink on lost worker --------------------------
+
+def test_controller_shrinks_fleet_on_lost_worker(tmp_path):
+    """Budget 0 + --allow_shrink: worker 0's kill makes it LOST; the
+    controller drains worker 1 (preemption drain — its shard ends with
+    run_end{reason=preempted} mid-fleet), relaunches it at hosts-1 with
+    resume, and the survivor completes from its drain checkpoint."""
+    open(str(tmp_path / "w1.safetensors.injected"), "w").write("off")
+    r = _run_controller(tmp_path,
+                        _worker_cmd(tmp_path, 10, "--inject kill:4"),
+                        budget=0, extra=("--allow_shrink",))
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    ctrl = read_events(controller_path(str(tmp_path / "run.jsonl")))
+    acts = [e["action"] for e in ctrl]
+    assert "lost" in acts and "shrink" in acts and "restart" not in acts
+    shrink = next(e for e in ctrl if e["action"] == "shrink")
+    assert shrink["worker"] == 0 and shrink["recovery_s"] > 0
+    # the survivor: drained mid-fleet, then resumed to completion
+    shard1 = read_events(str(tmp_path / "run.jsonl.host1"))
+    ends = [e for e in shard1 if e["event"] == "run_end"]
+    assert ends[0]["reason"] == "preempted"  # the shrink drain
+    assert ends[-1]["exit"] == "ok"
+    steps = [e["step"] for e in shard1 if e["event"] == "step_stats"]
+    assert steps == list(range(1, 11))  # no replayed or lost steps
+
+
+# --------------------------- fleet drain on SIGTERM -------------------------
+
+def test_controller_sigterm_drains_whole_fleet(tmp_path):
+    p = subprocess.Popen(
+        [sys.executable, CONTROLLER, "--hosts", "2",
+         "--telemetry", str(tmp_path / "run.jsonl"),
+         "--max_wall_s", "120",
+         "--cmd", _worker_cmd(tmp_path, 400)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=_env(), cwd=REPO)
+    try:
+        deadline = time.time() + 60
+        shard = str(tmp_path / "run.jsonl")
+        while time.time() < deadline:
+            if os.path.exists(shard) \
+                    and "step_stats" in open(shard).read():
+                break
+            time.sleep(0.1)
+        p.send_signal(signal.SIGTERM)
+        out, _ = p.communicate(timeout=60)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert p.returncode == 0, out
+    # every worker drained with the resumable contract
+    for h, path in ((0, shard), (1, shard + ".host1")):
+        recs = read_events(path)
+        end = recs[-1]
+        assert end["event"] == "run_end" \
+            and end["reason"] == "preempted", (h, end)
+        assert any(e["event"] == "preempt" for e in recs)
+    ctrl = read_events(controller_path(shard))
+    acts = [e["action"] for e in ctrl]
+    assert "drain" in acts and acts[-1] == "stop"
+
+
+# --------------------------- dry-run decision contract ----------------------
+
+def test_dry_run_decisions_over_recorded_incidents(tmp_path, capsys):
+    """--dry_run replays a recorded shard set through the SAME decision
+    function the live policy uses and prints one decision per worker:
+    ok->none, truncated->restart(crash), hang->restart(hang),
+    preempted->resume."""
+    base = str(tmp_path / "inc.jsonl")
+    manifest = dict(jax_version="sim", mesh_shape=None, process_count=4,
+                    process_index=0, device_kind="sim-cpu",
+                    device_count=4, config={})
+    ss = dict(loss=3.0, ema=3.0, lr=1e-4, grad_norm=0.5,
+              step_time_ms=10.0, host_wait_ms=0.0, slept_ms=0.0,
+              tok_s=100.0, mfu=None, param_norm=None, update_ratio=None,
+              nonfinite_count=None, hbm_mb=0.0, queue_depth=None,
+              host_step_ms=None)
+    # host 0: clean completion
+    with Telemetry(base, host=0) as tel:
+        tel.emit("run_start", **manifest)
+        tel.emit("step_stats", step=6, **ss)
+        tel.emit("run_end", steps=6, wall_s=1.0, exit="ok", goodput=None)
+    # host 1: SIGKILLed (truncated — no run_end)
+    with Telemetry(base + ".host1", host=1) as tel:
+        tel.emit("run_start", **manifest)
+        tel.emit("step_stats", step=4, **ss)
+    # host 2: watchdog hang fired, process wedged (no run_end)
+    with Telemetry(base + ".host2", host=2) as tel:
+        tel.emit("run_start", **manifest)
+        tel.emit("step_stats", step=5, **ss)
+        tel.emit("hang", step=5, stall_s=120.0, deadline_s=60.0,
+                 stacks_file="", device_probe="timeout", action="abort")
+    # host 3: preemption-drained
+    with Telemetry(base + ".host3", host=3) as tel:
+        tel.emit("run_start", **manifest)
+        tel.emit("step_stats", step=3, **ss)
+        tel.emit("preempt", step=4, signal="SIGTERM")
+        tel.emit("run_end", steps=4, wall_s=1.0, exit="preempted",
+                 goodput=None, reason="preempted")
+    import fleet_controller
+    assert fleet_controller.main(["--telemetry", base, "--dry_run"]) == 0
+    out = capsys.readouterr().out.splitlines()
+    assert "DRYRUN worker=0 decision=none reason=ok step=6" in out[0]
+    assert "DRYRUN worker=1 decision=restart reason=crash step=4" in out[1]
+    assert "DRYRUN worker=2 decision=restart reason=hang step=5" in out[2]
+    assert ("DRYRUN worker=3 decision=resume reason=preempted step=3"
+            in out[3])
+
+
+# --------------------------- review-fix regressions -------------------------
+
+def test_preempted_worker_resumes_without_burning_budget(tmp_path,
+                                                         monkeypatch):
+    """A worker exit-75 OUTSIDE a controller drain (the platform
+    preempted it directly) is a clean resume — scheduled relaunch,
+    reason=preempted, restart budget untouched — matching what
+    decide_worker says about the same shard."""
+    import argparse
+    import fleet_controller
+    args = argparse.Namespace(
+        telemetry=str(tmp_path / "r.jsonl"), cmd="true", hosts=1,
+        restart_budget=1, backoff_s=0.01, resume_flags="--resume",
+        resume_first=False, allow_shrink=False, min_hosts=1,
+        kill_on_hang=1, drain_timeout_s=1.0, poll_s=0.01,
+        max_wall_s=0.0)
+    fc = fleet_controller.FleetController(args)
+    fc.guard.uninstall()  # unit test: no signal handlers left behind
+    spawned = []
+    monkeypatch.setattr(fc, "spawn", lambda w: spawned.append(w.host))
+    w = fc.workers[0]
+    fc.handle_exit(w, EXIT_PREEMPTED)
+    assert w.attempts == 0          # no budget burned
+    assert not w.lost and not w.done
+    assert w.relaunch_at is not None and w.down_reason == "preempted"
+    time.sleep(0.02)
+    fc.maybe_relaunch(w)
+    assert spawned == [0] and w.restarted
+    # a real crash afterwards still burns budget exactly once
+    fc.handle_exit(w, 86)
+    assert w.attempts == 1 and w.relaunch_at is not None
+    fc.tel.close()
+    ctrl = read_events(controller_path(str(tmp_path / "r.jsonl")))
+    acts = [(e["action"], e.get("reason")) for e in ctrl]
+    assert ("down", "preempted") in acts
+    assert ("restart", "preempted") in acts
+    restart = next(e for e in ctrl if e["action"] == "restart")
+    assert restart["attempt"] is None  # unbudgeted resume
+
+
+def test_shard_tail_ignores_preexisting_history(tmp_path):
+    """The live tail starts at END of file: a previous session's hang
+    events must not SIGKILL a freshly launched healthy worker (history
+    belongs to --dry_run, not the live policy)."""
+    import fleet_controller
+    path = str(tmp_path / "old.jsonl")
+    with Telemetry(path, host=0) as tel:
+        tel.emit("step_stats", step=9, loss=3.0, ema=3.0, lr=1e-4,
+                 grad_norm=0.5, step_time_ms=10.0, host_wait_ms=0.0,
+                 slept_ms=0.0, tok_s=100.0, mfu=None, param_norm=None,
+                 update_ratio=None, nonfinite_count=None, hbm_mb=0.0,
+                 queue_depth=None, host_step_ms=None)
+        tel.emit("hang", step=9, stall_s=120.0, deadline_s=60.0,
+                 stacks_file="", device_probe="timeout", action="abort")
+    tail = fleet_controller.ShardTail(path)
+    tail.poll()
+    assert tail.hangs == 0 and tail.last_step is None  # history skipped
+    with Telemetry(path, host=0) as tel:  # the NEW session's events
+        tel.emit("hang", step=12, stall_s=90.0, deadline_s=60.0,
+                 stacks_file="", device_probe="ok", action="continue")
+    tail.poll()
+    assert tail.hangs == 1  # live events still observed
+
+
+def test_controller_summary_scopes_to_latest_session():
+    """Recovery accounting over an appended controller stream counts
+    only the latest session — a prior run's restarts must not inflate
+    this run's recovery line."""
+    from telemetry_report import controller_entries, controller_summary
+    mk = lambda seq, **kw: {"event": "controller", "seq": seq, "t": float(seq),
+                            "action": kw.pop("action"),
+                            "worker": kw.pop("worker", None),
+                            "reason": kw.pop("reason", None),
+                            "attempt": kw.pop("attempt", None),
+                            "backoff_s": None,
+                            "step": None,
+                            "recovery_s": kw.pop("recovery_s", None)}
+    events = [
+        # session 1: two restarts, closed with stop
+        mk(0, action="launch", worker=0),
+        mk(1, action="restart", worker=0, recovery_s=5.0),
+        mk(2, action="restart", worker=0, recovery_s=5.0),
+        mk(3, action="stop"),
+        # session 2 (latest): one restart
+        mk(4, action="launch", worker=0),
+        mk(5, action="restart", worker=0, recovery_s=1.25),
+        mk(6, action="stop"),
+    ]
+    s = controller_summary(controller_entries(events))
+    assert s["restarts"] == 1
+    assert s["recovery_s"] == pytest.approx(1.25)
+    # a live (unterminated) latest session scopes the same way
+    s2 = controller_summary(controller_entries(events[:6]))
+    assert s2["restarts"] == 1 and s2["recovery_s"] == pytest.approx(1.25)
+    # a SIGKILLed session 1 (no stop/give_up ever written) must not
+    # bleed into session 2 either: sessions are delimited by the
+    # launch burst, not just terminators
+    no_term = [e for e in events if e["seq"] != 3]
+    s3 = controller_summary(controller_entries(no_term))
+    assert s3["restarts"] == 1 and s3["recovery_s"] == pytest.approx(1.25)
+
+
+# --------------------------- sim-kill fixture dry run -----------------------
+
+def test_dry_run_contract_against_simulated_kill_shards(tmp_path):
+    """The dry run replayed against REAL sim-worker output: run the kill
+    fixture to its crash (no controller), then assert the dry-run
+    decision is restart/crash with the last checkpointed step."""
+    r = subprocess.run(
+        [sys.executable, SMOKE, "--sim_worker", "--host", "0",
+         "--hosts", "1", "--steps", "10",
+         "--telemetry", str(tmp_path / "k.jsonl"),
+         "--ckpt", str(tmp_path / "k.safetensors"),
+         "--step_ms", "5", "--inject", "kill:3"],
+        capture_output=True, text=True, env=_env(), cwd=REPO,
+        timeout=60)
+    assert r.returncode == 86  # the hard-kill exit
+    import fleet_controller
+    from telemetry_report import load_events
+    events, _ = load_events(str(tmp_path / "k.jsonl"))
+    d = fleet_controller.decide_worker(events)
+    assert d == {"decision": "restart", "reason": "crash", "step": 3}

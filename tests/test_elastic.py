@@ -1,0 +1,457 @@
+"""Elastic-fleet robustness tests (DESIGN.md §18): preemption drain
+(SIGTERM -> one step + one drain -> resumable exit), mesh-shape-agnostic
+resume (save at mesh (1,4), resume at (1,2)/(1,1) with the loss
+trajectory matching the uninterrupted run and the Adam sidecar
+byte-equal through the re-shard round trip), the streaming-data bounded
+retry, the coordinator-connect retry, and the watchdog's flush-before-
+abort stream hygiene."""
+
+import csv
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from mobilefinetuner_tpu.core.preempt import EXIT_PREEMPTED, PreemptionGuard
+from mobilefinetuner_tpu.core.telemetry import validate_event
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from fixtures import write_tiny_gpt2_dir, write_wikitext_dir
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def read_events(path):
+    out = []
+    with open(path) as f:
+        for line in f.read().splitlines():
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+# --------------------------- preemption guard (unit) ------------------------
+
+def test_preemption_guard_sets_flag_then_escalates():
+    prev_term = signal.getsignal(signal.SIGTERM)
+    guard = PreemptionGuard().install()
+    assert guard.installed
+    assert signal.getsignal(signal.SIGTERM) == guard._handler
+    try:
+        assert not guard.triggered
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.05)  # delivery is at the next bytecode boundary
+        assert guard.triggered and guard.signal_name == "SIGTERM"
+        # a SECOND signal aborts the drain (the operator outranks a
+        # wedged final save)
+        with pytest.raises(KeyboardInterrupt):
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(0.2)
+    finally:
+        guard.uninstall()
+    # handlers restored: SIGTERM is back to whatever it was before
+    assert signal.getsignal(signal.SIGTERM) == prev_term
+
+
+# --------------------------- fixtures ---------------------------------------
+
+@pytest.fixture(scope="module")
+def gpt2_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("gpt2elastic")
+    write_tiny_gpt2_dir(str(d))
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def gpt2_big_dir(tmp_path_factory):
+    """n_embd=128 so stacked per-layer leaves exceed FSDP min_size —
+    the (1,4)->(1,2) resume genuinely re-shards, not just re-replicates."""
+    d = tmp_path_factory.mktemp("gpt2elastic_big")
+    write_tiny_gpt2_dir(str(d), n_embd=128)
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def wiki_dir(tmp_path_factory):
+    return write_wikitext_dir(str(tmp_path_factory.mktemp("wt2elastic")))
+
+
+# --------------------------- SIGTERM drain e2e ------------------------------
+
+def test_cli_sigterm_drain_e2e(gpt2_dir, wiki_dir, tmp_path):
+    """The acceptance criterion: a subprocess training run receiving
+    SIGTERM mid-run exits with the RESUMABLE code, leaves a loadable
+    atomic checkpoint at the drain step, and its stream ends with a
+    schema-valid run_end{reason=preempted} — then an actual resume
+    continues from that step."""
+    stream = str(tmp_path / "run.jsonl")
+    adapter = str(tmp_path / "a.safetensors")
+    p = subprocess.Popen(
+        [sys.executable, "-m",
+         "mobilefinetuner_tpu.cli.gpt2_lora_finetune",
+         "--pretrained_dir", gpt2_dir, "--data_dir", wiki_dir,
+         "--steps", "500", "--batch_size", "2", "--seq_len", "32",
+         "--lora_out", adapter, "--telemetry_out", stream,
+         "--log_interval", "1", "--pm_schedule", "0-:15"],
+        cwd=REPO, env=_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        # wait until the run is PAST compile and mid-training (a
+        # step_stats flush proves a completed step)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if os.path.exists(stream) \
+                    and "step_stats" in open(stream).read():
+                break
+            if p.poll() is not None:
+                pytest.fail(f"run died early:\n{p.communicate()[0]}")
+            time.sleep(0.1)
+        else:
+            pytest.fail("run never reached a training step")
+        p.send_signal(signal.SIGTERM)
+        out, _ = p.communicate(timeout=60)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert p.returncode == EXIT_PREEMPTED, out
+
+    recs = read_events(stream)
+    for r in recs:
+        assert validate_event(r) is None, (r, validate_event(r))
+    kinds = [r["event"] for r in recs]
+    assert "preempt" in kinds
+    pre = next(r for r in recs if r["event"] == "preempt")
+    assert pre["signal"] == "SIGTERM"
+    end = recs[-1]
+    assert end["event"] == "run_end"
+    assert end["exit"] == "preempted" and end["reason"] == "preempted"
+    # the drain took a FINAL checkpoint and it landed before run_end
+    cks = [r for r in recs if r["event"] == "checkpoint"]
+    assert cks and cks[-1]["final"] is True
+    # the checkpoint is loadable and carries the drain step
+    assert os.path.exists(adapter) and os.path.exists(adapter + ".opt")
+    from mobilefinetuner_tpu.io.safetensors_io import SafeTensorsReader
+    saved_step = int(np.asarray(
+        SafeTensorsReader(adapter + ".opt").load_all()["step"]))
+    assert saved_step == pre["step"]
+
+    # resume: the step counter survives and the run completes
+    from mobilefinetuner_tpu.cli.gpt2_lora_finetune import main
+    rc = main(["--pretrained_dir", gpt2_dir, "--data_dir", wiki_dir,
+               "--steps", str(saved_step + 2), "--batch_size", "2",
+               "--seq_len", "32", "--lora_out", adapter,
+               "--resume_from", adapter, "--telemetry_out", stream])
+    assert rc == 0
+    end2 = read_events(stream)[-1]
+    assert end2["event"] == "run_end" and end2["exit"] == "ok"
+    assert end2["steps"] == 2  # exactly the un-run remainder
+
+
+# --------------------------- mesh-shrink resume parity ----------------------
+
+def _losses(csv_path):
+    with open(csv_path) as f:
+        return {int(r["step"]): float(r["loss"])
+                for r in csv.DictReader(f)}
+
+
+def test_mesh_shrink_resume_parity_full_ft(gpt2_big_dir, wiki_dir,
+                                           tmp_path):
+    """The acceptance criterion: a full-FT checkpoint saved at mesh
+    (1,4) resumes at (1,2) and (1,1) — step counter, FSDP'd Adam
+    sidecar, and skip_steps data fast-forward all survive the reshape —
+    and the post-resume loss trajectory matches the uninterrupted
+    (1,4) baseline (tolerance covers cross-mesh reduction-order float
+    drift; the data order is bit-identical by construction)."""
+    from mobilefinetuner_tpu.cli.gpt2_full_finetune import main
+    base = ["--pretrained_dir", gpt2_big_dir, "--data_dir", wiki_dir,
+            "--batch_size", "4", "--seq_len", "32", "--log_interval", "1"]
+    ck = str(tmp_path / "full.safetensors")
+
+    # ONE uninterrupted (1,4) run is both the baseline trajectory AND
+    # (via --save_every 3) the interruption point: the periodic step-3
+    # checkpoint is exactly what a preempted run would resume from —
+    # same total_steps, so the LR schedule matches by construction.
+    csv_a = str(tmp_path / "a.csv")
+    assert main(base + ["--steps", "6", "--mesh_fsdp", "4",
+                        "--save_every", "3", "--metrics_csv", csv_a,
+                        "--output_path", ck]) == 0
+    baseline = _losses(csv_a)
+    assert set(baseline) == {1, 2, 3, 4, 5, 6}
+    ck3 = str(tmp_path / "full_step3.safetensors")
+    assert os.path.exists(ck3) and os.path.exists(ck3 + ".opt")
+
+    for fsdp in ("2", "1"):
+        csv_r = str(tmp_path / f"r{fsdp}.csv")
+        assert main(base + ["--steps", "6", "--mesh_fsdp", fsdp,
+                            "--resume_from", ck3, "--metrics_csv", csv_r,
+                            "--output_path",
+                            str(tmp_path / f"y{fsdp}.safetensors")]) == 0
+        resumed = _losses(csv_r)
+        # step counter + skip_steps survived: exactly steps 4..6 ran
+        assert set(resumed) == {4, 5, 6}, resumed
+        for s in (4, 5, 6):
+            assert resumed[s] == pytest.approx(baseline[s], rel=1e-5), \
+                (fsdp, s, resumed[s], baseline[s])
+
+
+def test_opt_sidecar_reshard_byte_roundtrip(tmp_path):
+    """Adam sidecar values are BYTE-equal after the save -> load ->
+    place-at-a-different-mesh -> gather round trip, and the big leaves
+    actually land FSDP-sharded at the new mesh (placement is data
+    movement, never arithmetic)."""
+    from mobilefinetuner_tpu.cli import common
+    from mobilefinetuner_tpu.optim import adam as adam_mod
+    from mobilefinetuner_tpu.parallel.mesh import make_mesh
+    from mobilefinetuner_tpu.train.trainer import (TrainConfig,
+                                                   init_optimizer)
+    rng = np.random.default_rng(0)
+    params = {"big": rng.standard_normal((64, 2048)).astype(np.float32),
+              "small": rng.standard_normal((7,)).astype(np.float32)}
+    state = {"step": np.asarray(17, np.int32),
+             "m": {k: rng.standard_normal(v.shape).astype(np.float32)
+                   for k, v in params.items()},
+             "v": {k: np.abs(rng.standard_normal(v.shape)
+                             ).astype(np.float32)
+                   for k, v in params.items()}}
+    tc = TrainConfig(total_steps=10)
+    path = str(tmp_path / "s.opt")
+    adam_mod.save_state(path, state, tc.adam())
+
+    template = jax.eval_shape(lambda t: init_optimizer(t, tc, None),
+                              params)
+    loaded, _ = adam_mod.load_state(path, template, to_host=True)
+    # host-side load: nothing committed to a device yet
+    assert all(isinstance(x, np.ndarray) for x in jax.tree.leaves(loaded))
+    assert int(loaded["step"]) == 17
+
+    mesh2 = make_mesh(data=1, fsdp=2, devices=jax.devices()[:2])
+    placed = common.place_opt_state(loaded, mesh2)
+    # the big leaves re-sharded at the NEW mesh shape
+    assert "fsdp" in str(placed["m"]["big"].sharding.spec)
+    assert "fsdp" in str(placed["v"]["big"].sharding.spec)
+    # byte equality through the round trip
+    for key in ("m", "v"):
+        for leaf in ("big", "small"):
+            np.testing.assert_array_equal(
+                np.asarray(placed[key][leaf]), state[key][leaf])
+    assert int(placed["step"]) == 17
+
+
+# --------------------------- streaming-data retry ---------------------------
+
+EOS = 999
+
+
+def _encode(line: str):
+    return [abs(hash(w)) % 900 for w in line.split()]
+
+
+@pytest.fixture()
+def corpus_file(tmp_path):
+    path = str(tmp_path / "wiki.train.tokens")
+    rng = np.random.default_rng(0)
+    with open(path, "w") as f:
+        for _ in range(120):
+            n = int(rng.integers(3, 20))
+            f.write(" ".join(f"w{rng.integers(0, 300)}"
+                             for _ in range(n)) + "\n")
+    return path
+
+
+def _make_flaky(path, retries, backoff=0.001):
+    from mobilefinetuner_tpu.data.wikitext2 import (WT2Config,
+                                                    WikiText2Dataset)
+
+    class Flaky(WikiText2Dataset):
+        fail_next = 0
+
+        def _open_text(self, p):
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                raise OSError(f"transient I/O ({self.fail_next} left)")
+            return super()._open_text(p)
+
+    cfg = WT2Config(seq_len=16, batch_size=2, shuffle=False,
+                    streaming=True, window_tokens=48, retries=retries,
+                    retry_backoff_s=backoff)
+    return Flaky(path, "train", cfg, _encode, eos_id=EOS)
+
+
+def test_streaming_refetch_retries_then_succeeds(corpus_file):
+    """Satellite: two injected failures then success — data identical
+    to the clean read, one anomaly-shaped event per retry, run alive."""
+    from mobilefinetuner_tpu.data.wikitext2 import (WT2Config,
+                                                    WikiText2Dataset)
+    clean = WikiText2Dataset(
+        corpus_file, "train",
+        WT2Config(seq_len=16, batch_size=2, shuffle=False,
+                  streaming=True, window_tokens=48),
+        _encode, eos_id=EOS)
+    ds = _make_flaky(corpus_file, retries=3)
+    events = []
+    ds.event_sink = lambda **f: events.append(f)
+    far = ds.num_chunks - 1  # outside the resident window: forces I/O
+    ds.fail_next = 2
+    got = ds._chunk_tokens(far)
+    np.testing.assert_array_equal(got, clean._chunk_tokens(far))
+    assert len(events) == 2
+    for i, e in enumerate(events):
+        assert e["kind"] == "data_retry"
+        assert e["attempt"] == i + 1
+        assert "transient I/O" in e["error"]
+        assert e["backoff_s"] > 0
+    # the next (clean) fetch emits nothing
+    ds.fail_next = 0
+    ds._chunk_tokens(0)
+    assert len(events) == 2
+
+
+def test_production_retry_sink_emits_valid_anomaly(corpus_file,
+                                                   tmp_path):
+    """The PRODUCTION sink (common.make_data_retry_sink — what
+    run_training actually wires) against the real _io_retry payload:
+    the dataset swallows sink exceptions by design, so an argument
+    mismatch here would silently eat the telemetry forever (it did,
+    once: kind was passed twice). The event must land in a real stream
+    and pass the schema validator."""
+    from mobilefinetuner_tpu.cli.common import make_data_retry_sink
+    from mobilefinetuner_tpu.core.telemetry import Telemetry
+    ds = _make_flaky(corpus_file, retries=3)
+    stream = str(tmp_path / "retry.jsonl")
+    tel = Telemetry(stream)
+    ds.event_sink = make_data_retry_sink(tel, {"step": 7})
+    ds.fail_next = 2
+    ds._chunk_tokens(ds.num_chunks - 1)  # survives via two retries
+    tel.close()
+    recs = read_events(stream)
+    assert len(recs) == 2, recs  # one anomaly PER retry, none eaten
+    for r in recs:
+        assert validate_event(r) is None, (r, validate_event(r))
+        assert r["event"] == "anomaly" and r["kind"] == "data_retry"
+        assert r["step"] == 8  # cur_step + 1
+        assert "transient I/O" in r["error"] and r["backoff_s"] > 0
+
+
+def test_streaming_refetch_budget_exhausted_raises(corpus_file):
+    ds = _make_flaky(corpus_file, retries=1)
+    ds.fail_next = 5
+    with pytest.raises(OSError, match="transient"):
+        ds._chunk_tokens(ds.num_chunks - 1)
+
+
+def test_retries_off_fails_fast(corpus_file):
+    ds = _make_flaky(corpus_file, retries=0)
+    events = []
+    ds.event_sink = lambda **f: events.append(f)
+    ds.fail_next = 1
+    with pytest.raises(OSError):
+        ds._chunk_tokens(ds.num_chunks - 1)
+    assert events == []  # fail-fast: no retry happened
+
+
+# --------------------------- coordinator-connect retry ----------------------
+
+def test_initialize_retries_coordinator_then_succeeds(monkeypatch):
+    from mobilefinetuner_tpu.parallel import distributed as dist
+    monkeypatch.setattr(dist, "_INITIALIZED", False)
+    calls = []
+
+    def flaky(**kw):
+        calls.append(kw)
+        if len(calls) < 3:
+            raise RuntimeError(f"connection refused #{len(calls)}")
+
+    monkeypatch.setattr(dist.jax.distributed, "initialize", flaky)
+    assert dist.initialize(coordinator="127.0.0.1:1", num_processes=1,
+                           process_id=0, connect_retries=4,
+                           connect_backoff_s=0.001) is True
+    assert len(calls) == 3  # two failures absorbed by the backoff
+
+
+def test_initialize_raises_original_error_after_budget(monkeypatch):
+    from mobilefinetuner_tpu.parallel import distributed as dist
+    monkeypatch.setattr(dist, "_INITIALIZED", False)
+    errs = []
+
+    def always_fail(**kw):
+        errs.append(RuntimeError(f"refused #{len(errs) + 1}"))
+        raise errs[-1]
+
+    monkeypatch.setattr(dist.jax.distributed, "initialize", always_fail)
+    with pytest.raises(RuntimeError) as ei:
+        dist.initialize(coordinator="127.0.0.1:1", num_processes=1,
+                        process_id=0, connect_retries=2,
+                        connect_backoff_s=0.001)
+    assert len(errs) == 3          # budget: 1 try + 2 retries
+    assert ei.value is errs[0]     # the ORIGINAL error, not the last
+
+
+def test_initialize_autodetect_failure_never_retries(monkeypatch):
+    """--multihost with nothing to address keeps the degrade-to-single-
+    process behavior — exactly one attempt."""
+    from mobilefinetuner_tpu.parallel import distributed as dist
+    monkeypatch.setattr(dist, "_INITIALIZED", False)
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    calls = []
+
+    def fail(**kw):
+        calls.append(kw)
+        raise RuntimeError("no pod metadata")
+
+    monkeypatch.setattr(dist.jax.distributed, "initialize", fail)
+    assert dist.initialize(force=True, connect_retries=5,
+                           connect_backoff_s=0.001) is False
+    assert len(calls) == 1
+
+
+# --------------------------- watchdog abort flush ---------------------------
+
+def test_watchdog_abort_flushes_and_terminates_stream(tmp_path):
+    """Satellite regression: after a forced exit-113 abort, the shard
+    read back is clean — every line complete (the hang record included),
+    the file newline-terminated — because the abort path runs the
+    telemetry flush barrier before os._exit."""
+    stream = str(tmp_path / "wd.jsonl")
+    script = textwrap.dedent(f"""
+        import sys, time
+        sys.path.insert(0, {REPO!r})
+        from mobilefinetuner_tpu.core.telemetry import (Telemetry,
+                                                        HangWatchdog)
+        tel = Telemetry({stream!r})
+        tel.emit("eval", step=1, loss=1.0, ppl=2.0, tokens=3)
+        wd = HangWatchdog(mult=2.0, min_deadline_s=0.15, grace_s=0.15,
+                          abort=True,
+                          stacks_file={stream!r} + ".stacks",
+                          on_hang=lambda p: tel.emit(
+                              "hang", last_seq=tel.last_seq, **p),
+                          flush_fn=tel.flush_tail)
+        wd.start()
+        time.sleep(30)   # the watchdog aborts us at ~0.15 s
+    """)
+    r = subprocess.run([sys.executable, "-c", script], env=_env(),
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 113, (r.returncode, r.stderr)
+    raw = open(stream, "rb").read()
+    assert raw.endswith(b"\n")  # no truncated tail line
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from telemetry_report import load_events
+    events, bad = load_events(stream)
+    assert bad == 0
+    kinds = [e["event"] for e in events]
+    assert kinds == ["eval", "hang"]
+    assert events[-1]["action"] == "abort"

@@ -67,9 +67,16 @@ REPRESENTATIVE = {
     "request": dict(id=3, phase="finish", prompt_tokens=17, adapter=1,
                     queue_ms=4.2, new_tokens=32, ttft_ms=81.0,
                     tpot_ms=9.5),
+    # round-13 elastic fleet (DESIGN.md §18): the drain marker and the
+    # fleet controller's decision timeline
+    "preempt": dict(step=7, signal="SIGTERM"),
+    "controller": dict(action="restart", worker=1, reason="exit:113",
+                       attempt=1, backoff_s=0.5, step=5,
+                       recovery_s=0.82),
     "run_end": dict(steps=10, wall_s=60.0, exit="ok",
                     goodput={"total_s": 60.0, "step_s": 50.0,
-                             "productive_frac": 0.83}),
+                             "productive_frac": 0.83},
+                    reason=None),
 }
 
 

@@ -1,0 +1,494 @@
+"""Fleet controller: turn the observability sensors into automatic
+recovery (ROADMAP item 4, DESIGN.md §18).
+
+Rounds 8-10 built the sensors — per-host telemetry shards, straggler
+attribution, the hang watchdog (`hang` events + exit 113), goodput
+buckets, atomic async checkpoints. This supervisor closes the loop: it
+launches one worker subprocess per host, tails the per-host shards
+LIVE, and enacts policy:
+
+  * **restart**: a worker that exits nonzero (crash, or the watchdog's
+    113) — or whose shard shows a `hang` event while the process is
+    still wedged (`--kill_on_hang`) — is relaunched with `{resume}`
+    flags after exponential backoff, up to `--restart_budget` attempts.
+    Training resumes from the last ATOMIC checkpoint (the round-10
+    publication guarantee is what makes blind restart safe).
+  * **shrink**: a worker whose budget is exhausted is declared LOST;
+    with `--allow_shrink` the controller drains the survivors (SIGTERM
+    → they exit EXIT_PREEMPTED with a final checkpoint) and relaunches
+    the fleet at `hosts-1` — the `{hosts}` template field carries the
+    new size, so a real launch can re-mesh (`--mesh_data`), and every
+    relaunched worker `{resume}`s from its drain checkpoint.
+  * **drain**: the controller's OWN SIGTERM/SIGINT forwards to every
+    worker and waits for the preemption-drain exits — one signal
+    cleanly parks the whole fleet.
+
+Every decision is emitted as a `controller` telemetry event to
+`<base>.controller` (its own stream — interleaving a second writer into
+a worker shard would corrupt the (host, seq) merge key), which
+`tools/fleet_report.py` renders next to the goodput buckets: recovery
+cost becomes a visible line, not a mystery gap in step reach.
+
+A clean worker exit is 0. EXIT_PREEMPTED (75) during a controller-
+initiated drain counts as clean; OUTSIDE one (the platform preempted
+the worker directly) it drained cleanly and is resumed after the base
+backoff WITHOUT burning restart budget — the same verdict
+`decide_worker` reaches replaying that shard. Everything else is a
+failure that counts against the budget.
+
+`--dry_run` replays a RECORDED shard set through the same decision
+function and prints what the live policy would do — the cheap
+contract-testable mode, and an operator's post-mortem tool.
+
+Usage:
+  python tools/fleet_controller.py --hosts 2 --telemetry run.jsonl \\
+      --cmd "python tools/multihost_smoke.py --sim_worker --host {host} \\
+             --hosts {hosts} --steps 20 --telemetry run.jsonl \\
+             --ckpt w{host}.safetensors {resume}" \\
+      --restart_budget 2 --backoff_s 0.5 --allow_shrink
+  python tools/fleet_controller.py --telemetry run.jsonl --dry_run
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from telemetry_report import load_events, split_latest_run  # noqa: E402
+
+from mobilefinetuner_tpu.core.preempt import (EXIT_PREEMPTED,  # noqa: E402
+                                              PreemptionGuard)
+from mobilefinetuner_tpu.core.telemetry import (Telemetry,  # noqa: E402
+                                                controller_path,
+                                                shard_path)
+
+
+# --------------------------- shard tailing ----------------------------------
+
+class ShardTail:
+    """Incremental reader over one worker's telemetry shard: consumes
+    only COMPLETE lines (a worker killed mid-write leaves a partial
+    tail; we wait for the newline rather than mis-parse), tracking the
+    facts the live policy needs — last observed step and hang-event
+    count (exit CODES carry the rest; run_end records are the dry-run
+    replay's input, not the live tail's)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        # start tailing at the CURRENT end of file: shards append across
+        # controller sessions (Telemetry resumes seq), and replaying a
+        # previous run's hang events into the live policy would SIGKILL
+        # a freshly launched healthy worker (--dry_run is the tool that
+        # reads history; the live tail reads only what happens now)
+        try:
+            self._off = os.path.getsize(path)
+        except OSError:
+            self._off = 0
+        self.last_step: Optional[int] = None
+        self.hangs = 0
+
+    def poll(self) -> None:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return  # not written yet
+        if size <= self._off:
+            return
+        with open(self.path, "rb") as f:
+            f.seek(self._off)
+            buf = f.read(size - self._off)
+        nl = buf.rfind(b"\n")
+        if nl < 0:
+            return
+        self._off += nl + 1
+        for raw in buf[:nl + 1].splitlines():
+            try:
+                rec = json.loads(raw)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue
+            if not isinstance(rec, dict):
+                continue
+            ev = rec.get("event")
+            if ev == "step_stats" and isinstance(rec.get("step"), int):
+                self.last_step = rec["step"]
+            elif ev == "hang":
+                self.hangs += 1
+
+
+# --------------------------- decision function ------------------------------
+
+def decide_worker(events) -> dict:
+    """One worker shard -> the decision the live policy would take for
+    it: the SHARED logic behind --dry_run (replay a recorded incident)
+    and the operator's post-mortem reading. Scoped to the shard's
+    LATEST run (telemetry_report's resume-append rule)."""
+    truncated, latest = split_latest_run(events)
+    stats = [e for e in latest if e.get("event") == "step_stats"]
+    last_step = stats[-1]["step"] if stats else None
+    hangs = [e for e in latest if e.get("event") == "hang"]
+    ends = [e for e in latest if e.get("event") == "run_end"]
+    if hangs and not ends:
+        return {"decision": "restart", "reason": "hang",
+                "step": hangs[-1].get("step", last_step)}
+    if truncated or not ends:
+        return {"decision": "restart", "reason": "crash",
+                "step": last_step}
+    end = ends[-1]
+    if end.get("reason") == "preempted" or end.get("exit") == "preempted":
+        return {"decision": "resume", "reason": "preempted",
+                "step": last_step}
+    if end.get("exit") != "ok":
+        return {"decision": "restart",
+                "reason": f"exit:{end.get('exit')}", "step": last_step}
+    return {"decision": "none", "reason": "ok", "step": last_step}
+
+
+def dry_run(base: str) -> int:
+    """Replay a recorded shard set; print (don't enact) the decisions."""
+    import fleet_report
+    shards = fleet_report.discover_shards(base)
+    if not shards:
+        print(f"error: no telemetry shards at {base}", file=sys.stderr)
+        return 1
+    for host, path in sorted(shards.items()):
+        events, bad = load_events(path)
+        d = decide_worker(events)
+        print(f"DRYRUN worker={host} decision={d['decision']} "
+              f"reason={d['reason']} step={d['step']}"
+              + (f" invalid_lines={bad}" if bad else ""))
+    return 0
+
+
+# --------------------------- the live controller ----------------------------
+
+class _W:
+    __slots__ = ("host", "proc", "attempts", "done", "lost", "tail",
+                 "seen_hangs", "restarted", "relaunch_at", "down_t",
+                 "down_reason", "pending_attempt", "backoff")
+
+    def __init__(self, host: int, tail: ShardTail):
+        self.host = host
+        self.proc: Optional[subprocess.Popen] = None
+        self.attempts = 0          # budgeted restarts consumed
+        self.done = False
+        self.lost = False
+        self.tail = tail
+        self.seen_hangs = 0        # hang events already acted on
+        self.restarted = False     # next spawn passes {resume}
+        # scheduled-relaunch state: handle_exit sets a DEADLINE instead
+        # of sleeping the backoff inline — an inline sleep would stall
+        # monitoring of every other worker (and the controller's own
+        # SIGTERM) for the whole backoff
+        self.relaunch_at: Optional[float] = None
+        self.down_t = 0.0
+        self.down_reason = ""
+        self.pending_attempt: Optional[int] = None
+        self.backoff = 0.0
+
+
+class FleetController:
+    def __init__(self, args):
+        self.args = args
+        self.tel = Telemetry(controller_path(args.telemetry), host=0)
+        self.workers: Dict[int, _W] = {
+            k: _W(k, ShardTail(shard_path(args.telemetry, k)))
+            for k in range(args.hosts)}
+        self.active_hosts = args.hosts
+        self.guard = PreemptionGuard().install()
+        self.t0 = time.time()
+
+    # -- helpers --------------------------------------------------------------
+
+    def record(self, action: str, worker=None, reason=None, attempt=None,
+             backoff_s=None, step=None, recovery_s=None):
+        self.tel.emit("controller", action=action, worker=worker,
+                      reason=reason, attempt=attempt,
+                      backoff_s=backoff_s, step=step,
+                      recovery_s=recovery_s)
+        bits = [f"controller: {action}"]
+        if worker is not None:
+            bits.append(f"worker={worker}")
+        if reason:
+            bits.append(f"reason={reason}")
+        if step is not None:
+            bits.append(f"step={step}")
+        print("  ".join(bits), flush=True)
+
+    def spawn(self, w: _W) -> None:
+        cmd = self.args.cmd.format(
+            host=w.host, hosts=self.active_hosts,
+            resume=(self.args.resume_flags if w.restarted else ""))
+        # own session: a terminal Ctrl-C must reach ONLY the controller
+        # — if workers shared the foreground process group they would
+        # get the SIGINT directly AND the controller's drain SIGTERM,
+        # and a worker's PreemptionGuard treats the second signal as
+        # "abort the drain" (losing the final checkpoint). All worker
+        # signalling is explicit, from the drain/kill paths here.
+        w.proc = subprocess.Popen(shlex.split(cmd),
+                                  start_new_session=True)
+
+    def alive(self):
+        return [w for w in self.workers.values()
+                if w.proc is not None and w.proc.poll() is None]
+
+    def signal_all(self, sig) -> None:
+        for w in self.alive():
+            try:
+                w.proc.send_signal(sig)
+            except OSError:
+                pass
+
+    def wait_all(self, timeout_s: float) -> int:
+        """Wait for every live worker; force-kill past the deadline.
+        Marks clean completions (rc 0) done — an exit that lands during
+        a drain window never reaches handle_exit, and a finished worker
+        must not be respawned by a subsequent shrink relaunch. Returns
+        the number of workers that had to be SIGKILLed (their drain
+        checkpoint never landed)."""
+        deadline = time.time() + timeout_s
+        killed = 0
+        for w in list(self.workers.values()):
+            if w.proc is None:
+                continue
+            try:
+                w.proc.wait(timeout=max(deadline - time.time(), 0.1))
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+                w.proc.wait()
+                killed += 1
+            if w.proc.returncode == 0:
+                w.done = True
+                w.proc = None
+        return killed
+
+    # -- policy ---------------------------------------------------------------
+
+    def handle_exit(self, w: _W, rc: int) -> None:
+        reason = "hang" if rc == 113 else f"exit:{rc}"
+        if w.seen_hangs < w.tail.hangs:
+            reason = "hang"  # the shard names the incident
+        if rc == 0:
+            # (controller-initiated drains never reach here — shrink()/
+            # drain() reap their exits via wait_all; an exit-75 HERE is
+            # always an external preemption, handled below)
+            w.done = True
+            w.proc = None
+            return
+        w.proc = None
+        w.seen_hangs = w.tail.hangs
+        w.down_t = time.time()
+        if rc == EXIT_PREEMPTED:
+            # externally-preempted worker (the platform SIGTERMed it,
+            # not us): it drained cleanly and its checkpoint is durable
+            # — RESUME without burning restart budget, mirroring what
+            # decide_worker says about the same shard. The base backoff
+            # still applies (give the platform's disruption a beat).
+            w.down_reason = "preempted"
+            w.pending_attempt = None
+            w.backoff = self.args.backoff_s
+            self.record("down", worker=w.host, reason="preempted",
+                        step=w.tail.last_step)
+            w.relaunch_at = w.down_t + w.backoff
+            return
+        self.record("down", worker=w.host, reason=reason,
+                    step=w.tail.last_step)
+        w.attempts += 1
+        if w.attempts <= self.args.restart_budget:
+            # schedule, don't sleep: the poll loop relaunches when the
+            # deadline passes, and keeps watching everyone meanwhile
+            w.down_reason = reason
+            w.pending_attempt = w.attempts
+            w.backoff = self.args.backoff_s * (2 ** (w.attempts - 1))
+            w.relaunch_at = w.down_t + w.backoff
+            return
+        # budget exhausted: the host is LOST
+        w.lost = True
+        self.record("lost", worker=w.host, reason=reason,
+                    attempt=w.attempts, step=w.tail.last_step)
+        if self.args.allow_shrink \
+                and self.active_hosts - 1 >= self.args.min_hosts:
+            self.shrink(lost=w, t_down=w.down_t)
+        else:
+            self.give_up(f"worker {w.host} lost, shrink unavailable")
+
+    def maybe_relaunch(self, w: _W) -> None:
+        """Fire a scheduled relaunch once its backoff deadline passes."""
+        if w.relaunch_at is None or time.time() < w.relaunch_at:
+            return
+        w.relaunch_at = None
+        w.restarted = True
+        self.spawn(w)
+        self.record("restart", worker=w.host, reason=w.down_reason,
+                    attempt=w.pending_attempt,
+                    backoff_s=round(w.backoff, 3),
+                    step=w.tail.last_step,
+                    recovery_s=round(time.time() - w.down_t, 3))
+
+    def shrink(self, lost: _W, t_down: float) -> None:
+        """Drain the survivors (SIGTERM -> preemption drain -> atomic
+        checkpoint) and relaunch the fleet one host smaller, every
+        worker resuming from its drain checkpoint. The shrunk size
+        reaches the workers through the {hosts} template field. A
+        survivor SIGKILLed for blowing the drain timeout is still
+        relaunched (its last PERIODIC checkpoint is the best recovery
+        point available) but the forced kill is recorded on the shrink
+        event — the post-mortem must see that this host may replay
+        steps since its drain save never landed."""
+        self.signal_all(signal.SIGTERM)
+        killed = self.wait_all(self.args.drain_timeout_s)
+        self.active_hosts -= 1
+        for w in self.workers.values():
+            if w.lost or w.done:
+                continue
+            w.relaunch_at = None  # the shrink relaunch supersedes any
+            w.restarted = True    # scheduled single-worker restart
+            self.spawn(w)
+        self.record("shrink", worker=lost.host,
+                    reason=f"worker {lost.host} lost"
+                           + (f"; {killed} survivor(s) force-killed "
+                              f"mid-drain" if killed else ""),
+                    step=lost.tail.last_step,
+                    recovery_s=round(time.time() - t_down, 3))
+
+    def give_up(self, reason: str) -> None:
+        self.signal_all(signal.SIGTERM)
+        self.wait_all(self.args.drain_timeout_s)
+        self.record("give_up", reason=reason)
+        self.tel.close()
+        sys.exit(1)
+
+    def drain(self) -> None:
+        self.record("drain", reason=self.guard.signal_name or "SIGTERM")
+        self.signal_all(signal.SIGTERM)
+        killed = self.wait_all(self.args.drain_timeout_s)
+        parked = crashed = 0
+        for w in self.workers.values():
+            if w.done:
+                continue
+            if w.proc is None:
+                continue
+            if w.proc.returncode == EXIT_PREEMPTED:
+                w.done = True
+                parked += 1
+            else:
+                # a worker that died with a CRASH code during the drain
+                # window left no drain checkpoint either — the park is
+                # not fully resumable for it, same as a forced kill
+                crashed += 1
+        if killed or crashed:
+            # some worker's final checkpoint never landed (SIGKILLed
+            # past the timeout, or crashed mid-drain): this park is NOT
+            # fully resumable — say so in the event and the exit code
+            self.record("stop", reason=f"drain_incomplete:{killed} "
+                                       f"killed, {crashed} crashed, "
+                                       f"{parked} parked")
+            self.tel.close()
+            sys.exit(1)
+        self.record("stop", reason=f"drained:{parked} parked")
+        self.tel.close()
+        sys.exit(0)
+
+    # -- main loop ------------------------------------------------------------
+
+    def run(self) -> int:
+        for w in self.workers.values():
+            w.restarted = self.args.resume_first
+            self.spawn(w)
+            self.record("launch", worker=w.host)
+        while True:
+            if self.guard.triggered:
+                self.drain()
+            if self.args.max_wall_s \
+                    and time.time() - self.t0 > self.args.max_wall_s:
+                self.give_up("max_wall_s exceeded")
+            pending = False
+            for w in self.workers.values():
+                if w.done or w.lost:
+                    continue
+                if w.proc is None:
+                    if w.relaunch_at is not None:
+                        pending = True
+                        self.maybe_relaunch(w)
+                    continue
+                pending = True
+                w.tail.poll()
+                rc = w.proc.poll()
+                if rc is not None:
+                    w.tail.poll()  # drain the tail the exit flushed
+                    self.handle_exit(w, rc)
+                    continue
+                if self.args.kill_on_hang \
+                        and w.tail.hangs > w.seen_hangs:
+                    # the shard reports a hang but the process is still
+                    # wedged (watchdog mode 1, or a hang between report
+                    # and abort): reclaim the host
+                    try:
+                        w.proc.kill()
+                    except OSError:
+                        pass
+            if not pending:
+                break
+            time.sleep(self.args.poll_s)
+        ok = all(w.done for w in self.workers.values() if not w.lost)
+        self.record("stop", reason="complete" if ok else "incomplete")
+        self.guard.uninstall()
+        self.tel.close()
+        return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleet_controller",
+        description="elastic-fleet supervisor over per-host telemetry "
+                    "shards (DESIGN.md §18)")
+    ap.add_argument("--telemetry", required=True,
+                    help="telemetry base path (worker shards at "
+                         "<base>/<base>.host<k>; controller events at "
+                         "<base>.controller)")
+    ap.add_argument("--cmd", default="",
+                    help="worker command template; {host}/{hosts}/"
+                         "{resume} are substituted per spawn")
+    ap.add_argument("--hosts", type=int, default=2)
+    ap.add_argument("--restart_budget", type=int, default=2,
+                    help="restarts per worker before it is declared "
+                         "lost")
+    ap.add_argument("--backoff_s", type=float, default=0.5,
+                    help="restart backoff base (doubles per attempt)")
+    ap.add_argument("--resume_flags", default="--resume",
+                    help="what {resume} expands to on restarts")
+    ap.add_argument("--resume_first", action="store_true",
+                    help="pass {resume} on the FIRST launch too "
+                         "(controller itself restarted mid-run)")
+    ap.add_argument("--allow_shrink", action="store_true",
+                    help="on a lost worker: drain survivors and "
+                         "relaunch the fleet one host smaller")
+    ap.add_argument("--min_hosts", type=int, default=1)
+    ap.add_argument("--kill_on_hang", type=int, default=1,
+                    help="SIGKILL a live worker whose shard reports a "
+                         "hang event (watchdog mode 1 wedges)")
+    ap.add_argument("--drain_timeout_s", type=float, default=30.0)
+    ap.add_argument("--poll_s", type=float, default=0.05)
+    ap.add_argument("--max_wall_s", type=float, default=0.0,
+                    help="safety net: give up past this wall time "
+                         "(0 = off)")
+    ap.add_argument("--dry_run", action="store_true",
+                    help="replay the recorded shard set at --telemetry "
+                         "and print the decisions; no processes")
+    args = ap.parse_args(argv)
+    if args.dry_run:
+        return dry_run(args.telemetry)
+    if not args.cmd:
+        ap.error("--cmd is required (unless --dry_run)")
+    return FleetController(args).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

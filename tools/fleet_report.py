@@ -26,12 +26,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from telemetry_report import (_fmt, checkpoint_lines,  # noqa: E402
-                              checkpoint_summary, goodput_lines,
-                              hang_entries, hang_lines, load_events,
-                              percentile, split_latest_run,
+                              checkpoint_summary, controller_entries,
+                              controller_lines, controller_summary,
+                              goodput_lines, hang_entries, hang_lines,
+                              load_events, percentile, split_latest_run,
                               straggler_entries, straggler_lines)
 
-from mobilefinetuner_tpu.core.telemetry import partial_goodput  # noqa: E402
+from mobilefinetuner_tpu.core.telemetry import (controller_path,  # noqa: E402
+                                                partial_goodput)
 
 
 def discover_shards(base: str) -> dict:
@@ -95,8 +97,11 @@ def shard_summary(host: int, events: list, n_invalid: int) -> dict:
     }
 
 
-def fleet_summary(shards: dict) -> dict:
-    """shards: {host: (events, n_invalid)} -> the merged fleet view."""
+def fleet_summary(shards: dict, controller=None) -> dict:
+    """shards: {host: (events, n_invalid)} -> the merged fleet view.
+    `controller`: the <base>.controller stream's validated events (the
+    fleet controller's recovery timeline, DESIGN.md §18) — rendered
+    next to the goodput buckets so recovery cost is a visible line."""
     per_host = {h: shard_summary(h, ev, bad)
                 for h, (ev, bad) in sorted(shards.items())}
     # merged timeline: every shard's events ordered by wall time, ties
@@ -157,6 +162,8 @@ def fleet_summary(shards: dict) -> dict:
         "hangs": hang_entries(scoped),
         "hosts_missing_run_end": missing_end,
         "goodput": goodput,
+        "controller": controller_summary(
+            controller_entries(controller or [])),
     }
 
 
@@ -210,6 +217,10 @@ def print_fleet(s: dict):
         print(f"  hosts without run_end: {s['hosts_missing_run_end']}")
     for line in goodput_lines(s["goodput"]):  # one shared renderer
         print(line)
+    # the recovery timeline renders NEXT TO the goodput buckets: the
+    # two together answer "where did the fleet's wall-clock go"
+    for line in controller_lines(s.get("controller")):
+        print(line)
 
 
 def main(argv=None) -> int:
@@ -236,7 +247,14 @@ def main(argv=None) -> int:
         print(f"error: no valid telemetry events in {sorted(paths.values())}",
               file=sys.stderr)
         return 1
-    s = fleet_summary(shards)
+    controller = None
+    cpath = controller_path(args.jsonl)
+    if os.path.exists(cpath):
+        try:
+            controller, _ = load_events(cpath)
+        except OSError:
+            controller = None
+    s = fleet_summary(shards, controller=controller)
     try:
         if args.json:
             print(json.dumps(s, indent=1))

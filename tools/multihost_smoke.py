@@ -23,12 +23,30 @@ merges. `--write_shards <path>` simulates the same two-host shard set in
 ONE process on CPU (no jax.distributed needed) with a known straggler
 skew baked in — the cheap merge-path proof tests/test_scripts.py runs.
 
+Elastic-fleet wiring (DESIGN.md §18): `--inject kill:<step>` /
+`--inject hang:<step>` fault-injects the REAL worker loop (die hard /
+emit a `hang` event then exit 113 like the watchdog's abort) so a pod
+smoke can exercise tools/fleet_controller.py against actual collectives.
+`--sim_worker` is the CPU-runnable simulated-fleet worker the tier-1
+controller e2e drives instead: an independent single-process "training"
+loop (deterministic fake loss, real per-host telemetry shard, real
+ATOMIC safetensors checkpoint each step, automatic resume from that
+checkpoint, SIGTERM drain via core/preempt.py, and the same --inject
+faults) — no cross-process collectives, because this container's jax
+CPU backend cannot run them; everything the controller observes (shard
+tails, exit codes, resume-from-checkpoint step counters) is real.
+
 Usage (one line per process):
   python tools/multihost_smoke.py <coordinator> <num_procs> <proc_id> \
-      [ndev] [telemetry_out]
+      [ndev] [telemetry_out] [--inject kill:1]
   python tools/multihost_smoke.py --write_shards out.jsonl
+  python tools/multihost_smoke.py --sim_worker --host 1 --hosts 2 \
+      --steps 10 --telemetry base.jsonl --ckpt w1.safetensors \
+      [--step_ms 30] [--inject kill:4]
 """
 
+import argparse
+import os
 import sys
 import time
 
@@ -78,16 +96,143 @@ def write_simulated_shards(base: str, hosts: int = 2,
     return paths
 
 
+def parse_inject(spec: str):
+    """'kill:<step>' / 'hang:<step>' -> (mode, step); ('', -1) when off."""
+    if not spec:
+        return "", -1
+    mode, _, step = spec.partition(":")
+    if mode not in ("kill", "hang") or not step.isdigit():
+        raise SystemExit(f"--inject must be kill:<step> or hang:<step>, "
+                         f"got {spec!r}")
+    return mode, int(step)
+
+
+def fire_inject(mode: str, tel, step: int, marker: str) -> None:
+    """Fault injection, ONCE per checkpoint lineage (the marker file
+    makes a restarted/resumed worker run clean — the fault simulates a
+    host incident, not a deterministic poison step). kill = die hard
+    mid-run (no flush: exactly the truncated-tail shard a dead host
+    leaves). hang = what the watchdog's abort path produces: a durable
+    `hang` event, a flushed newline-terminated shard, exit 113."""
+    if marker:
+        if os.path.exists(marker):
+            return
+        with open(marker, "w") as f:
+            f.write(f"{mode}@{step}\n")
+    if mode == "kill":
+        os._exit(86)
+    tel.emit("hang", step=step, stall_s=120.0, deadline_s=60.0,
+             stacks_file=(tel.path + ".stacks") if tel.path else "",
+             device_probe="timeout", action="abort")
+    tel.flush_tail()
+    os._exit(113)
+
+
+def sim_worker(args) -> None:
+    """One simulated fleet worker (see module docstring). Exit codes
+    mirror the real training CLIs: 0 = complete, EXIT_PREEMPTED (75) =
+    SIGTERM drain with a durable checkpoint, 113 = hang abort, other =
+    crash. The checkpoint is written ATOMICALLY every step through the
+    production safetensors writer, so a kill at ANY instant leaves a
+    loadable recovery point — the property the controller's
+    resume-from-checkpoint restart depends on."""
+    from mobilefinetuner_tpu.core.preempt import (EXIT_PREEMPTED,
+                                                  PreemptionGuard)
+    from mobilefinetuner_tpu.core.telemetry import Telemetry, shard_path
+    from mobilefinetuner_tpu.io.safetensors_io import (SafeTensorsReader,
+                                                       save_safetensors)
+    mode, inject_step = parse_inject(args.inject)
+    marker = (args.ckpt + ".injected") if args.ckpt else ""
+    start = 0
+    if args.ckpt and os.path.exists(args.ckpt):
+        start = int(SafeTensorsReader(args.ckpt).load_all()["step"][0])
+    guard = PreemptionGuard().install()
+    tel = Telemetry(shard_path(args.telemetry, args.host), host=args.host)
+    tel.emit("run_start", jax_version="sim", mesh_shape=None,
+             process_count=args.hosts, process_index=args.host,
+             device_kind="sim-cpu", device_count=args.hosts,
+             config={"sim_worker": True, "steps": args.steps,
+                     "start_step": start, "inject": args.inject})
+    t0 = time.time()
+    for step in range(start, args.steps):
+        if mode and step == inject_step:
+            fire_inject(mode, tel, step, marker)
+        time.sleep(args.step_ms / 1000.0)
+        loss = 3.0 - 0.02 * step  # deterministic in the ABSOLUTE step:
+        # a resumed trajectory continues the uninterrupted one exactly
+        tel.emit("step_stats", step=step + 1, loss=loss, ema=loss,
+                 lr=1e-4, grad_norm=0.5, step_time_ms=args.step_ms,
+                 host_wait_ms=0.0, slept_ms=0.0, tok_s=1000.0, mfu=None,
+                 param_norm=10.0, update_ratio=1e-3, nonfinite_count=0,
+                 hbm_mb=1.0, queue_depth=None, host_step_ms=None)
+        if args.ckpt:
+            save_safetensors(args.ckpt, {
+                "step": np.asarray([step + 1], np.int32),
+                "w": np.full((8,), float(step + 1), np.float32)})
+        if guard.triggered:
+            tel.emit("preempt", step=step + 1,
+                     signal=guard.signal_name or "SIGTERM")
+            tel.emit("run_end", steps=step + 1 - start,
+                     wall_s=round(time.time() - t0, 3), exit="preempted",
+                     goodput=None, reason="preempted")
+            tel.close()
+            print(f"SIM_WORKER_PREEMPTED host={args.host} "
+                  f"step={step + 1}")
+            sys.exit(EXIT_PREEMPTED)
+    tel.emit("run_end", steps=args.steps - start,
+             wall_s=round(time.time() - t0, 3), exit="ok", goodput=None)
+    tel.close()
+    guard.uninstall()
+    print(f"SIM_WORKER_OK host={args.host} steps={args.steps}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="multihost_smoke",
+        description="multi-host smoke worker / simulated fleet worker")
+    ap.add_argument("pos", nargs="*",
+                    help="real-worker positionals: coordinator "
+                         "num_procs proc_id [ndev] [telemetry_out]")
+    ap.add_argument("--write_shards", default="",
+                    help="write a simulated 2-host shard set and exit")
+    ap.add_argument("--sim_worker", action="store_true",
+                    help="run ONE simulated fleet worker (CPU, no "
+                         "collectives) for tools/fleet_controller.py")
+    ap.add_argument("--host", type=int, default=0)
+    ap.add_argument("--hosts", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--telemetry", default="")
+    ap.add_argument("--ckpt", default="",
+                    help="sim worker checkpoint path (atomic "
+                         "safetensors; auto-resumed when present)")
+    ap.add_argument("--step_ms", type=float, default=30.0)
+    ap.add_argument("--resume", action="store_true",
+                    help="accepted for controller cmd-template compat; "
+                         "the sim worker auto-resumes from --ckpt")
+    ap.add_argument("--inject", default="",
+                    help="kill:<step> | hang:<step> — fire ONCE per "
+                         "checkpoint lineage (marker file)")
+    return ap
+
+
 def main():
-    if sys.argv[1] == "--write_shards":
-        for p in write_simulated_shards(sys.argv[2]):
+    args = build_parser().parse_args()
+    if args.write_shards:
+        for p in write_simulated_shards(args.write_shards):
             print(f"SHARD {p}")
         print("SHARDS_OK")
         return
-    coordinator, num_procs, proc_id = (sys.argv[1], int(sys.argv[2]),
-                                       int(sys.argv[3]))
-    ndev = int(sys.argv[4]) if len(sys.argv) > 4 else 4
-    telemetry_out = sys.argv[5] if len(sys.argv) > 5 else ""
+    if args.sim_worker:
+        sim_worker(args)
+        return
+    if len(args.pos) < 3:
+        raise SystemExit("usage: multihost_smoke.py <coordinator> "
+                         "<num_procs> <proc_id> [ndev] [telemetry_out]")
+    coordinator, num_procs, proc_id = (args.pos[0], int(args.pos[1]),
+                                       int(args.pos[2]))
+    ndev = int(args.pos[3]) if len(args.pos) > 3 else 4
+    telemetry_out = args.pos[4] if len(args.pos) > 4 else ""
+    inject_mode, inject_at = parse_inject(args.inject)
 
     from mobilefinetuner_tpu.parallel.host_devices import force_host_devices
     force_host_devices(ndev)
@@ -124,7 +269,9 @@ def main():
 
     # fleet telemetry: EVERY process writes its host-stamped shard (the
     # per-host contract tools/fleet_report.py merges)
-    from mobilefinetuner_tpu.core.telemetry import Telemetry, run_manifest
+    from mobilefinetuner_tpu.core.telemetry import (Telemetry,
+                                                    run_manifest,
+                                                    shard_path)
     tel = Telemetry.for_process(telemetry_out)
     tel.emit("run_start", **run_manifest(
         {"smoke": True, "num_procs": num_procs, "ndev": ndev}, mesh))
@@ -161,9 +308,22 @@ def main():
     assert batch["input_ids"].sharding.spec == \
         jax.sharding.PartitionSpec(("data", "fsdp"))
 
+    # once-per-lineage marker for pod fault injection: keyed off the
+    # telemetry shard (the real worker has no checkpoint path) so a
+    # controller-relaunched worker runs clean instead of re-dying at
+    # the same step forever. Without a telemetry path the fault
+    # re-fires every launch — fine for a bare two-process smoke, but a
+    # controller drive needs the shard path anyway.
+    inject_marker = (shard_path(telemetry_out, jax.process_index())
+                     + ".injected") if telemetry_out else ""
     with mesh:
         losses = []
         for step in range(2):
+            if inject_mode and step == inject_at:
+                # pod fault injection: this process dies mid-collective
+                # (kill) or reports-then-aborts like the watchdog
+                # (hang) — what the fleet controller recovers from
+                fire_inject(inject_mode, tel, step, marker=inject_marker)
             t0 = time.perf_counter()
             lora, opt, metrics = step_fn(lora, params, opt, batch,
                                          jnp.int32(step))

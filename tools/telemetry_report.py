@@ -257,6 +257,97 @@ def request_lines(r) -> list:
     return lines
 
 
+def controller_entries(events) -> list:
+    """Summary dicts for `controller` events (the fleet controller's
+    recovery timeline, tools/fleet_controller.py) — ONE builder shared
+    with tools/fleet_report.py like the straggler/hang entries."""
+    return [{"t": e["t"], "action": e["action"],
+             "worker": e.get("worker"), "reason": e.get("reason"),
+             "attempt": e.get("attempt"), "step": e.get("step"),
+             "recovery_s": e.get("recovery_s")}
+            for e in events if e.get("event") == "controller"]
+
+
+def latest_controller_session(entries) -> list:
+    """The controller stream appends across sessions (re-running with
+    the same --telemetry base resumes the file). Scope to the LATEST
+    session — the same rule the worker shards get from split_latest_run
+    — so a resumed fleet's recovery accounting describes THIS run, not
+    every run ever recorded. A session STARTS with a burst of `launch`
+    events, so the latest session begins at the last launch whose
+    predecessor is not itself a launch — robust even when an earlier
+    session died without its stop/give_up terminator (a SIGKILLed
+    controller writes no goodbye). Streams with no launch at all
+    (hand-built fixtures) fall back to terminator slicing."""
+    starts = [i for i, e in enumerate(entries)
+              if e["action"] == "launch"
+              and (i == 0 or entries[i - 1]["action"] != "launch")]
+    if starts:
+        return entries[starts[-1]:]
+    ends = [i for i, e in enumerate(entries)
+            if e["action"] in ("stop", "give_up")]
+    if not ends:
+        return entries
+    last = ends[-1]
+    if last == len(entries) - 1:  # closed session: back to the previous
+        prev = ends[-2] if len(ends) > 1 else -1
+        return entries[prev + 1:]
+    return entries[last + 1:]     # live session after the last closed one
+
+
+def controller_summary(entries) -> dict:
+    """Roll up the recovery timeline (scoped to the LATEST controller
+    session): restarts/shrinks/lost counts and the total recovery
+    wall-clock (down-observed -> relaunched, summed over restart+shrink
+    events) — the number that turns recovery cost into a visible line
+    next to the goodput buckets instead of a mystery gap in step reach.
+    None when no controller ran."""
+    if not entries:
+        return None
+    entries = latest_controller_session(entries)
+    return {
+        "events": len(entries),
+        "restarts": sum(1 for e in entries if e["action"] == "restart"),
+        "shrinks": sum(1 for e in entries if e["action"] == "shrink"),
+        "lost": sum(1 for e in entries if e["action"] == "lost"),
+        "drains": sum(1 for e in entries if e["action"] == "drain"),
+        "gave_up": any(e["action"] == "give_up" for e in entries),
+        "recovery_s": round(sum(e["recovery_s"] or 0.0 for e in entries
+                                if e["action"] in ("restart", "shrink")),
+                            3),
+        "entries": entries,
+    }
+
+
+def controller_lines(cs) -> list:
+    """Render a controller_summary (shared with fleet_report)."""
+    if not cs:
+        return []
+    head = (f"  controller: {cs['restarts']} restart(s), "
+            f"{cs['shrinks']} shrink(s), {cs['lost']} lost, "
+            f"recovery {cs['recovery_s']:.2f}s"
+            + (", GAVE UP" if cs["gave_up"] else "")
+            + (f", {cs['drains']} drain(s)" if cs["drains"] else ""))
+    lines = [head]
+    for e in cs["entries"]:
+        if e["action"] not in ("restart", "shrink", "lost", "give_up",
+                               "drain"):
+            continue
+        bits = [f"    {e['action'].upper()}"]
+        if e["worker"] is not None:
+            bits.append(f"worker {e['worker']}")
+        if e["reason"]:
+            bits.append(f"({e['reason']})")
+        if e["step"] is not None:
+            bits.append(f"@ step {e['step']}")
+        if e["attempt"] is not None:
+            bits.append(f"attempt {e['attempt']}")
+        if e["recovery_s"] is not None:
+            bits.append(f"recovered in {e['recovery_s']:.2f}s")
+        lines.append(" ".join(bits))
+    return lines
+
+
 def straggler_entries(events) -> list:
     """Summary dicts for `straggler` events — ONE builder shared with
     tools/fleet_report.py (same rule as goodput_lines)."""

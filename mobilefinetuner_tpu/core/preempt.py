@@ -20,6 +20,14 @@ drain, resume me" rather than "crashed, count against the restart
 budget". A preemption notice therefore costs one step plus one drain
 instead of a lost run (DESIGN.md §18).
 
+The serve loop consumes the same guard (round 14, DESIGN.md §19):
+`serve/engine.ServeEngine.install_preemption()` observes the flag at
+decode-step boundaries — admissions stop, the queued remainder rejects
+with `reason="shutdown"`, in-flight requests finish, and close()
+records the same `run_end{exit=preempted, reason=preempted}` contract,
+so a drained server and a drained trainer are indistinguishable to the
+recovery layer.
+
 A SECOND signal during the drain aborts it (KeyboardInterrupt): the
 operator — or the platform's hard-kill escalation — always wins over a
 wedged save.

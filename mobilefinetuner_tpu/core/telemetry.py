@@ -187,17 +187,47 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
     # serving-request lifecycle (serve/engine.py): one event per phase
     # transition — enqueue (submit), admit (prefill issued; queue_ms),
     # first_token (ttft_ms closes), finish (new_tokens/ttft/tpot final),
-    # cancel. The SLO numbers telemetry_report's TTFT/TPOT percentiles
-    # and req/s are computed from.
+    # and the TERMINAL failure phases the round-14 robustness layer
+    # added: cancel, reject (admission refused: queue full / shed /
+    # shutdown), timeout (deadline blown — queued requests never
+    # prefill, active ones return partial output), error (the request
+    # was in flight when a step-dispatch exception was contained). A
+    # request emits EXACTLY ONE terminal phase (finish|cancel|reject|
+    # timeout|error). The SLO numbers telemetry_report's TTFT/TPOT
+    # percentiles, req/s, and reject/timeout/error rates are computed
+    # from these.
     "request": {
         "id": (int,),
-        "phase": (str,),            # enqueue|admit|first_token|finish|cancel
+        "phase": (str,),            # REQUEST_PHASES (validated below)
         "prompt_tokens": (int,),
         "adapter": (int, type(None)),  # bank slot; None = base-only
         "queue_ms": _OPT_NUM,       # enqueue -> admission
         "new_tokens": _OPT_NUM,     # tokens generated so far
         "ttft_ms": _OPT_NUM,        # enqueue -> first token
         "tpot_ms": _OPT_NUM,        # mean per-token after the first
+        "reason": _OPT_STR,         # terminal detail: a REQUEST_REASONS
+                                    # policy string on reject/timeout, the
+                                    # exception type name on error, else
+                                    # None (optional on read: r11 streams)
+    },
+    # cadenced serve-loop health snapshot (serve/engine.py health()):
+    # queue depth, slot occupancy, page-pool headroom, rolling p95 step
+    # latency, and the cumulative terminal-state counters — the
+    # observable the operator's load-shed/deadline policy is tuned
+    # against (telemetry_report renders queue max / occupancy mean /
+    # free-page floor from these).
+    "serve_stats": {
+        "step": (int,),             # decode_steps at the snapshot
+        "queue_depth": (int,),
+        "active": (int,),           # occupied slots
+        "occupancy": _NUM,          # active / num_slots
+        "free_blocks": (int,),      # page-pool headroom
+        "p95_step_ms": _OPT_NUM,    # rolling window; None before step 1
+        "finished": (int,),         # cumulative terminal-state counters
+        "cancelled": (int,),
+        "rejected": (int,),
+        "timeout": (int,),
+        "error": (int,),
     },
     # preemption drain began (core/preempt.py + cli/common.run_training):
     # a SIGTERM/SIGINT was observed at a step boundary; what follows is
@@ -247,7 +277,27 @@ OPTIONAL_FIELDS: Dict[str, frozenset] = {
     "run_end": frozenset({"goodput", "reason"}),
     "checkpoint": frozenset({"snapshot_ms", "write_ms", "bytes", "mb_s",
                              "async"}),
+    "request": frozenset({"reason"}),
 }
+
+
+# The request lifecycle's CLOSED phase set (serve/engine.py): the
+# validator rejects any other spelling, and the emit-site scan
+# (tests/test_fleet.py) pins source literals against this tuple both
+# directions — a new phase lands in schema, emitter, and report in one
+# review or not at all.
+REQUEST_PHASES = ("enqueue", "admit", "first_token", "finish", "cancel",
+                  "reject", "timeout", "error")
+
+# The closed set of POLICY reasons a reject/timeout carries (the error
+# phase instead carries the contained exception's type name — an open
+# set the scan cannot and should not pin):
+#   queue_full  bounded admission refused the newest arrival
+#   shed        the deadline-shed policy dropped a queued request to
+#               make room for a new one
+#   shutdown    drain in progress (SIGTERM): queued remainder rejected
+#   deadline    the request's own deadline_ms expired
+REQUEST_REASONS = frozenset({"queue_full", "shed", "shutdown", "deadline"})
 
 
 def validate_event(rec: Any) -> Optional[str]:
@@ -280,6 +330,8 @@ def validate_event(rec: Any) -> Optional[str]:
             return f"{ev}.{field}: bool where {types} expected"
         if not isinstance(v, types):
             return f"{ev}.{field}: {type(v).__name__} not in {types}"
+    if ev == "request" and rec.get("phase") not in REQUEST_PHASES:
+        return f"request: unknown phase {rec.get('phase')!r}"
     return None
 
 

@@ -31,6 +31,39 @@ append on page-boundary crossings), so short/eos-early requests return
 their tail reservation without ever touching it; the reservation only
 guarantees `append` cannot fail mid-flight — there is no preemption
 path to need.
+
+Round 14 (DESIGN.md §19) hardens the loop for production traffic — the
+serve-side mirror of the training path's sensors-to-recovery discipline:
+
+  - bounded admission: `max_queue` caps the FCFS queue; over-limit
+    submits terminate with `request{phase=reject, reason=queue_full}`,
+    or `shed_policy="deadline"` drops the queued request closest to
+    blowing its own deadline instead of the newest arrival;
+  - per-request deadlines: `submit(..., deadline_ms=)` — queued
+    requests past deadline are timed out WITHOUT ever prefilling,
+    active ones are cancelled at the next step boundary with their
+    partial output intact (phase=timeout, slot + pages released);
+  - crash containment: a step-dispatch exception fails only the
+    in-flight requests (phase=error, reason=<exception type>), resets
+    slots and the page pool to a clean empty state, and — under the
+    default `on_step_error="fail_active"` — keeps serving the queue;
+  - graceful drain: `install_preemption()` arms a
+    core/preempt.PreemptionGuard; the first SIGTERM stops admissions,
+    rejects the queued remainder (reason=shutdown), finishes in-flight
+    requests, and close() records `run_end{exit=preempted,
+    reason=preempted}`; a second signal escalates (KeyboardInterrupt)
+    so the caller cancels in-flight;
+  - health: `health()` snapshots queue depth / occupancy / page
+    headroom / rolling p95 step latency / terminal-state counters,
+    emitted as cadenced `serve_stats` events under `stats_every`.
+
+Every terminal transition goes through ONE bookkeeping path
+(`_terminal`), so a request emits exactly one terminal `request` phase
+and releases exactly the pages it allocated — the leak-accounting
+invariant tests/test_serve_robustness.py asserts after every injected
+fault. None of this touches the compiled programs: rejects, timeouts,
+sheds, containment, and drain are host-side bookkeeping, so the ≤2
+post-warmup trace invariant holds across every fault path.
 """
 
 from __future__ import annotations
@@ -38,13 +71,15 @@ from __future__ import annotations
 import collections
 import dataclasses
 import time
-from typing import List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mobilefinetuner_tpu.core.telemetry import Telemetry, run_manifest
+from mobilefinetuner_tpu.core.preempt import PreemptionGuard
+from mobilefinetuner_tpu.core.telemetry import (HangWatchdog, Telemetry,
+                                                run_manifest)
 from mobilefinetuner_tpu.lora.lora import assign_adapters
 from mobilefinetuner_tpu.models.generate import (gemma3_decode_step_paged,
                                                  gemma3_prefill,
@@ -69,6 +104,17 @@ class ServeConfig:
     dtype: str = "float32"    # compute + cache dtype
     attn_impl: str = "auto"   # auto | xla | pallas (paged attention path)
     lora_impl: str = "auto"   # auto | naive | fused (models/lora_apply)
+    # --- robustness knobs (round 14, DESIGN.md §19) — host-side policy
+    # only: none of these reach a traced program, so changing them can
+    # never cost a retrace
+    max_queue: int = 0        # FCFS queue cap; 0 = unbounded
+    shed_policy: str = "reject"   # reject the newest arrival, or
+                                  # "deadline": shed the queued request
+                                  # closest to blowing its deadline
+    on_step_error: str = "fail_active"  # contain a step-dispatch
+                                  # exception (fail in-flight, keep
+                                  # serving) or "raise" after containing
+    stats_every: int = 0      # serve_stats cadence (decode steps); 0=off
 
     def validate(self) -> None:
         from mobilefinetuner_tpu.models.lora_apply import \
@@ -80,6 +126,16 @@ class ServeConfig:
                 f"block_T ({self.block_T})")
         if self.num_slots < 1 or self.max_new_tokens < 1:
             raise ValueError("num_slots and max_new_tokens must be >= 1")
+        if self.max_queue < 0 or self.stats_every < 0:
+            raise ValueError("max_queue and stats_every must be >= 0")
+        if self.shed_policy not in ("reject", "deadline"):
+            raise ValueError(
+                f"shed_policy must be 'reject' or 'deadline', got "
+                f"{self.shed_policy!r}")
+        if self.on_step_error not in ("fail_active", "raise"):
+            raise ValueError(
+                f"on_step_error must be 'fail_active' or 'raise', got "
+                f"{self.on_step_error!r}")
         # the pool must hold at least one worst-case request, or FCFS
         # admission can never fire and drain() spins forever
         worst = blocks_for(self.max_prompt + self.max_new_tokens - 1,
@@ -99,18 +155,31 @@ class Request:
     prompt: List[int]
     max_new_tokens: int
     adapter: Optional[str] = None      # resident bank name; None = base
-    # lifecycle: queued -> active -> finished | cancelled
+    # lifecycle: queued -> active -> one of the TERMINAL states
+    # (finished | cancelled | rejected | timeout | error); queued
+    # requests can reach rejected/timeout without ever becoming active
     state: str = "queued"
+    reason: Optional[str] = None       # terminal detail (REQUEST_REASONS
+                                       # policy string, or the exception
+                                       # type name on state=error)
     tokens: List[int] = dataclasses.field(default_factory=list)
     enqueue_t: float = 0.0
     admit_t: float = 0.0
     first_token_t: float = 0.0
     finish_t: float = 0.0
+    deadline_t: float = 0.0            # absolute perf_counter deadline
+                                       # (enqueue_t + deadline_ms); 0=none
     # engine-internal
     slot: int = -1
     aid: int = 0
     blocks: List[int] = dataclasses.field(default_factory=list)
     worst_blocks: int = 0
+
+    TERMINAL = ("finished", "cancelled", "rejected", "timeout", "error")
+
+    @property
+    def done(self) -> bool:
+        return self.state in self.TERMINAL
 
     @property
     def ttft_ms(self) -> Optional[float]:
@@ -142,7 +211,8 @@ class ServeEngine:
                  cfg: Optional[ServeConfig] = None,
                  bank: Optional[AdapterBank] = None,
                  telemetry: Optional[Telemetry] = None,
-                 eos_id: Optional[int] = None, pad_id: int = 0):
+                 eos_id: Optional[int] = None, pad_id: int = 0,
+                 watchdog: Optional[HangWatchdog] = None):
         cfg = cfg or ServeConfig()
         cfg.validate()
         if family == "gpt2":
@@ -171,6 +241,7 @@ class ServeEngine:
         self.M = blocks_for(cfg.max_prompt + cfg.max_new_tokens - 1,
                             cfg.block_T)
         self.alloc = BlockAllocator(cfg.num_blocks)
+        self._pool_dims = (L, KV, D)   # for the containment pool reset
         self.pool_k, self.pool_v = init_pools(
             cfg.num_blocks, L, KV, cfg.block_T, D, self.dtype)
         self._tok = np.zeros(S, np.int32)
@@ -182,6 +253,23 @@ class ServeEngine:
         self.decode_steps = 0
         self._next_id = 0
         self._t0 = time.perf_counter()
+        # --- robustness state (round 14) --------------------------------
+        self.draining = False          # admissions stopped (drain/shutdown)
+        self._closed = False
+        self.guard: Optional[PreemptionGuard] = None
+        self.watchdog = watchdog       # pet()-only: the harness owns its
+                                       # lifecycle (start/stop)
+        # fault-injection seam: called with decode_steps right before
+        # every step dispatch, INSIDE the containment try — an exception
+        # here exercises the same path a real dispatch failure takes
+        # (tools/serve_bench.py --inject installs it)
+        self.step_hook: Optional[Callable[[int], None]] = None
+        self._step_ms: collections.deque = collections.deque(maxlen=256)
+        self.counts: collections.Counter = collections.Counter()
+        # True exactly while a pool-donating dispatch (_write) is in
+        # flight: a failure in that window may have consumed the
+        # donated buffers, so containment must treat the pools as lost
+        self._pools_at_risk = False
 
         # --- the two compiled programs (+ the prompt-page writer) ----------
         # trace_counts is the compile-stability observable: the wrapped
@@ -248,7 +336,10 @@ class ServeEngine:
             "max_new_tokens": cfg.max_new_tokens, "dtype": cfg.dtype,
             "lora_impl": cfg.lora_impl,
             "lora_impl_resolved": lora_impl_resolved,
-            "adapter_slots": bank.capacity if bank else 0}))
+            "adapter_slots": bank.capacity if bank else 0,
+            "max_queue": cfg.max_queue, "shed_policy": cfg.shed_policy,
+            "on_step_error": cfg.on_step_error,
+            "stats_every": cfg.stats_every}))
 
     # ------------------------------------------------------------ helpers ---
     @staticmethod
@@ -282,7 +373,23 @@ class ServeEngine:
             queue_ms=((req.admit_t - req.enqueue_t) * 1000.0
                       if req.admit_t else None),
             new_tokens=len(req.tokens) or None,
-            ttft_ms=req.ttft_ms, tpot_ms=req.tpot_ms)
+            ttft_ms=req.ttft_ms, tpot_ms=req.tpot_ms, reason=req.reason)
+
+    def _terminal(self, req: Request, state: str, phase: str,
+                  reason: Optional[str] = None) -> None:
+        """THE terminal transition: every path out of the lifecycle
+        funnels through here, so a request emits exactly one terminal
+        `request` phase, is counted exactly once, and can never be
+        double-terminated (the accounting invariant the robustness
+        tests assert after every injected fault). The caller releases
+        slot/pages FIRST (queued requests hold none)."""
+        assert state in Request.TERMINAL, state
+        assert not req.done, f"request {req.id} already {req.state}"
+        req.state = state
+        req.reason = reason
+        req.finish_t = time.perf_counter()
+        self.counts[state] += 1
+        self._emit_request(req, phase=phase)
 
     # ------------------------------------------------------------ tenancy ---
     def load_adapter(self, name: str, source) -> int:
@@ -311,13 +418,30 @@ class ServeEngine:
         return self.bank.evict(name)
 
     def _adapter_in_use(self, name: str) -> bool:
+        # QUEUED requests count as in-use too: submit() resolved their
+        # bank slot at enqueue, so replacing/evicting the resident while
+        # they wait would silently serve another tenant's weights at
+        # admission (_admit additionally re-resolves the name —
+        # belt-and-braces, both pinned by
+        # test_serve.py::test_queued_request_pins_its_adapter)
         return any(r.adapter == name
                    for r in list(self.queue) + self.active)
 
     # ------------------------------------------------------------ intake ----
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 0,
-               adapter: Optional[str] = None) -> Request:
-        """Enqueue one request (admission happens inside step())."""
+               adapter: Optional[str] = None,
+               deadline_ms: Optional[float] = None) -> Request:
+        """Enqueue one request (admission happens inside step()).
+        `deadline_ms` is the request's end-to-end budget from now: a
+        queued request past it times out without prefilling, an active
+        one is cancelled at the next step boundary with partial output.
+        Under a full bounded queue (`max_queue`) the returned request
+        may already be terminal (state="rejected") — check `.state`
+        rather than assuming it queued."""
+        if self._closed:
+            raise RuntimeError(
+                "submit() on a closed ServeEngine: close() already "
+                "ended the telemetry stream — build a new engine")
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -330,6 +454,8 @@ class ServeEngine:
             raise ValueError(
                 f"max_new_tokens {n_new} outside (0, "
                 f"{self.cfg.max_new_tokens}]")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
         aid = 0
         if adapter is not None:
             if self.bank is None:
@@ -343,9 +469,32 @@ class ServeEngine:
         req = Request(id=self._next_id, prompt=prompt,
                       max_new_tokens=n_new, adapter=adapter, aid=aid,
                       enqueue_t=time.perf_counter())
+        if deadline_ms is not None:
+            req.deadline_t = req.enqueue_t + deadline_ms / 1000.0
         self._next_id += 1
+        self._emit_request(req, phase="enqueue")
+        if self.draining:
+            # drain in progress: admissions are closed for good
+            self._terminal(req, "rejected", phase="reject",
+                           reason="shutdown")
+            return req
+        if self.cfg.max_queue and len(self.queue) >= self.cfg.max_queue:
+            victim = None
+            if self.cfg.shed_policy == "deadline":
+                # shed the queued request closest to blowing its own
+                # deadline — it is the least likely to finish in time
+                # anyway; deadline-less requests are never shed
+                dl = [r for r in self.queue if r.deadline_t]
+                if dl:
+                    victim = min(dl, key=lambda r: r.deadline_t)
+            if victim is None:
+                self._terminal(req, "rejected", phase="reject",
+                               reason="queue_full")
+                return req
+            self.queue.remove(victim)
+            self._terminal(victim, "rejected", phase="reject",
+                           reason="shed")
         self.queue.append(req)
-        self._emit_request(req, "enqueue")
         return req
 
     def cancel(self, req: Request) -> None:
@@ -356,9 +505,7 @@ class ServeEngine:
             self._release(req)
         else:
             return
-        req.state = "cancelled"
-        req.finish_t = time.perf_counter()
-        self._emit_request(req, "cancel")
+        self._terminal(req, "cancelled", phase="cancel")
 
     # ------------------------------------------------------------ the loop --
     def _admit(self, req: Request, slot: int) -> None:
@@ -387,8 +534,13 @@ class ServeEngine:
         block_ids = np.full(cfg.max_prompt // cfg.block_T, TRASH_BLOCK,
                             np.int32)
         block_ids[:len(req.blocks)] = req.blocks
+        # the write DONATES the pools (non-CPU): if it raises, the old
+        # buffers may already be consumed — flag the window so the
+        # admission containment knows one-victim recovery is not enough
+        self._pools_at_risk = True
         self.pool_k, self.pool_v = self._write(
             self.pool_k, self.pool_v, k, v, jnp.asarray(block_ids))
+        self._pools_at_risk = False
         tok0 = int(tok0)                 # host sync: the first token
         now = time.perf_counter()
         req.admit_t = req.first_token_t = now
@@ -397,34 +549,94 @@ class ServeEngine:
         self._tbl[slot] = TRASH_BLOCK
         self._tbl[slot, :len(req.blocks)] = req.blocks
         self._aid[slot] = req.aid
-        self._emit_request(req, "admit")
-        self._emit_request(req, "first_token")
+        self._emit_request(req, phase="admit")
+        self._emit_request(req, phase="first_token")
         if (self.eos_id is not None and tok0 == self.eos_id) \
                 or req.max_new_tokens == 1:
             self._finish(req)
 
     def _release(self, req: Request) -> None:
-        s = req.slot
         self.alloc.free(req.blocks)
         req.blocks = []
+        s = req.slot
+        if s < 0:   # admission died before the slot was taken: nothing
+            return  # slot-side to clean (containment path)
         self._slots[s] = None
         self._tok[s] = self._pos[s] = self._aid[s] = 0
         self._tbl[s] = TRASH_BLOCK
 
     def _finish(self, req: Request) -> None:
-        req.state = "finished"
-        req.finish_t = time.perf_counter()
         self._release(req)
-        self._emit_request(req, "finish")
+        self._terminal(req, "finished", phase="finish")
+
+    def _expire(self, now: float) -> List[Request]:
+        """Time out every request past its deadline: queued ones are
+        dropped WITHOUT ever prefilling (no trace, no pages), active
+        ones at this step boundary — partial output stays on
+        `req.tokens`, slot and pages are released."""
+        out: List[Request] = []
+        for req in [r for r in self.queue
+                    if r.deadline_t and now >= r.deadline_t]:
+            self.queue.remove(req)
+            self._terminal(req, "timeout", phase="timeout",
+                           reason="deadline")
+            out.append(req)
+        for req in [r for r in self.active
+                    if r.deadline_t and now >= r.deadline_t]:
+            self._release(req)
+            self._terminal(req, "timeout", phase="timeout",
+                           reason="deadline")
+            out.append(req)
+        return out
+
+    def _contain_step_error(self, e: BaseException) -> List[Request]:
+        """A step-dispatch exception reached the scheduler: the step's
+        in-flight work is unrecoverable (and the donated pools may have
+        been consumed mid-dispatch), but the ENGINE is not — fail each
+        active request individually (phase=error, reason=<exception
+        type>), release its slot and exactly its pages, and rebuild the
+        pool arrays so the next admission starts from a clean, empty
+        cache. The queue is untouched: admission resumes on the next
+        step() under `on_step_error="fail_active"`. The compiled
+        executables survive (containment is host-side bookkeeping), so
+        recovery costs zero retraces."""
+        name = type(e).__name__
+        failed: List[Request] = []
+        for req in self.active:
+            self._release(req)
+            self._terminal(req, "error", phase="error", reason=name)
+            failed.append(req)
+        # every active released its own pages, so the allocator is whole
+        # again by construction; the pools are rebuilt because a step
+        # that died after dispatch may have invalidated the donated
+        # buffers (and their contents described only the dead requests)
+        L, KV, D = self._pool_dims
+        self.pool_k, self.pool_v = init_pools(
+            self.cfg.num_blocks, L, KV, self.cfg.block_T, D, self.dtype)
+        self._pools_at_risk = False
+        return failed
 
     def step(self) -> List[Request]:
-        """One scheduler iteration: admit what fits, then one decode
-        step for every active slot. Returns the requests that finished
-        on this iteration."""
+        """One scheduler iteration: observe preemption, expire
+        deadlines, admit what fits (unless draining), then one decode
+        step for every active slot. Returns every request that reached
+        a TERMINAL state on this iteration (finished, and since round
+        14: timeout, error, and shutdown-rejected) — filter on
+        `.state` when only completions matter."""
         cfg = self.cfg
-        finished: List[Request] = []
+        done: List[Request] = []
+        # a preemption signal is observed at the step boundary (never
+        # inside a dispatch): stop admissions, reject the queued
+        # remainder, let the in-flight requests finish
+        if self.guard is not None and self.guard.triggered \
+                and not self.draining:
+            self.telemetry.emit("preempt", step=self.decode_steps,
+                                signal=self.guard.signal_name or "SIGTERM")
+            done.extend(self.begin_shutdown())
+        now = time.perf_counter()
+        done.extend(self._expire(now))
         # FCFS admission under the worst-case page reservation
-        while self.queue:
+        while self.queue and not self.draining:
             free = [i for i, r in enumerate(self._slots) if r is None]
             if not free:
                 break
@@ -434,13 +646,34 @@ class ServeEngine:
             if self.alloc.free_blocks - self._committed_blocks() < worst:
                 break
             self.queue.popleft()
-            self._admit(req, free[0])
+            try:
+                self._admit(req, free[0])
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                # a failed PREFILL kills ONE request, not the engine —
+                # and not the other residents' cache (the pools stay:
+                # the in-flight requests are still live and their pages
+                # untouched)
+                self._release(req)
+                self._terminal(req, "error", phase="error",
+                               reason=type(e).__name__)
+                done.append(req)
+                if self._pools_at_risk:
+                    # ...UNLESS the prompt-page WRITE died: it donates
+                    # the pools, so every resident's cache is suspect —
+                    # escalate to full containment (fail actives,
+                    # rebuild pools)
+                    done.extend(self._contain_step_error(e))
+                if cfg.on_step_error == "raise":
+                    raise
+                continue
             if req.state == "finished":  # eos/cap hit on the first token
-                finished.append(req)
+                done.append(req)
 
         live = self.active
         if not live:
-            return finished
+            return done
         # a slot crossing a page boundary this step takes its next page
         # (guaranteed by the admission reservation)
         for req in live:
@@ -455,12 +688,28 @@ class ServeEngine:
                 self._tbl[req.slot, j] = req.blocks[-1]
 
         bank_tree = self.bank.tree if self.bank else None
-        nxt, self.pool_k, self.pool_v = self._step(
-            self.params, bank_tree, self.pool_k, self.pool_v,
-            jnp.asarray(self._tok), jnp.asarray(self._pos),
-            jnp.asarray(self._tbl), jnp.asarray(self._aid))
-        nxt = np.asarray(nxt)            # host sync: this step's tokens
+        t_step = time.perf_counter()
+        try:
+            if self.step_hook is not None:
+                self.step_hook(self.decode_steps)
+            nxt, pool_k, pool_v = self._step(
+                self.params, bank_tree, self.pool_k, self.pool_v,
+                jnp.asarray(self._tok), jnp.asarray(self._pos),
+                jnp.asarray(self._tbl), jnp.asarray(self._aid))
+            nxt = np.asarray(nxt)        # host sync: this step's tokens
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            done.extend(self._contain_step_error(e))
+            if cfg.on_step_error == "raise":
+                raise
+            return done
+        self.pool_k, self.pool_v = pool_k, pool_v
         self.decode_steps += 1
+        self._step_ms.append((time.perf_counter() - t_step) * 1000.0)
+        if self.watchdog is not None:
+            self.watchdog.pet(self.decode_steps,
+                              time.perf_counter() - t_step)
         for req in live:
             s = req.slot
             self._pos[s] += 1
@@ -469,27 +718,111 @@ class ServeEngine:
             if (self.eos_id is not None and req.tokens[-1] == self.eos_id) \
                     or len(req.tokens) >= req.max_new_tokens:
                 self._finish(req)
-                finished.append(req)
-        return finished
+                done.append(req)
+        if cfg.stats_every and self.decode_steps % cfg.stats_every == 0:
+            self.emit_stats()
+        return done
 
     def drain(self) -> List[Request]:
-        """step() until queue and slots are empty; returns everything
-        finished along the way, submission order."""
+        """step() until queue and slots are empty; returns every
+        request that reached a terminal state along the way, submission
+        order."""
         done: List[Request] = []
         while not self.idle:
             done.extend(self.step())
         return sorted(done, key=lambda r: r.id)
 
+    # ------------------------------------------------------------ shutdown --
+    def install_preemption(
+            self, guard: Optional[PreemptionGuard] = None
+    ) -> PreemptionGuard:
+        """Arm SIGTERM/SIGINT drain (the serve-side mirror of
+        run_training's --on_preempt): the first signal is observed at
+        the next step boundary — admissions stop, the queued remainder
+        is rejected with reason="shutdown", in-flight requests decode
+        to completion, and close() records run_end{exit=preempted,
+        reason=preempted}. A SECOND signal raises KeyboardInterrupt out
+        of the drain (the guard's escalation): the caller cancels
+        in-flight requests and closes — the operator always outranks a
+        slow drain."""
+        self.guard = guard or PreemptionGuard()
+        if not self.guard.installed:
+            self.guard.install()
+        return self.guard
+
+    def begin_shutdown(self, reason: str = "shutdown") -> List[Request]:
+        """Stop admissions for good and reject every queued request
+        (they would never be admitted); in-flight requests keep
+        decoding — step()/drain() finish them. Returns the rejected
+        requests. Idempotent once draining."""
+        self.draining = True
+        out: List[Request] = []
+        while self.queue:
+            req = self.queue.popleft()
+            self._terminal(req, "rejected", phase="reject", reason=reason)
+            out.append(req)
+        return out
+
+    # ------------------------------------------------------------ health ----
+    def health(self) -> dict:
+        """Host-side loop vitals — what an operator (or the cadenced
+        serve_stats emission) reads to see pressure building BEFORE it
+        becomes rejects: queue depth, slot occupancy, page-pool
+        headroom, rolling p95 step latency, and the cumulative
+        terminal-state counters."""
+        ms = sorted(self._step_ms)
+        p95 = (round(ms[min(int(0.95 * len(ms)), len(ms) - 1)], 3)
+               if ms else None)
+        return {
+            "queue_depth": len(self.queue),
+            "active": len(self.active),
+            "occupancy": round(len(self.active) / self.cfg.num_slots, 4),
+            "free_blocks": self.alloc.free_blocks,
+            "blocks_in_use": self.alloc.in_use,
+            "p95_step_ms": p95,
+            "decode_steps": self.decode_steps,
+            "draining": self.draining,
+            "counts": {s: int(self.counts.get(s, 0))
+                       for s in Request.TERMINAL},
+        }
+
+    def emit_stats(self) -> None:
+        """One `serve_stats` snapshot into the stream (step() calls
+        this every `stats_every` decode steps)."""
+        h = self.health()
+        self.telemetry.emit(
+            "serve_stats", step=self.decode_steps,
+            queue_depth=h["queue_depth"], active=h["active"],
+            occupancy=h["occupancy"], free_blocks=h["free_blocks"],
+            p95_step_ms=h["p95_step_ms"], **h["counts"])
+
     # ------------------------------------------------------------ teardown --
-    def close(self, exit: str = "ok") -> None:
+    def close(self, exit: str = "ok", reason: Optional[str] = None) -> None:
+        """End the stream (idempotent). A drain that a preemption
+        signal started records the r13 exit contract — run_end
+        {exit=preempted, reason=preempted} — so a fleet controller
+        reads a served SIGTERM exactly like a trained one."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.guard is not None:
+            self.guard.uninstall()
+        if exit == "ok" and self.guard is not None and self.guard.triggered:
+            exit, reason = "preempted", "preempted"
         self.telemetry.emit(
             "run_end", steps=self.decode_steps,
             wall_s=time.perf_counter() - self._t0, exit=exit,
-            goodput=None)
+            goodput=None, reason=reason)
         self.telemetry.close()
 
     def __enter__(self) -> "ServeEngine":
         return self
 
     def __exit__(self, exc_type, *_) -> None:
-        self.close("ok" if exc_type is None else exc_type.__name__)
+        # unwinding an exception is NOT a clean exit: exit="error" with
+        # the exception type as reason (the old code recorded the type
+        # name AS the exit, so no reader could filter on a stable value)
+        if exc_type is None:
+            self.close()
+        else:
+            self.close(exit="error", reason=exc_type.__name__)

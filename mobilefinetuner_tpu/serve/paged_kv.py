@@ -90,6 +90,14 @@ class BlockAllocator:
     def free_blocks(self) -> int:
         return len(self._free)
 
+    @property
+    def in_use(self) -> int:
+        """Pages currently handed out (trash page excluded) — the
+        leak-accounting observable: after every request has reached a
+        terminal state this must be 0, whatever path (finish, cancel,
+        timeout, contained step error) released the pages."""
+        return self.num_blocks - 1 - len(self._free)
+
     def alloc(self, n: int) -> List[int]:
         if n > len(self._free):
             raise OutOfBlocks(

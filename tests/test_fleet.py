@@ -586,3 +586,36 @@ def test_every_emitted_event_name_is_in_schema():
     # this set ever grows, either wire the event or drop it
     assert not never_emitted, \
         f"schema events no source ever emits: {sorted(never_emitted)}"
+
+
+def test_request_phases_and_reasons_pinned_both_directions():
+    """Round-14 satellite: the serve layer's request lifecycle phases
+    and its policy reject/timeout reasons are CLOSED sets
+    (core/telemetry.py REQUEST_PHASES / REQUEST_REASONS — the
+    validator enforces the phases). Scan the serve emit sites for
+    `phase="..."` / `reason="..."` literals and pin BOTH directions:
+    every literal in source is declared (a new phase/reason cannot ship
+    without landing in the schema + report), and every declared one has
+    an emit site (no dead taxonomy). The error phase's reason is an
+    exception type name — an open set this scan deliberately ignores
+    (only lowercase_snake literals match)."""
+    from mobilefinetuner_tpu.core.telemetry import (REQUEST_PHASES,
+                                                    REQUEST_REASONS)
+    sources = [os.path.join(REPO, "mobilefinetuner_tpu", "serve",
+                            "engine.py"),
+               os.path.join(REPO, "tools", "serve_bench.py")]
+    phase_re = re.compile(r"""phase=['"]([a-z_]+)['"]""")
+    reason_re = re.compile(r"""reason=['"]([a-z_]+)['"]""")
+    phases, reasons = set(), set()
+    for path in sources:
+        src = open(path).read()
+        phases |= {m.group(1) for m in phase_re.finditer(src)}
+        reasons |= {m.group(1) for m in reason_re.finditer(src)}
+    assert phases == set(REQUEST_PHASES), (
+        f"phase literals vs REQUEST_PHASES: "
+        f"undeclared={sorted(phases - set(REQUEST_PHASES))}, "
+        f"never emitted={sorted(set(REQUEST_PHASES) - phases)}")
+    assert reasons == set(REQUEST_REASONS), (
+        f"reason literals vs REQUEST_REASONS: "
+        f"undeclared={sorted(reasons - set(REQUEST_REASONS))}, "
+        f"never emitted={sorted(set(REQUEST_REASONS) - reasons)}")

@@ -337,6 +337,43 @@ def test_tenancy_protocol_guards(gemma_engine):
         eng.load_adapter("t9", bad)
 
 
+def test_queued_request_pins_its_adapter(gemma_params):
+    """Satellite regression (round 14): submit() resolves the bank slot
+    at enqueue, so the in-use guard must cover QUEUED requests too —
+    otherwise evict/load while a request waits silently serves another
+    tenant's weights at admission. Pin both directions: replacement AND
+    eviction of a queued-referenced resident are refused, and a
+    non-referenced resident still swaps freely while the queue is
+    non-empty."""
+    bank = AdapterBank(rand_lora(5), capacity=2)
+    eng = ServeEngine("gemma", GEMMA_CFG, gemma_params,
+                      ServeConfig(num_slots=1, block_T=8, num_blocks=32,
+                                  max_prompt=24, max_new_tokens=10),
+                      bank=bank)
+    a1, a2 = rand_lora(6), rand_lora(7)
+    eng.load_adapter("t1", a1)
+    eng.load_adapter("t2", a2)
+    # one active request occupies the single slot, one QUEUED request
+    # references t2 — nothing active routes to t2
+    active = eng.submit([3, 4, 5], max_new_tokens=9, adapter="t1")
+    eng.step()
+    assert active.state == "active"
+    queued = eng.submit([6, 7, 8], max_new_tokens=9, adapter="t2")
+    assert queued.state == "queued"
+    with pytest.raises(RuntimeError, match="in-flight"):
+        eng.load_adapter("t2", rand_lora(8))   # replacement refused
+    with pytest.raises(RuntimeError, match="in-flight"):
+        eng.evict_adapter("t2")                # eviction refused
+    done = {r.id: r for r in eng.drain()}
+    # the queued tenant got ITS OWN weights, not a swapped-in stranger's
+    assert done[queued.id].tokens == oracle("gemma", gemma_params,
+                                            queued, lora=a2)
+    # with the queue empty the same swap is legal again
+    eng.evict_adapter("t2")
+    eng.load_adapter("t3", rand_lora(9))
+    eng.close()
+
+
 # --------------------------- telemetry + e2e smoke ---------------------------
 
 def test_enqueue_event_reports_tenant_slot(gemma_params, tmp_path):

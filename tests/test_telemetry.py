@@ -66,7 +66,13 @@ REPRESENTATIVE = {
     "ckpt_dropped": dict(step=10, superseded_by=12),
     "request": dict(id=3, phase="finish", prompt_tokens=17, adapter=1,
                     queue_ms=4.2, new_tokens=32, ttft_ms=81.0,
-                    tpot_ms=9.5),
+                    tpot_ms=9.5, reason=None),
+    # round-14 serve robustness (DESIGN.md §19): cadenced health
+    # snapshot from ServeEngine.health() — queue/occupancy/page
+    # headroom/p95 step latency + cumulative terminal-state counters
+    "serve_stats": dict(step=50, queue_depth=3, active=8, occupancy=1.0,
+                        free_blocks=120, p95_step_ms=12.5, finished=40,
+                        cancelled=1, rejected=2, timeout=1, error=0),
     # round-13 elastic fleet (DESIGN.md §18): the drain marker and the
     # fleet controller's decision timeline
     "preempt": dict(step=7, signal="SIGTERM"),
@@ -108,6 +114,14 @@ def test_validator_rejects_bad_events():
     assert validate_event({**ok, "loss": True}) is not None
     # extra fields are allowed (schema is a floor)
     assert validate_event({**ok, "extra": {"x": 1}}) is None
+    # the request phase set is CLOSED (round 14): an unknown phase is a
+    # schema violation, not an extra-field allowance
+    req = dict(event="request", seq=0, t=1.0, **REPRESENTATIVE["request"])
+    assert validate_event(req) is None
+    assert validate_event({**req, "phase": "exploded"}) is not None
+    # `reason` is optional on read (r11 streams predate it)
+    assert validate_event({k: v for k, v in req.items()
+                           if k != "reason"}) is None
 
 
 def test_nonfinite_floats_serialize_as_strict_json(tmp_path):

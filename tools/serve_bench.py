@@ -1,5 +1,6 @@
 """Serving-SLO ground truth: seeded Poisson open-loop load over the
-real serve engine (serve/engine.py).
+real serve engine (serve/engine.py) — and, since round 14, the serve
+FAULT-INJECTION harness (DESIGN.md §19).
 
 Open-loop means arrivals do NOT wait for the service: request k arrives
 at its scheduled time whether or not the engine is keeping up, so queue
@@ -13,10 +14,31 @@ Every request's lifecycle rides the telemetry `request` events
 (--telemetry_out), so tools/telemetry_report.py renders the same
 TTFT/TPOT percentiles this tool prints — one measurement, two readers.
 
+`--inject` drives the robustness layers end to end under load, the way
+multihost_smoke's --inject proves the fleet controller:
+
+  step_error:<n>        raise out of decode step n's dispatch — the
+                        engine must fail only the in-flight requests
+                        and keep serving the queue (crash containment)
+  hang:<n>[:<s>]        wedge step n for <s> seconds — the attached
+                        HangWatchdog (--watchdog) must fire a `hang`
+                        event while the run completes
+  slow_step:<n>:<ms>    one straggler step (latency-tail realism)
+  adapter_load_fail     a tenant upload with a mismatched template —
+                        the bank must refuse it without disturbing the
+                        resident tenants
+
+`--max_queue/--deadline_ms/--shed_policy` engage bounded admission and
+per-request deadlines under the same load; SIGTERM during a run drains
+gracefully (finish in-flight, reject the queue with reason=shutdown,
+run_end{reason=preempted}); a second SIGTERM cancels in-flight.
+
 Usage:
   python tools/serve_bench.py                        # GPT-2 small, k=1
   python tools/serve_bench.py --gemma --adapters 8   # Gemma-270M, k=8
   python tools/serve_bench.py --out BENCH_SERVE_r11.json --rate 4 8
+  python tools/serve_bench.py --inject step_error:20 --max_queue 16 \
+      --deadline_ms 2000 --stats_every 25            # fault harness
 """
 
 from __future__ import annotations
@@ -37,6 +59,79 @@ import numpy as np
 # one rank convention, two readers: the percentiles this tool prints
 # must be the ones telemetry_report computes over the same stream
 from telemetry_report import percentile
+
+
+class InjectedStepError(RuntimeError):
+    """The fault harness's synthetic step-dispatch failure — a distinct
+    type so telemetry attributes the contained error to the injection
+    (request{phase=error, reason=InjectedStepError}) and a real crash
+    can never hide behind an injected one."""
+
+
+def install_inject(engine, spec: str, hang_s: float = 2.0):
+    """Arm one fault on the engine's step_hook seam (fires ONCE: the
+    step counter does not advance on a contained failure, so an
+    unlatched hook would re-fire forever). Returns the fired-latch
+    list (empty until the fault triggers) so the caller can FAIL the
+    run when an armed fault never fired — a spec naming a step the run
+    never reaches must not silently pass as "containment proven".
+    Spec grammar: step_error:<n> | hang:<n>[:<s>] | slow_step:<n>:<ms>
+    | adapter_load_fail (handled by inject_adapter_load_fail — it
+    needs the bank, not the step loop; returns None here)."""
+    if not spec or spec == "adapter_load_fail":
+        return None
+    parts = spec.split(":")
+    kind, fired = parts[0], []
+
+    def once(step, n):
+        if step == n and not fired:
+            fired.append(step)
+            return True
+        return False
+
+    if kind == "step_error":
+        n = int(parts[1])
+
+        def hook(step):
+            if once(step, n):
+                raise InjectedStepError(
+                    f"injected step_error at decode step {n}")
+    elif kind == "hang":
+        n = int(parts[1])
+        s = float(parts[2]) if len(parts) > 2 else hang_s
+
+        def hook(step):
+            if once(step, n):
+                time.sleep(s)   # wedge: the watchdog's deadline expires
+    elif kind == "slow_step":
+        n, ms = int(parts[1]), float(parts[2])
+
+        def hook(step):
+            if once(step, n):
+                time.sleep(ms / 1000.0)
+    else:
+        raise SystemExit(f"unknown --inject spec {spec!r}")
+    engine.step_hook = hook
+    return fired
+
+
+def inject_adapter_load_fail(engine) -> str:
+    """Offer the bank a structurally-wrong adapter (rank bumped) the
+    way a corrupt tenant upload would: the load must be REFUSED with a
+    named error, resident tenants undisturbed. Returns the error text
+    (empty = the bank accepted it, which is the failure)."""
+    import jax
+    from mobilefinetuner_tpu.lora.lora import (LoRASpec, init_lora_gemma3,
+                                               init_lora_gpt2)
+    init = (init_lora_gpt2 if engine.family == "gpt2"
+            else init_lora_gemma3)
+    bad = init(engine.config, LoRASpec(rank=16, alpha=32.0),
+               jax.random.PRNGKey(99))
+    try:
+        engine.load_adapter("corrupt_tenant", bad)
+    except ValueError as e:
+        return str(e)
+    return ""
 
 
 def rand_adapters(family, config, k: int, seed: int = 0):
@@ -61,7 +156,10 @@ def rand_adapters(family, config, k: int, seed: int = 0):
 def build_engine(model: str, num_slots: int, block_T: int,
                  num_blocks: int, max_prompt: int, max_new: int,
                  adapters: int, dtype: str, telemetry_out: str = "",
-                 seed: int = 0):
+                 seed: int = 0, max_queue: int = 0,
+                 shed_policy: str = "reject",
+                 on_step_error: str = "fail_active",
+                 stats_every: int = 0, watchdog=None):
     """model: gpt2s | gemma270m | tiny-gpt2 | tiny-gemma. The tiny
     modes are the CPU contract/smoke path (tests/test_serve.py)."""
     from mobilefinetuner_tpu.core.config import GPT2Config, Gemma3TextConfig
@@ -89,9 +187,13 @@ def build_engine(model: str, num_slots: int, block_T: int,
         names = [f"tenant{i}" for i in range(adapters)]
     cfg = ServeConfig(num_slots=num_slots, block_T=block_T,
                       num_blocks=num_blocks, max_prompt=max_prompt,
-                      max_new_tokens=max_new, dtype=dtype)
+                      max_new_tokens=max_new, dtype=dtype,
+                      max_queue=max_queue, shed_policy=shed_policy,
+                      on_step_error=on_step_error,
+                      stats_every=stats_every)
     eng = ServeEngine(family, config, params, cfg, bank=bank,
-                      telemetry=Telemetry(telemetry_out))
+                      telemetry=Telemetry(telemetry_out),
+                      watchdog=watchdog)
     if adapters:
         for n, t in zip(names, trees):
             eng.load_adapter(n, t)
@@ -99,10 +201,17 @@ def build_engine(model: str, num_slots: int, block_T: int,
 
 
 def run_load(engine, names, rate: float, n_requests: int, seed: int,
-             prompt_lo: int, prompt_hi: int, max_new: int):
-    """Drive one open-loop Poisson run; returns (finished requests,
+             prompt_lo: int, prompt_hi: int, max_new: int,
+             deadline_ms=None):
+    """Drive one open-loop Poisson run; returns (terminal requests,
     elapsed seconds). Deterministic given the seed: arrivals, prompt
-    contents/lengths, and tenant routing all come from one rng."""
+    contents/lengths, and tenant routing all come from one rng.
+    Drain-aware: when a SIGTERM flips the engine into draining, the
+    unsubmitted remainder of the schedule is dropped (the clients went
+    away with the pod) and the loop runs the in-flight requests out; a
+    second signal (KeyboardInterrupt out of step()) cancels in-flight.
+    Rejected-at-submit requests (bounded queue, shutdown) are included
+    in the returned list — filter on `.state` for completions."""
     rng = np.random.default_rng(seed)
     vocab = engine.config.vocab_size
     gaps = rng.exponential(1.0 / rate, n_requests)
@@ -113,37 +222,63 @@ def run_load(engine, names, rate: float, n_requests: int, seed: int,
              if names else [None] * n_requests)
     t0 = time.perf_counter()
     arrivals = t0 + np.cumsum(gaps)
-    done, i = [], 0
-    while i < n_requests or not engine.idle:
-        now = time.perf_counter()
-        while i < n_requests and arrivals[i] <= now:
-            engine.submit(prompts[i], max_new_tokens=max_new,
-                          adapter=route[i])
-            i += 1
-        if engine.idle:
-            time.sleep(min(max(arrivals[i] - now, 0.0), 0.05))
-            continue
-        done.extend(engine.step())
-    return sorted(done, key=lambda r: r.id), time.perf_counter() - t0
+    done, submitted, i = [], [], 0
+    try:
+        while i < n_requests or not engine.idle:
+            now = time.perf_counter()
+            if engine.draining:
+                i = n_requests
+            while i < n_requests and arrivals[i] <= now:
+                submitted.append(
+                    engine.submit(prompts[i], max_new_tokens=max_new,
+                                  adapter=route[i],
+                                  deadline_ms=deadline_ms))
+                i += 1
+            if engine.idle:
+                if i < n_requests:
+                    time.sleep(min(max(arrivals[i] - now, 0.0), 0.05))
+                continue
+            done.extend(engine.step())
+    except KeyboardInterrupt:
+        # second signal mid-drain: the operator wants out NOW — cancel
+        # what is still in flight (partial output stays on the request)
+        for req in list(engine.active):
+            engine.cancel(req)
+        engine.begin_shutdown()
+    # census over SUBMITTED ∪ step-returned, not just step-returned:
+    # submit-time terminals (queue_full/shutdown rejects, and shed
+    # victims — terminated inside a LATER request's submit) and the
+    # KeyboardInterrupt cancels above never come back from step()
+    by_id = {r.id: r for r in done}
+    by_id.update({r.id: r for r in submitted if r.done})
+    return (sorted(by_id.values(), key=lambda r: r.id),
+            time.perf_counter() - t0)
 
 
 def row_from(config_name: str, engine, done, elapsed: float,
              rate: float, adapters: int) -> dict:
-    ttfts = sorted(r.ttft_ms for r in done if r.ttft_ms is not None)
-    tpots = sorted(r.tpot_ms for r in done if r.tpot_ms is not None)
+    fin = [r for r in done if r.state == "finished"]
+    ttfts = sorted(r.ttft_ms for r in fin if r.ttft_ms is not None)
+    tpots = sorted(r.tpot_ms for r in fin if r.tpot_ms is not None)
     gen_tokens = sum(len(r.tokens) for r in done)
     pct = lambda v: {"p50": percentile(v, 50), "p95": percentile(v, 95),
                      "p99": percentile(v, 99)}
     return {
         "config": config_name,
         "offered_rps": rate,
-        "requests": len(done),
+        "requests": len(fin),
         "elapsed_s": round(elapsed, 3),
-        "req_s": round(len(done) / elapsed, 3) if elapsed > 0 else None,
+        "req_s": round(len(fin) / elapsed, 3) if elapsed > 0 else None,
         "gen_tok_s": (round(gen_tokens / elapsed, 1)
                       if elapsed > 0 else None),
         "ttft_ms": pct(ttfts),
         "tpot_ms": pct(tpots),
+        # round 14: where the non-finishers went (the SLO denominator a
+        # load-shed/deadline policy is judged by) + the loop vitals
+        "terminal": {s: sum(1 for r in done if r.state == s)
+                     for s in ("finished", "cancelled", "rejected",
+                               "timeout", "error")},
+        "health": engine.health(),
         "adapters_resident": adapters,
         "num_slots": engine.cfg.num_slots,
         "block_T": engine.cfg.block_T,
@@ -157,35 +292,104 @@ def run_rows(model: str, rates, n_requests: int, adapters: int,
              num_slots: int = 8, block_T: int = 16, num_blocks: int = 256,
              max_prompt: int = 64, max_new: int = 32, dtype: str =
              "bfloat16", seed: int = 0, prompt_lo: int = 8,
-             prompt_hi: int = 0, telemetry_out: str = "") -> list:
-    """One engine, one warmup request, then one row per offered rate."""
+             prompt_hi: int = 0, telemetry_out: str = "",
+             max_queue: int = 0, shed_policy: str = "reject",
+             on_step_error: str = "fail_active", deadline_ms=None,
+             stats_every: int = 0, inject: str = "", drain: bool = True,
+             watchdog_mode: int = 0, watchdog_min_s: float = 60.0) -> list:
+    """One engine, one warmup request, then one row per offered rate.
+    `drain` arms the SIGTERM PreemptionGuard; `inject` fires its fault
+    during the FIRST rate's run (the spec names an absolute decode
+    step)."""
+    from mobilefinetuner_tpu.core.telemetry import HangWatchdog
     prompt_hi = prompt_hi or max_prompt
+    wd = None
+    if watchdog_mode:
+        wd = HangWatchdog(mult=10.0, min_deadline_s=watchdog_min_s,
+                          grace_s=max(watchdog_min_s, 5.0),
+                          abort=watchdog_mode == 2)
     eng, names = build_engine(model, num_slots, block_T, num_blocks,
                               max_prompt, max_new, adapters, dtype,
-                              telemetry_out=telemetry_out, seed=seed)
+                              telemetry_out=telemetry_out, seed=seed,
+                              max_queue=max_queue, shed_policy=shed_policy,
+                              on_step_error=on_step_error,
+                              stats_every=stats_every, watchdog=wd)
+    if wd is not None:
+        wd.on_hang = lambda p: eng.telemetry.emit("hang", **p)
+        wd.stacks_file = (eng.telemetry.path + ".stacks"
+                          if eng.telemetry.path else wd.stacks_file)
+        wd.start()
+    if drain:
+        eng.install_preemption()
     # warmup: compile prefill + step outside the measured window
     eng.submit([1] * prompt_lo, max_new_tokens=min(2, max_new),
                adapter=names[0] if names else None)
     eng.drain()
     warm_traces = eng.total_traces()
+    if inject == "adapter_load_fail":
+        err = inject_adapter_load_fail(eng)
+        if not err:
+            # the harness MUST fail loudly when the injected fault is
+            # not handled — a CI caller keys on the exit status
+            if wd is not None:
+                wd.stop()
+            eng.close()
+            raise SystemExit(
+                "--inject adapter_load_fail: the bank ACCEPTED a "
+                "structurally-wrong adapter — validation regressed")
+        print(f"inject adapter_load_fail: REFUSED ({err[:60]}...)")
+        fired = None
+    else:
+        fired = install_inject(eng, inject)
     rows = []
-    for rate in rates:
-        done, elapsed = run_load(eng, names, rate, n_requests, seed,
-                                 prompt_lo, prompt_hi, max_new)
-        name = f"{model}_serve_k{max(adapters, 1)}_r{rate:g}"
-        row = row_from(name, eng, done, elapsed, rate, adapters)
-        row["new_traces_after_warmup"] = eng.total_traces() - warm_traces
-        rows.append(row)
-        # percentiles may be None (e.g. max_new=1 leaves no post-first-
-        # token cadence, so every tpot is None)
-        fmt = lambda v, spec="0f": ("n/a" if v is None
-                                    else f"{v:.{spec}}")
-        print(f"{name}: {row['req_s']} req/s ({row['gen_tok_s']} tok/s), "
-              f"TTFT p50/p99 = {fmt(row['ttft_ms']['p50'])}/"
-              f"{fmt(row['ttft_ms']['p99'])} ms, TPOT p50 = "
-              f"{fmt(row['tpot_ms']['p50'], '1f')} ms, "
-              f"{row['new_traces_after_warmup']} retraces")
-    eng.close()
+    try:
+        for rate in rates:
+            counts0 = dict(eng.counts)   # scope the row's census to
+            # THIS run: health()'s counters are engine-lifetime
+            done, elapsed = run_load(eng, names, rate, n_requests, seed,
+                                     prompt_lo, prompt_hi, max_new,
+                                     deadline_ms=deadline_ms)
+            name = f"{model}_serve_k{max(adapters, 1)}_r{rate:g}"
+            row = row_from(name, eng, done, elapsed, rate, adapters)
+            row["health"]["counts"] = {
+                k: int(eng.counts.get(k, 0)) - counts0.get(k, 0)
+                for k in row["health"]["counts"]}
+            row["new_traces_after_warmup"] = \
+                eng.total_traces() - warm_traces
+            if inject:
+                row["inject"] = inject
+            rows.append(row)
+            # percentiles may be None (e.g. max_new=1 leaves no post-
+            # first-token cadence, so every tpot is None)
+            fmt = lambda v, spec="0f": ("n/a" if v is None
+                                        else f"{v:.{spec}}")
+            term = row["terminal"]
+            faults = ", ".join(f"{k} {v}" for k, v in term.items()
+                               if k != "finished" and v)
+            print(f"{name}: {row['req_s']} req/s "
+                  f"({row['gen_tok_s']} tok/s), "
+                  f"TTFT p50/p99 = {fmt(row['ttft_ms']['p50'])}/"
+                  f"{fmt(row['ttft_ms']['p99'])} ms, TPOT p50 = "
+                  f"{fmt(row['tpot_ms']['p50'], '1f')} ms, "
+                  f"{row['new_traces_after_warmup']} retraces"
+                  + (f" [{faults}]" if faults else ""))
+            if eng.draining:
+                print(f"{name}: DRAINED (SIGTERM) — remaining rates "
+                      f"skipped")
+                break
+    finally:
+        if wd is not None:
+            wd.stop()
+        eng.close()
+    if fired is not None and not fired:
+        # the armed fault never triggered (step already consumed by
+        # warmup, or past the run's reach): the robustness claim was
+        # NOT exercised — fail the harness, don't report a clean row
+        raise SystemExit(
+            f"--inject {inject}: the armed fault never fired "
+            f"(run ended at decode step "
+            f"{rows[-1]['decode_steps'] if rows else 0}) — "
+            f"nothing was proven")
     return rows
 
 
@@ -212,15 +416,62 @@ def main(argv=None) -> int:
     ap.add_argument("--telemetry_out", default="")
     ap.add_argument("--out", default="",
                     help="append rows to this JSON artifact")
+    # --- robustness / fault harness (round 14, DESIGN.md §19) ---------
+    ap.add_argument("--max_queue", type=int, default=0,
+                    help="bounded admission: cap the FCFS queue; "
+                         "over-limit submits reject with "
+                         "reason=queue_full (0 = unbounded)")
+    ap.add_argument("--shed_policy", default="reject",
+                    choices=["reject", "deadline"],
+                    help="on a full queue: reject the newest arrival, "
+                         "or shed the queued request closest to "
+                         "blowing its deadline")
+    ap.add_argument("--deadline_ms", type=float, default=0.0,
+                    help="per-request end-to-end deadline; expired "
+                         "queued requests never prefill, active ones "
+                         "stop at the next step boundary (0 = none)")
+    ap.add_argument("--on_step_error", default="fail_active",
+                    choices=["fail_active", "raise"],
+                    help="contain a step-dispatch exception (fail the "
+                         "in-flight requests, keep serving) or re-raise "
+                         "after containing")
+    ap.add_argument("--stats_every", type=int, default=0,
+                    help="emit a serve_stats health snapshot every N "
+                         "decode steps (0 = off)")
+    ap.add_argument("--inject", default="",
+                    help="fault harness: step_error:<n> | hang:<n>[:<s>]"
+                         " | slow_step:<n>:<ms> | adapter_load_fail")
+    ap.add_argument("--drain", type=int, default=1, choices=[0, 1],
+                    help="arm SIGTERM graceful drain (finish in-flight, "
+                         "reject queue with reason=shutdown, "
+                         "run_end{reason=preempted}; second signal "
+                         "cancels in-flight)")
+    ap.add_argument("--watchdog", type=int, default=0, choices=[0, 1, 2],
+                    help="hang watchdog over the serve loop: 1 = report "
+                         "(`hang` event) and keep waiting, 2 = report "
+                         "then abort (exit 113)")
+    ap.add_argument("--watchdog_min_s", type=float, default=60.0,
+                    help="watchdog deadline floor (and pre-first-step "
+                         "grace) in seconds")
     args = ap.parse_args(argv)
     model = "gemma270m" if args.gemma else args.model
+    if args.inject == "adapter_load_fail" and not args.adapters:
+        raise SystemExit("--inject adapter_load_fail needs --adapters k")
     rows = run_rows(model, args.rate, args.requests, args.adapters,
                     num_slots=args.num_slots, block_T=args.block_T,
                     num_blocks=args.num_blocks,
                     max_prompt=args.max_prompt, max_new=args.max_new,
                     dtype=args.dtype, seed=args.seed,
                     prompt_lo=args.prompt_lo,
-                    telemetry_out=args.telemetry_out)
+                    telemetry_out=args.telemetry_out,
+                    max_queue=args.max_queue,
+                    shed_policy=args.shed_policy,
+                    on_step_error=args.on_step_error,
+                    deadline_ms=args.deadline_ms or None,
+                    stats_every=args.stats_every, inject=args.inject,
+                    drain=bool(args.drain),
+                    watchdog_mode=args.watchdog,
+                    watchdog_min_s=args.watchdog_min_s)
     if args.out:
         art = {"device": jax.devices()[0].device_kind,
                "jax": jax.__version__, "rows": []}

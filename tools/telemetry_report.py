@@ -138,6 +138,7 @@ def summarize(events, n_invalid=0) -> dict:
                   for e in by.get("eval", [])],
         "checkpoints": checkpoint_summary(scope),
         "requests": request_summary(scope),
+        "serve": serve_stats_summary(scope),
         "stragglers": straggler_entries(scope),
         "hangs": hang_entries(scope),
         # a killed LATEST run leaves no run_end after its run_start (a
@@ -210,8 +211,10 @@ def checkpoint_lines(ck) -> list:
 
 def request_summary(events) -> dict:
     """Serving SLOs from the per-request `request` lifecycle events
-    (serve/engine.py): TTFT/TPOT percentiles over FINISHED requests and
-    sustained req/s over the stream's observed request span. None when
+    (serve/engine.py): TTFT/TPOT percentiles over FINISHED requests,
+    sustained req/s over the stream's observed request span, and —
+    round 14 — the failure-mode counters and rates (reject / timeout /
+    error over submitted) a robustness policy is judged by. None when
     the stream carries no serving traffic."""
     reqs = [e for e in events if e.get("event") == "request"]
     if not reqs:
@@ -227,10 +230,27 @@ def request_summary(events) -> dict:
     span = (max(e["t"] for e in reqs) - min(e["t"] for e in reqs)
             if len(reqs) > 1 else 0.0)
     gen = sum(e.get("new_tokens") or 0 for e in fins)
+    sub = sum(1 for e in reqs if e.get("phase") == "enqueue")
+    n_phase = lambda p: sum(1 for e in reqs if e.get("phase") == p)
+    rate = lambda n: round(n / sub, 4) if sub else None
+    rejected, timeouts, errors = (n_phase("reject"), n_phase("timeout"),
+                                  n_phase("error"))
+    reasons = {}
+    for e in reqs:
+        if e.get("phase") in ("reject", "timeout", "error") \
+                and e.get("reason"):
+            reasons[e["reason"]] = reasons.get(e["reason"], 0) + 1
     return {
-        "submitted": sum(1 for e in reqs if e.get("phase") == "enqueue"),
+        "submitted": sub,
         "finished": len(fins),
-        "cancelled": sum(1 for e in reqs if e.get("phase") == "cancel"),
+        "cancelled": n_phase("cancel"),
+        "rejected": rejected,
+        "timeout": timeouts,
+        "errors": errors,
+        "reject_rate": rate(rejected),
+        "timeout_rate": rate(timeouts),
+        "error_rate": rate(errors),
+        "fail_reasons": reasons,
         "ttft_ms": pcts(ttfts),
         "tpot_ms": pcts(tpots),
         "req_s": round(len(fins) / span, 3) if span > 0 else None,
@@ -254,7 +274,54 @@ def request_lines(r) -> list:
     if tp["p50"] is not None:
         lines.append(f"    TPOT p50/p95/p99 = {_fmt(tp['p50'], 2)}/"
                      f"{_fmt(tp['p95'], 2)}/{_fmt(tp['p99'], 2)} ms")
+    # pre-round-14 summaries (fleet_report fixtures) may lack the
+    # failure counters; render the line only when something failed
+    fails = [(k, r.get(k, 0), r.get(rk)) for k, rk in
+             (("rejected", "reject_rate"), ("timeout", "timeout_rate"),
+              ("errors", "error_rate"))]
+    if any(n for _, n, _ in fails):
+        pc = lambda v: f" ({100 * v:.1f}%)" if v else ""
+        bits = [f"{k} {n}{pc(rt)}" for k, n, rt in fails if n]
+        why = r.get("fail_reasons") or {}
+        if why:
+            bits.append("reasons: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(why.items())))
+        lines.append("    " + "; ".join(bits))
     return lines
+
+
+def serve_stats_summary(events) -> dict:
+    """Roll up the cadenced `serve_stats` health snapshots
+    (serve/engine.py health()): queue-depth peak, occupancy mean,
+    free-page floor, latest rolling p95 step latency, and the final
+    cumulative terminal-state counters. None when the stream carries
+    none (pre-round-14 serve streams, training runs)."""
+    ss = [e for e in events if e.get("event") == "serve_stats"]
+    if not ss:
+        return None
+    last = ss[-1]
+    return {
+        "snapshots": len(ss),
+        "queue_depth_max": max(e["queue_depth"] for e in ss),
+        "queue_depth_last": last["queue_depth"],
+        "occupancy_mean": round(
+            sum(e["occupancy"] for e in ss) / len(ss), 4),
+        "free_blocks_min": min(e["free_blocks"] for e in ss),
+        "p95_step_ms_last": last["p95_step_ms"],
+        "counts": {k: last.get(k, 0) for k in
+                   ("finished", "cancelled", "rejected", "timeout",
+                    "error")},
+    }
+
+
+def serve_stats_lines(s) -> list:
+    if not s:
+        return []
+    return [f"  serve health: {s['snapshots']} snapshot(s); queue max "
+            f"{s['queue_depth_max']} (last {s['queue_depth_last']}), "
+            f"occupancy mean {100 * s['occupancy_mean']:.0f}%, free "
+            f"pages min {s['free_blocks_min']}, p95 step "
+            f"{_fmt(s['p95_step_ms_last'], 1)} ms"]
 
 
 def controller_entries(events) -> list:
@@ -451,6 +518,8 @@ def print_summary(s: dict):
     for line in checkpoint_lines(s["checkpoints"]):
         print(line)
     for line in request_lines(s.get("requests")):
+        print(line)
+    for line in serve_stats_lines(s.get("serve")):
         print(line)
     for line in straggler_lines(s.get("stragglers", [])) \
             + hang_lines(s.get("hangs", [])):

@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import contextlib
 import itertools
+import math
 import os
 import statistics
 import time
@@ -234,6 +235,70 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
                    help="base backoff for --data_retries (doubles per "
                         "attempt, +25%% jitter to desynchronize a fleet "
                         "retrying the same filesystem)")
+    # numerical-fault recovery (DESIGN.md §20)
+    g.add_argument("--skip_nonfinite", type=int, default=0,
+                   help="1 = guarded update: when a step's gradients "
+                        "carry any non-finite element (or the global "
+                        "grad norm is non-finite) the Adam update "
+                        "degenerates to identity INSIDE the compiled "
+                        "step (params/opt state pass through a "
+                        "jnp.where tree-select; donation, shardings, "
+                        "and the LR schedule untouched) and a "
+                        "`skipped` count rides step_stats with zero "
+                        "added syncs. A clean run is byte-identical "
+                        "with the guard on or off. 0 = off (a NaN "
+                        "grad poisons the params, as before)")
+    g.add_argument("--rollback_budget", type=int, default=0,
+                   help="> 0 arms in-process rollback: on sustained "
+                        "divergence (anomaly{kind=divergence}), a "
+                        "streak of --rollback_skip_streak skipped/"
+                        "nonfinite steps, or a nonfinite loss with the "
+                        "skip guard off, the loop reloads the newest "
+                        "VERIFIED lineage checkpoint + .opt sidecar "
+                        "without restarting the process or recompiling "
+                        "the step, fast-forwards the data stream, and "
+                        "keeps training — at most this many times per "
+                        "run (each decision emits a `rollback` event). "
+                        "Requires --save_every checkpoints. 0 = off")
+    g.add_argument("--rollback_skip_streak", type=int, default=3,
+                   help="consecutive skipped-update/nonfinite-loss "
+                        "steps that trigger a rollback (a single "
+                        "skipped step is the guard doing its job, not "
+                        "a reason to lose progress)")
+    g.add_argument("--rollback_data_offset", type=int, default=1,
+                   help="extra data-stream steps skipped per rollback "
+                        "so the replayed window sees a DIVERGED batch "
+                        "sequence (a deterministically poisonous batch "
+                        "must not be replayed verbatim); 0 replays the "
+                        "byte-pinned original sequence")
+    g.add_argument("--keep_ckpts", type=int, default=0,
+                   help="retain only the K newest step-tagged "
+                        "checkpoints in the lineage (<final>.lineage."
+                        "json), GC'ing older files AFTER the pruned "
+                        "lineage publishes atomically (a kill mid-GC "
+                        "leaves orphans, never a lineage naming "
+                        "deleted files); the final artifact is never "
+                        "pruned. 0 = keep all")
+    g.add_argument("--verify_ckpt", type=int, default=1,
+                   help="1 = verify the per-tensor checksum manifest "
+                        "on every checkpoint load (--resume_from and "
+                        "rollback): a corrupt/truncated/stale file is "
+                        "rejected with a ckpt_verify{ok=false} event "
+                        "and the load falls back down the lineage "
+                        "chain instead of crashing or silently "
+                        "loading garbage. 0 = trust the newest file")
+    g.add_argument("--inject", default="",
+                   help="fault-injection harness (the multihost_smoke/"
+                        "serve_bench --inject pattern, CPU-testable): "
+                        "grad_nan:<step>[:<n>] poisons n (default 1) "
+                        "consecutive step batches with NaN so the "
+                        "gradients go non-finite; loss_spike:<step>"
+                        "[:<n>] scrambles n batches' labels (loss "
+                        "level-shift); ckpt_corrupt flips a byte in "
+                        "the newest lineage checkpoint after its "
+                        "first periodic save. Each fires ONCE per "
+                        "process (latched), so a post-rollback replay "
+                        "of the same steps runs clean")
 
 
 def add_align_flags(p: argparse.ArgumentParser):
@@ -440,7 +505,8 @@ def train_config_from_args(args, total_steps: int) -> TrainConfig:
         schedule=args.lr_schedule, clip_grad_norm=args.clip_grad_norm,
         grad_accum_steps=args.grad_accum_steps,
         weight_decay=args.weight_decay,
-        coupled_weight_decay=args.coupled_weight_decay)
+        coupled_weight_decay=args.coupled_weight_decay,
+        skip_nonfinite=bool(getattr(args, "skip_nonfinite", 0)))
 
 
 def micro_batches(dataset: WikiText2Dataset, accum: int,
@@ -574,8 +640,22 @@ def maybe_resume_opt_state(args, trainable, tc: TrainConfig, mask=None):
                               trainable)
     opt_state, _ = adam_mod.load_state(path + ".opt", template,
                                        to_host=True)
-    start_step = int(opt_state["step"])
-    log.info(f"restored optimizer state @ step {start_step}")
+    # the LOOP step, not Adam's: under --skip_nonfinite the Adam step
+    # counter lags the loop step by the skipped updates, so resuming at
+    # opt_state["step"] would replay already-consumed batches. The
+    # sidecar's loop_step metadata (round 15) is authoritative; the
+    # lineage json is the fallback for sidecars that predate it.
+    from mobilefinetuner_tpu.io.checkpoints import lineage_step_for
+    from mobilefinetuner_tpu.io.safetensors_io import SafeTensorsReader
+    md = SafeTensorsReader(path + ".opt").metadata
+    if "loop_step" in md:
+        start_step = int(md["loop_step"])
+    else:
+        start_step = lineage_step_for(path)
+        if start_step is None:
+            start_step = int(opt_state["step"])
+    log.info(f"restored optimizer state @ step {start_step} "
+             f"(adam step {int(opt_state['step'])})")
     return opt_state, start_step
 
 
@@ -624,6 +704,170 @@ def make_data_retry_sink(tel, cur_step: dict):
     return sink
 
 
+def resolve_resume_from(args) -> None:
+    """Verify `--resume_from` against its integrity lineage BEFORE any
+    load touches it (DESIGN.md §20 verify-on-load contract): the
+    checksum manifest of the named checkpoint (+ .opt sidecar) is
+    recomputed; a corrupt/truncated/stale file makes the resolution
+    FALL BACK down `<path>.lineage.json` to the newest verified entry
+    instead of crashing — or worse, silently loading garbage into a
+    run. args.resume_from is REWRITTEN to the resolved path (all
+    downstream loads — adapter/model file and the opt sidecar — then
+    agree on the same artifact), and the per-candidate ckpt_verify
+    verdicts are stashed on args for run_training to emit right after
+    run_start (the stream's first event must stay run_start). Shared
+    by all four train CLIs so the fallback rule cannot drift."""
+    path = getattr(args, "resume_from", "")
+    if not path:
+        return
+    if os.path.isdir(path):
+        # an HF checkpoint DIRECTORY (full-FT resume source): external
+        # HF artifacts carry no per-file manifests and there is no
+        # lineage to fall back down — load as before
+        return
+    from mobilefinetuner_tpu.io.checkpoints import resolve_checkpoint
+    resolved, _step, events = resolve_checkpoint(
+        path, verify=bool(getattr(args, "verify_ckpt", 1)))
+    args._ckpt_verify_events = events
+    if resolved != path:
+        log.warning(f"--resume_from {path} failed integrity "
+                    f"verification; falling back down the lineage to "
+                    f"{resolved}")
+        args.resume_from = resolved
+    elif events and not events[0]["ok"]:
+        log.warning(f"--resume_from {path}: {events[-1]['reason']} "
+                    f"(loading unverified — no verified lineage "
+                    f"alternative)")
+
+
+def record_ckpt_files(args, final_path: str, step: int, files) -> None:
+    """Write-hook tail shared by the train CLIs: record a completed
+    save into `<final_path>.lineage.json` and GC past --keep_ckpts
+    (io/checkpoints.record_checkpoint — lineage publishes atomically
+    BEFORE any unlink, so a kill mid-GC never strands the retained
+    set). Runs on the async writer thread; failures are logged, not
+    raised (a lineage bookkeeping error must not fail the save whose
+    files are already durable)."""
+    try:
+        from mobilefinetuner_tpu.io.checkpoints import record_checkpoint
+        record_checkpoint(final_path, step, list(files),
+                          keep=max(getattr(args, "keep_ckpts", 0), 0))
+    except Exception as e:
+        log.warning(f"checkpoint lineage update failed: {e}")
+
+
+def make_rollback_loader(tc: TrainConfig, mask, load_trainable):
+    """Build run_training's `load_hook(path) -> (trainable_host,
+    opt_state_host)` from a CLI's trainable loader. `load_trainable`
+    maps a checkpoint path to the host trainable tree (the adapter for
+    the LoRA CLIs, the full param tree for full FT); the Adam sidecar
+    at `<path>.opt` is restored to HOST numpy against an abstract
+    template (no device allocation — the caller places both trees at
+    THIS run's mesh, reusing the elastic-resume machinery)."""
+    from mobilefinetuner_tpu.optim import adam as adam_mod
+
+    def load_hook(path):
+        tr_h = load_trainable(path)
+        template = jax.eval_shape(
+            lambda t: init_optimizer(t, tc, mask), tr_h)
+        opt_h, _ = adam_mod.load_state(path + ".opt", template,
+                                       to_host=True)
+        return tr_h, opt_h
+    return load_hook
+
+
+def parse_train_inject(spec: str):
+    """--inject grammar -> (kind, step, n) | ('ckpt_corrupt', None, 1)
+    | None. Shared validation so a typo dies at startup, not at the
+    injection step."""
+    if not spec:
+        return None
+    parts = spec.split(":")
+    kind = parts[0]
+    if kind == "ckpt_corrupt":
+        return ("ckpt_corrupt", None, 1)
+    if kind not in ("grad_nan", "loss_spike"):
+        raise SystemExit(
+            f"--inject must be grad_nan:<step>[:<n>] | "
+            f"loss_spike:<step>[:<n>] | ckpt_corrupt, got {spec!r}")
+    if len(parts) < 2:
+        raise SystemExit(f"--inject {kind} needs a step: {spec!r}")
+    step = int(parts[1])
+    n = int(parts[2]) if len(parts) > 2 else 1
+    return (kind, step, max(n, 1))
+
+
+class FaultInjector:
+    """Host-side numerical-fault injection for the train path (the
+    r13/r14 --inject pattern): poisons step BATCHES on the input side —
+    a NaN `grad_scale` row multiplies the accumulated gradients INSIDE
+    the compiled step (genuinely non-finite grads through the real
+    backward), scrambled labels drive a real loss level-shift — so the
+    skip/rollback machinery is exercised end to end, not simulated.
+    Each fault fires ONCE per process (latched by a fired counter):
+    after a rollback replays the poisoned window, the same steps run
+    clean — the recovery, not the fault, repeats."""
+
+    def __init__(self, spec: str):
+        parsed = parse_train_inject(spec)
+        self.kind, self.at, self.n = parsed if parsed else (None, None, 0)
+        self.fired = 0
+
+    @property
+    def active(self) -> bool:
+        return self.kind is not None
+
+    def maybe_poison(self, step: int, batch: dict) -> dict:
+        if self.kind == "grad_nan":
+            # EVERY batch carries the [B] grad_scale row while armed
+            # (batch structure must be constant for the AOT-compiled
+            # step); only the poison window carries NaN
+            batch = dict(batch)
+            poison = self.fired < self.n and step >= self.at
+            if poison:
+                self.fired += 1
+            batch["grad_scale"] = np.full(
+                batch["input_ids"].shape[0],
+                np.nan if poison else 1.0, np.float32)
+            if poison:
+                log.warning(f"--inject grad_nan: NaN grads for step "
+                            f"{step} ({self.fired}/{self.n})")
+            return batch
+        if self.kind == "loss_spike" and self.fired < self.n \
+                and step >= self.at:
+            self.fired += 1
+            # misaligned labels = a REAL loss level-shift through the
+            # actual forward, not a doctored metric
+            batch = dict(batch)
+            batch["labels"] = np.roll(batch["labels"], 7, axis=-1)
+            log.warning(f"--inject loss_spike: scrambled labels for "
+                        f"step {step} ({self.fired}/{self.n})")
+        return batch
+
+    def maybe_corrupt_ckpt(self, ckpt_path: str) -> bool:
+        """ckpt_corrupt: flip one payload byte in the newest lineage
+        checkpoint (once). Returns True when it fired."""
+        if self.kind != "ckpt_corrupt" or self.fired:
+            return False
+        from mobilefinetuner_tpu.io.checkpoints import lineage_entries
+        entries = lineage_entries(ckpt_path)
+        if not entries:
+            return False
+        victim = entries[0]["files"][0]
+        try:
+            with open(victim, "r+b") as f:
+                f.seek(-1, os.SEEK_END)
+                b = f.read(1)
+                f.seek(-1, os.SEEK_END)
+                f.write(bytes([b[0] ^ 0xFF]))
+        except OSError as e:
+            log.warning(f"--inject ckpt_corrupt failed: {e}")
+            return False
+        self.fired = 1
+        log.warning(f"--inject ckpt_corrupt: flipped a byte in {victim}")
+        return True
+
+
 class EMA:
     """EMA-smoothed loss (CmdArgs ema_beta, default 0.9)."""
 
@@ -645,7 +889,9 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                  save_hook: Optional[Callable] = None,
                  mesh=None, replicate_trainable: bool = True,
                  dropout_rng=None, step_builder=None,
-                 flops_per_step: Optional[float] = None):
+                 flops_per_step: Optional[float] = None,
+                 load_hook: Optional[Callable] = None,
+                 ckpt_path: str = ""):
     """The shared optimizer-step loop: compiled step + eval cadence + EMA +
     metrics CSV + JSONL eval records + governor throttle + periodic saves
     + the run-telemetry event stream (--telemetry_out, core/telemetry.py).
@@ -662,6 +908,15 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     flops_per_step: the CLI's analytic transformer_flops estimate for ONE
     optimizer step — drives the in-loop MFU in the log line, the CSV, and
     step_stats (None: MFU omitted).
+    load_hook(path) -> (trainable_host, opt_state_host) is the INVERSE
+    of save_hook (make_rollback_loader builds it): with it, `ckpt_path`
+    (the run's final artifact, whose .lineage.json tracks the
+    step-tagged last-known-good set) and --rollback_budget > 0, the
+    loop closes the SpikeDetector loop in-process — on sustained
+    divergence / a skipped-step streak / nonfinite loss it reloads the
+    newest VERIFIED lineage checkpoint at this run's mesh, rebuilds the
+    data stream (byte-pinned skip_steps + --rollback_data_offset), and
+    keeps training with the SAME compiled step (DESIGN.md §20).
     Returns (trainable, opt_state, last_metrics).
     """
     from mobilefinetuner_tpu.parallel.distributed import (allgather_scalars,
@@ -680,6 +935,13 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     multiproc = jax.process_count() > 1
     tel = Telemetry.for_process(getattr(args, "telemetry_out", ""))
     tel.emit("run_start", **run_manifest(vars(args), mesh))
+    # --resume_from integrity verdicts (resolve_resume_from ran in the
+    # CLI, BEFORE this stream existed): emitted here so the acceptance
+    # contract — a corrupted newest checkpoint resolves down the
+    # lineage WITH ckpt_verify evidence in the run's own stream — holds
+    # while run_start stays the stream's first event of the run.
+    for _ev in getattr(args, "_ckpt_verify_events", None) or []:
+        tel.emit("ckpt_verify", **_ev)
     t_start = time.time()
     # wall-clock bucket accounting over run_training's whole span; the
     # buckets sum to run_end.wall_s by construction (DESIGN.md §14)
@@ -901,27 +1163,53 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
         sp = getattr(args, "sequence_parallel", False)
         place_batch = make_batch_placer(mesh, sp)
 
-        def numbered_batches():
-            gen = micro_batches(train_ds, tc.grad_accum_steps,
-                                skip_steps=start_step)
-            for step in itertools.count(start_step):
-                epoch, batch = next(gen)
-                yield step, epoch, batch
+        # fault-injection harness (--inject, DESIGN.md §20): batches are
+        # poisoned on the HOST side inside place_step — before dropout
+        # keys and device placement — so the injected fault flows
+        # through the real compiled forward/backward
+        injector = FaultInjector(getattr(args, "inject", ""))
 
         def place_step(item):
             step, epoch, batch = item
+            if injector.active:
+                batch = injector.maybe_poison(step, batch)
             if dropout_rng is not None:
                 nb = batch["input_ids"].shape[0]
                 batch["dropout_rng"] = jax.random.split(
                     jax.random.fold_in(dropout_rng, step), nb)
             return step, epoch, place_batch(batch)
 
-        # max(..., 0): a resume at/after total_steps runs zero steps (the loop
-        # below is empty) and must not build a stream at all
-        stream = Prefetcher(
-            itertools.islice(numbered_batches(),
-                             max(total_steps - start_step, 0)),
-            depth=prefetch_depth, place_fn=place_step, lookahead=1)
+        def make_stream(from_step: int, data_skip: int) -> Prefetcher:
+            """The numbered, placed step-batch stream from `from_step`.
+            `data_skip` is the byte-pinned fast-forward in STEPS —
+            normally == from_step (resume continues the exact data
+            order); a rollback passes from_step + k*rollback_data_offset
+            to diverge the replayed window's batch sequence. max(..., 0):
+            a resume at/after total_steps runs zero steps (the loop
+            below is empty) and must not build a stream at all."""
+            def numbered():
+                gen = micro_batches(train_ds, tc.grad_accum_steps,
+                                    skip_steps=data_skip)
+                for step in itertools.count(from_step):
+                    epoch, batch = next(gen)
+                    yield step, epoch, batch
+            return Prefetcher(
+                itertools.islice(numbered(),
+                                 max(total_steps - from_step, 0)),
+                depth=prefetch_depth, place_fn=place_step, lookahead=1)
+
+        stream = make_stream(start_step, start_step)
+        # in-process rollback state (armed only when the CLI wired the
+        # inverse load hook AND checkpoints exist to roll back to)
+        rb = None
+        if (load_hook is not None and ckpt_path
+                and getattr(args, "rollback_budget", 0) > 0):
+            rb = {"budget": int(args.rollback_budget), "count": 0,
+                  "streak": 0, "due": None, "suppressed": False,
+                  "skip_streak": max(
+                      getattr(args, "rollback_skip_streak", 3), 1),
+                  "offset": max(
+                      getattr(args, "rollback_data_offset", 1), 0)}
         metrics = {}
         epoch = 0
         compiled_step = None       # AOT-compiled at the first step
@@ -1009,6 +1297,34 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                         f"anomaly @ step {s + 1}: {anom['kind']} "
                         f"loss={loss:.4f}"
                         + (f" z={anom['zscore']}" if anom["zscore"] else ""))
+                if rb is not None:
+                    # rollback triggers, evaluated per flushed step:
+                    # sustained divergence (the detector's escalated
+                    # kind), a streak of skipped/nonfinite steps, or a
+                    # nonfinite loss with the skip guard OFF (params
+                    # already poisoned — waiting is pointless). A
+                    # single skipped step or one-off loss_spike never
+                    # triggers: that is the guard/winsorizer working.
+                    # `suppressed` (set by a FAILED rollback) holds
+                    # triggers until a clean step ends the episode —
+                    # without it a checkpoint-less NaN run would emit
+                    # one ok=false rollback + a full lineage CRC walk
+                    # per step forever (stream-sizing rule).
+                    bad = (int(m.get("skipped") or 0) > 0
+                           or not math.isfinite(loss))
+                    rb["streak"] = rb["streak"] + 1 if bad else 0
+                    if not bad:
+                        rb["suppressed"] = False
+                    if rb["due"] is not None or rb["suppressed"]:
+                        pass
+                    elif anom is not None \
+                            and anom["kind"] == "divergence":
+                        rb["due"] = ("divergence", s + 1)
+                    elif rb["streak"] >= rb["skip_streak"]:
+                        rb["due"] = ("skip_streak", s + 1)
+                    elif (not math.isfinite(loss)
+                          and not tc.skip_nonfinite):
+                        rb["due"] = ("nonfinite_loss", s + 1)
                 if metrics_csv:
                     metrics_csv.log(epoch=ep, step=s + 1, loss=loss,
                                     avg_loss=avg, lr=float(m["lr"]),
@@ -1029,6 +1345,11 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 update_ratio=opt_f("update_ratio"),
                 nonfinite_count=(int(m["nonfinite_count"])
                                  if "nonfinite_count" in m else None),
+                # COUNT over the flush interval (unlike the last-step
+                # health scalars): the report's skipped-step total is a
+                # sum of these, so no skip can fall between flushes
+                skipped=(sum(int(fm["skipped"]) for fm in fetched)
+                         if "skipped" in m else None),
                 hbm_mb=hbm, queue_depth=stream.queue_depth(),
                 host_step_ms=host_step_ms["latest"])
             if emit_log and args.log_interval:
@@ -1046,10 +1367,111 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
             waited_ms = 0.0
             t_interval = time.perf_counter()
 
+        def attempt_rollback(reason: str, at_step: int):
+            """Close the sensors→recovery loop IN PROCESS (DESIGN.md
+            §20): resolve the newest VERIFIED lineage checkpoint at or
+            below the trigger step, reload trainable + Adam sidecar as
+            host numpy, place both at THIS run's mesh (the r13
+            elastic-resume placement — replicate for LoRA-style
+            trainables, FSDP re-shard otherwise), rebuild the data
+            stream past the poison region, and hand the loop its resume
+            step. The compiled step is REUSED — shapes, shardings and
+            donation are unchanged, so recovery costs a load + place,
+            not a recompile. Returns the resume step, or None when no
+            rollback happened (every verdict lands in the stream)."""
+            nonlocal trainable, opt_state, stream, ema, spikes, \
+                t_interval
+            with pause():
+                # the WHOLE recovery is a legitimate long pause — the
+                # drain of an in-flight multi-GB write and the CRC walk
+                # over the lineage candidates can each exceed any
+                # step-derived watchdog deadline, same as the load
+                try:  # lineage must be settled: finish in-flight writes
+                    ckpt.drain()
+                except Exception as e:
+                    log.warning(f"rollback: checkpoint drain failed "
+                                f"({e}); resolving against what is on "
+                                f"disk")
+                from mobilefinetuner_tpu.io.checkpoints import \
+                    resolve_checkpoint
+                # max_step = at_step - 1: a checkpoint written at the
+                # very trigger boundary may already hold the poisoned
+                # update (skip guard off) — never "recover" into it
+                resolved, to_step, events = resolve_checkpoint(
+                    None, verify=bool(getattr(args, "verify_ckpt", 1)),
+                    lineage_base=ckpt_path, max_step=at_step - 1)
+                for ev in events:
+                    tel.emit("ckpt_verify", **ev)
+                if resolved is None or to_step is None:
+                    tel.emit("rollback", step=at_step, reason=reason,
+                             ok=False, to_step=None, steps_lost=None,
+                             ckpt=None, data_offset=None,
+                             budget_left=rb["budget"])
+                    log.warning(f"rollback wanted ({reason} @ step "
+                                f"{at_step}) but no verified "
+                                f"checkpoint exists — continuing "
+                                f"without")
+                    # suppress further triggers until a CLEAN step ends
+                    # this episode: a checkpoint-less diverged run must
+                    # not emit one ok=false rollback + a lineage CRC
+                    # walk per step forever
+                    rb["streak"] = 0
+                    rb["suppressed"] = True
+                    return None
+                tr_h, opt_h = load_hook(resolved)
+                if mesh is not None and replicate_trainable:
+                    repl = replicated_sharding(mesh)
+                    put = lambda x: device_put_global(jnp.asarray(x),
+                                                      repl)
+                    trainable = jax.tree.map(put, tr_h)
+                    opt_state = jax.tree.map(put, opt_h)
+                elif mesh is not None:
+                    from mobilefinetuner_tpu.parallel.mesh import \
+                        shard_params
+                    trainable = shard_params(tr_h, mesh)
+                    opt_state = shard_params(opt_h, mesh)
+                else:
+                    trainable = jax.tree.map(jnp.asarray, tr_h)
+                    opt_state = jax.tree.map(jnp.asarray, opt_h)
+            rb["count"] += 1
+            rb["budget"] -= 1
+            rb["streak"] = 0
+            data_offset = rb["count"] * rb["offset"]
+            stream.close()
+            stream = make_stream(to_step, to_step + data_offset)
+            # fresh host-side statistics: the old EMA/variance describe
+            # the diverged trajectory, not the restored one (count_hint
+            # keeps the detector armed — post-rollback losses are
+            # healthy, not early-training wild)
+            ema = EMA(args.ema_beta)
+            spikes = SpikeDetector(SpikeConfig(
+                zscore=getattr(args, "spike_z", 8.0),
+                beta=getattr(args, "spike_beta", 0.98),
+                warmup=getattr(args, "spike_warmup", 20)))
+            spikes.seed([], count_hint=to_step)
+            cur_step["step"] = to_step
+            # recovery wall time is not step time: restart the flush
+            # interval or the first post-rollback flush would fold the
+            # whole drain+verify+load into its per-step average (and
+            # feed that corrupted sample to the watchdog deadline and
+            # the straggler window)
+            t_interval = time.perf_counter()
+            tel.emit("rollback", step=at_step, reason=reason, ok=True,
+                     to_step=to_step, steps_lost=at_step - to_step,
+                     ckpt=resolved, data_offset=data_offset,
+                     budget_left=rb["budget"])
+            log.warning(
+                f"ROLLBACK ({reason}): step {at_step} -> {to_step} "
+                f"from {resolved} ({at_step - to_step} step(s) lost, "
+                f"data offset +{data_offset}, budget left "
+                f"{rb['budget']})")
+            return to_step
+
         if wd is not None:
             wd.start()
         try:
-            for step in range(start_step, total_steps):
+            step = start_step
+            while step < total_steps:
                 # the prefetched stream yields batches already placed (and
                 # dropout-keyed); this next() is the step loop's only input
                 # dependency, and the time it blocks is the host/device
@@ -1168,6 +1590,15 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                                   final=False, ckpt=ckpt)
                     meter.enter("step")
                     t_interval = time.perf_counter()  # save time ≠ step time
+                    if injector.kind == "ckpt_corrupt" and ckpt_path:
+                        # fault harness: bit-flip the newest lineage
+                        # entry AFTER its write lands, so a later
+                        # rollback/resume must fall back down the chain
+                        try:
+                            ckpt.drain()
+                        except Exception:
+                            pass
+                        injector.maybe_corrupt_ckpt(ckpt_path)
 
                 meter.enter("governor_sleep")
                 slept_ms += governor.throttle(step)
@@ -1204,6 +1635,28 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                         metrics_csv.close()
                     end_run("preempted", done_steps, reason="preempted")
                     raise SystemExit(EXIT_PREEMPTED)
+
+                if rb is not None and rb["due"] is not None:
+                    # a flush inside THIS iteration raised a trigger:
+                    # act at the step boundary (the metrics buffer is
+                    # empty — triggers only arise from a flush)
+                    reason, at_step = rb["due"]
+                    rb["due"] = None
+                    if rb["budget"] <= 0:
+                        tel.emit("rollback", step=at_step, reason=reason,
+                                 ok=False, to_step=None, steps_lost=None,
+                                 ckpt=None, data_offset=None,
+                                 budget_left=0)
+                        log.warning(
+                            f"rollback budget exhausted; training on "
+                            f"through {reason} @ step {at_step}")
+                        rb = None  # stop evaluating triggers
+                    else:
+                        resumed = attempt_rollback(reason, at_step)
+                        if resumed is not None:
+                            step = resumed
+                            continue
+                step += 1
         except BaseException as e:
             # the stream records HOW the run ended before the exception
             # propagates — a crashed run's tail is run_start..last flush +

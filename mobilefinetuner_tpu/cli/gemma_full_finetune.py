@@ -92,6 +92,8 @@ def main(argv=None) -> int:
         log.warning("--no_model_dropout is a no-op for Gemma-3 "
                     "(the config has no dropout fields)")
     if args.resume_from:
+        # verify-on-load with lineage fallback (DESIGN.md §20)
+        common.resolve_resume_from(args)
         params = gemma3_params_from_hf(
             common.load_full_resume(args.resume_from), config)
         log.info(f"resumed full model from {args.resume_from}")
@@ -132,6 +134,18 @@ def main(argv=None) -> int:
             raise SystemExit("--opt_offload is single-chip (it streams "
                              "state through one chip's host link); drop "
                              "--mesh_data/--mesh_fsdp")
+        if getattr(args, "skip_nonfinite", 0) \
+                or getattr(args, "rollback_budget", 0) > 0:
+            # refuse loudly rather than silently void the safety
+            # promise: the offloaded step builder has no skip guard
+            # (a NaN grad would poison the host-tier master/m/v) and
+            # the generic rollback cannot reproduce its placements
+            raise SystemExit(
+                "--skip_nonfinite/--rollback_budget are not supported "
+                "with --opt_offload (the offloaded update has no "
+                "guarded-identity path; recovery there is "
+                "process-level --resume_from) — drop the recovery "
+                "flags or --opt_offload")
         oo_spec = oo.OptOffloadSpec(
             state_dtype=args.opt_offload_state_dtype,
             master_dtype=args.opt_offload_master_dtype)
@@ -221,7 +235,11 @@ def main(argv=None) -> int:
 
             def write():
                 save_gemma3(path, model_h)
-                adam_mod.save_state(path + ".opt", side_h, tc.adam())
+                adam_mod.save_state(path + ".opt", side_h, tc.adam(),
+                                    extra_metadata={
+                                        "loop_step": str(step)})
+                common.record_ckpt_files(args, args.output_path, step,
+                                         [path, path + ".opt"])
                 log.info(f"saved full model -> {path}")
                 return [path, path + ".opt"]
         else:
@@ -230,7 +248,11 @@ def main(argv=None) -> int:
 
             def write():
                 save_gemma3(path, params_h)
-                adam_mod.save_state(path + ".opt", opt_h, tc.adam())
+                adam_mod.save_state(path + ".opt", opt_h, tc.adam(),
+                                    extra_metadata={
+                                        "loop_step": str(step)})
+                common.record_ckpt_files(args, args.output_path, step,
+                                         [path, path + ".opt"])
                 log.info(f"saved full model -> {path}")
                 return [path, path + ".opt"]
 
@@ -251,8 +273,25 @@ def main(argv=None) -> int:
         total_steps=total_steps, tc=tc, mask=None, start_step=start_step,
         opt_state=opt_state, save_hook=save_hook, mesh=mesh,
         replicate_trainable=False, step_builder=step_builder,
-        flops_per_step=flops)
+        flops_per_step=flops,
+        # rollback rides the plain-Adam path only: the opt-offload
+        # builder owns its own host-tier placements, which the generic
+        # rollback re-placement cannot reproduce — its recovery story
+        # stays process-level restart (--resume_from)
+        load_hook=(None if args.opt_offload
+                   else common.make_rollback_loader(
+                       tc, None,
+                       lambda p: _load_full_gemma(p, config))),
+        ckpt_path="" if args.opt_offload else args.output_path)
     return 0
+
+
+def _load_full_gemma(path, config):
+    """Rollback inverse of the plain-Adam save_hook: HF-keyed Gemma-3
+    file -> stacked host param tree."""
+    from mobilefinetuner_tpu.io.safetensors_io import SafeTensorsReader
+    return gemma3_params_from_hf(
+        SafeTensorsReader(path).load_all(promote_to_f32=True), config)
 
 
 if __name__ == "__main__":

@@ -64,6 +64,8 @@ def main(argv=None) -> int:
         config = dataclasses.replace(config, embd_pdrop=0.0,
                                      resid_pdrop=0.0, attn_pdrop=0.0)
     if args.resume_from:
+        # verify-on-load with lineage fallback (DESIGN.md §20)
+        common.resolve_resume_from(args)
         params = gpt2_params_from_hf(
             common.load_full_resume(args.resume_from), config)
         log.info(f"resumed full model from {args.resume_from}")
@@ -145,12 +147,23 @@ def main(argv=None) -> int:
 
         def write():
             save_gpt2(path, params_h)
-            adam_mod.save_state(path + ".opt", opt_h, tc.adam())
+            adam_mod.save_state(path + ".opt", opt_h, tc.adam(),
+                                extra_metadata={"loop_step": str(step)})
+            common.record_ckpt_files(args, args.output_path, step,
+                                     [path, path + ".opt"])
             log.info(f"saved full model -> {path}")
             return [path, path + ".opt"]
 
         async_ckpt.submit(ckpt, step, write, final=final,
                           snapshot_ms=snap_ms)
+
+    def load_trainable(path):
+        """Rollback inverse of save_hook: HF-keyed full model file ->
+        the stacked host param tree (mesh placement happens in
+        run_training's rollback, reusing the elastic-resume rule)."""
+        from mobilefinetuner_tpu.io.safetensors_io import SafeTensorsReader
+        return gpt2_params_from_hf(
+            SafeTensorsReader(path).load_all(promote_to_f32=True), config)
 
     # in-loop MFU from the shared estimator (core/telemetry.py)
     from mobilefinetuner_tpu.core.telemetry import transformer_flops
@@ -165,7 +178,9 @@ def main(argv=None) -> int:
         total_steps=total_steps, tc=tc, mask=None, start_step=start_step,
         opt_state=opt_state, save_hook=save_hook, mesh=mesh,
         replicate_trainable=False, dropout_rng=base_rng,
-        flops_per_step=flops)
+        flops_per_step=flops,
+        load_hook=common.make_rollback_loader(tc, None, load_trainable),
+        ckpt_path=args.output_path)
     return 0
 
 

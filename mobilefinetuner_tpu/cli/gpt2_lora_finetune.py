@@ -104,8 +104,12 @@ def main(argv=None) -> int:
     log.info(f"GPT-2: layers={config.n_layer} hidden={config.n_embd} "
              f"heads={config.n_head}")
 
-    # LoRA: fresh init or resume (main.cpp:340-400)
+    # LoRA: fresh init or resume (main.cpp:340-400). The resume source
+    # is checksum-verified first and falls back down its lineage on
+    # corruption (common.resolve_resume_from rewrites args.resume_from;
+    # the ckpt_verify verdicts land in the telemetry stream).
     if args.resume_from:
+        common.resolve_resume_from(args)
         lora, spec = peft_io.load_adapter(args.resume_from)
         log.info(f"resumed adapter: r={spec.rank} alpha={spec.alpha} "
                  f"targets={spec.targets}")
@@ -220,7 +224,12 @@ def main(argv=None) -> int:
 
         def write():
             peft_io.save_adapter(path, lora_h, spec)
-            adam_mod.save_state(path + ".opt", opt_h, tc.adam())
+            # loop_step: the resume point (Adam's own counter lags it
+            # under --skip_nonfinite); lineage + GC ride the write hook
+            adam_mod.save_state(path + ".opt", opt_h, tc.adam(),
+                                extra_metadata={"loop_step": str(step)})
+            common.record_ckpt_files(args, args.lora_out, step,
+                                     [path, path + ".opt"])
             log.info(f"saved adapter -> {path}")
             if final and args.peft_export_dir:
                 peft_io.export_peft(args.peft_export_dir, lora_h, spec,
@@ -246,7 +255,12 @@ def main(argv=None) -> int:
         train_ds=train_ds, valid_ds=valid_ds, total_steps=total_steps,
         tc=tc, mask=mask, start_step=start_step, opt_state=opt_state,
         save_hook=save_hook, mesh=mesh, dropout_rng=base_rng,
-        flops_per_step=flops)
+        flops_per_step=flops,
+        # the inverse of save_hook: arms in-process rollback
+        # (--rollback_budget) against the lineage at --lora_out
+        load_hook=common.make_rollback_loader(
+            tc, mask, lambda p: peft_io.load_adapter(p)[0]),
+        ckpt_path=args.lora_out)
     return 0
 
 

@@ -105,6 +105,8 @@ def main(argv=None) -> int:
              f"{config.num_key_value_heads}")
 
     if args.resume_from:
+        # verify-on-load with lineage fallback (DESIGN.md §20)
+        common.resolve_resume_from(args)
         lora, spec = peft_io.load_adapter(args.resume_from)
         log.info(f"resumed adapter: r={spec.rank} targets={spec.targets}")
     else:
@@ -238,7 +240,12 @@ def main(argv=None) -> int:
 
         def write():
             peft_io.save_adapter(path, lora_h, spec)
-            adam_mod.save_state(path + ".opt", opt_h, tc.adam())
+            adam_mod.save_state(path + ".opt", opt_h, tc.adam(),
+                                extra_metadata={"loop_step": str(step)})
+            common.record_ckpt_files(
+                args, os.path.join(args.output_dir,
+                                   "gemma_lora.safetensors"),
+                step, [path, path + ".opt"])
             log.info(f"saved adapter -> {path}")
             if final and args.peft_export_dir:
                 peft_io.export_peft(args.peft_export_dir, lora_h, spec,
@@ -263,7 +270,10 @@ def main(argv=None) -> int:
         train_ds=train_ds, valid_ds=valid_ds, total_steps=total_steps,
         tc=tc, mask=mask, start_step=start_step, opt_state=opt_state,
         save_hook=save_hook, mesh=mesh, dropout_rng=base_rng,
-        flops_per_step=flops)
+        flops_per_step=flops,
+        load_hook=common.make_rollback_loader(
+            tc, mask, lambda p: peft_io.load_adapter(p)[0]),
+        ckpt_path=os.path.join(args.output_dir, "gemma_lora.safetensors"))
     return 0
 
 

@@ -99,6 +99,10 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "param_norm": _OPT_NUM,     # None on step builders without the
         "update_ratio": _OPT_NUM,   # on-device health metrics
         "nonfinite_count": _OPT_NUM,
+        "skipped": _OPT_NUM,        # skip_nonfinite guard: COUNT of
+                                    # skipped updates in this flush
+                                    # interval (None on step builders
+                                    # without the guard's metric)
         "hbm_mb": _NUM,
         "queue_depth": _OPT_NUM,    # input-pipeline gauge (None: no stream)
         "host_step_ms": (dict, type(None)),  # {host: per-step ms} from the
@@ -117,11 +121,15 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
     # exactly for kind=nonfinite_loss — strict-JSON rule), OR a
     # transient-but-survived incident: kind=data_retry (the streaming
     # data pipeline hit an I/O error and is backing off instead of
-    # killing the run; extra fields carry attempt/error/backoff_s)
+    # killing the run; extra fields carry attempt/error/backoff_s).
+    # kind=divergence is the SUSTAINED form — divergence_run
+    # consecutive spiking steps, a level-shift rather than a blip —
+    # and is the rollback policy's trigger; one-off loss_spike events
+    # deliberately are not (DESIGN.md §20).
     "anomaly": {
         "step": (int,),
-        "kind": (str,),             # "loss_spike" | "nonfinite_loss"
-                                    # | "data_retry"
+        "kind": (str,),             # "loss_spike" | "divergence"
+                                    # | "nonfinite_loss" | "data_retry"
         "loss": _OPT_NUM,
         "ema": _OPT_NUM,
         "zscore": _OPT_NUM,
@@ -229,6 +237,38 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "timeout": (int,),
         "error": (int,),
     },
+    # one checkpoint-integrity verdict per candidate a load path
+    # visited (io/checkpoints.resolve_checkpoint — --resume_from, the
+    # in-process rollback, the serve AdapterBank hot-swap): ok=false
+    # names why the candidate was rejected (checksum_mismatch:<tensor>,
+    # manifest_missing/stale, malformed, size_mismatch) and the walk
+    # falls back DOWN the lineage chain instead of crashing on — or
+    # silently loading — the newest file (DESIGN.md §20).
+    "ckpt_verify": {
+        "path": (str,),
+        "ok": (bool,),
+        "reason": _OPT_STR,         # None exactly when ok
+        "step": _OPT_NUM,           # lineage step; None when unknown
+        "action": _OPT_STR,         # "load" | "reject"
+    },
+    # one in-process rollback decision (cli/common.run_training closing
+    # the SpikeDetector loop, DESIGN.md §20): on sustained divergence /
+    # a skipped-step streak / nonfinite loss the loop reloads the
+    # last-known-good verified checkpoint WITHOUT restarting the
+    # process or recompiling the step, fast-forwards the data stream,
+    # and keeps training. ok=false records a rollback that could not
+    # happen (no verified checkpoint, or budget exhausted).
+    "rollback": {
+        "step": (int,),             # the step the trigger fired at
+        "reason": (str,),           # divergence | skip_streak |
+                                    # nonfinite_loss | ...
+        "ok": (bool,),
+        "to_step": _OPT_NUM,        # resumed loop step (None on ok=false)
+        "steps_lost": _OPT_NUM,     # step - to_step (recovery cost)
+        "ckpt": _OPT_STR,           # the checkpoint file loaded
+        "data_offset": _OPT_NUM,    # extra data-stream skip applied
+        "budget_left": _OPT_NUM,    # --rollback_budget remaining
+    },
     # preemption drain began (core/preempt.py + cli/common.run_training):
     # a SIGTERM/SIGINT was observed at a step boundary; what follows is
     # the final flush, one atomic checkpoint, and a run_end with
@@ -273,11 +313,14 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
 # ABSENCE so pre-fleet (round-8) streams still validate and render —
 # when present they are type-checked as usual.
 OPTIONAL_FIELDS: Dict[str, frozenset] = {
-    "step_stats": frozenset({"host_step_ms"}),
+    "step_stats": frozenset({"host_step_ms", "skipped"}),
     "run_end": frozenset({"goodput", "reason"}),
     "checkpoint": frozenset({"snapshot_ms", "write_ms", "bytes", "mb_s",
                              "async"}),
     "request": frozenset({"reason"}),
+    "ckpt_verify": frozenset({"reason", "step", "action"}),
+    "rollback": frozenset({"to_step", "steps_lost", "ckpt",
+                           "data_offset", "budget_left"}),
 }
 
 
@@ -550,6 +593,12 @@ class SpikeConfig:
     zscore: float = 8.0    # fire when (loss - ema) / std exceeds this
     beta: float = 0.98     # EMA decay for mean AND variance
     warmup: int = 20       # observations before the detector arms
+    # sustained-divergence threshold: this many CONSECUTIVE spiking
+    # steps escalate the anomaly kind from loss_spike (transient blip)
+    # to divergence (level-shift) — the distinction the rollback policy
+    # keys on, so one bad batch never triggers a rollback but a run
+    # walking away from its loss curve does. <= 0 disables escalation.
+    divergence_run: int = 3
 
 
 class SpikeDetector:
@@ -569,6 +618,7 @@ class SpikeDetector:
         self.var: float = 0.0
         self.count: int = 0
         self._nonfinite: bool = False  # inside a non-finite run?
+        self.streak: int = 0  # consecutive spiking steps (divergence)
 
     def update(self, loss: float) -> Optional[dict]:
         """Feed one per-step loss; returns {kind, zscore} when anomalous,
@@ -593,7 +643,13 @@ class SpikeDetector:
             return {"kind": "nonfinite_loss", "zscore": None}
         self._nonfinite = False
         if self.mean is None:
-            self.mean, self.count = loss, 1
+            # first OBSERVED loss: seed the mean but never clobber the
+            # observation count — a rollback re-arms the detector via
+            # seed([], count_hint=step) with no losses to feed, and
+            # resetting to 1 here would silently re-enter warmup
+            # exactly when a recurring divergence needs catching
+            self.mean = loss
+            self.count += 1
             return None
         dev = loss - self.mean
         std = math.sqrt(self.var)
@@ -601,9 +657,22 @@ class SpikeDetector:
         armed = self.count >= c.warmup
         out = None
         if armed and std > 0 and z > c.zscore:
-            out = {"kind": "loss_spike", "zscore": round(z, 2)}
+            # a streak of consecutive spiking steps is not a blip but a
+            # level-shift: escalate the kind to `divergence` at
+            # divergence_run — the distinct trigger the rollback policy
+            # consumes (a transient loss_spike must never roll a run
+            # back). The streak resets on fire so a long excursion
+            # re-fires every divergence_run-th step, not every step.
+            self.streak += 1
+            kind = "loss_spike"
+            if 0 < c.divergence_run <= self.streak:
+                kind = "divergence"
+                self.streak = 0
+            out = {"kind": kind, "zscore": round(z, 2)}
             loss = self.mean + c.zscore * std  # winsorize
             dev = loss - self.mean
+        else:
+            self.streak = 0
         self.mean = c.beta * self.mean + (1 - c.beta) * loss
         self.var = c.beta * self.var + (1 - c.beta) * dev * dev
         self.count += 1

@@ -93,6 +93,24 @@ def tree_bytes(host_tree) -> int:
 
 # ----------------------------- background writer -----------------------------
 
+class CheckpointDrainTimeout(TimeoutError):
+    """A bounded drain/close expired with a checkpoint write still in
+    flight — the writer thread is wedged (dead filesystem, hung NFS).
+    Carries the stuck step in the message so the operator knows WHICH
+    recovery point never landed; atomic publication guarantees the
+    unfinished write left no corrupt file behind. A NAMED type so
+    cleanup paths can distinguish 'writer wedged, abandon it' from a
+    real write error (which close() re-raises as RuntimeError)."""
+
+    def __init__(self, step: int, timeout: float):
+        self.step = step
+        self.timeout = timeout
+        super().__init__(
+            f"checkpoint write for step {step} still in flight after "
+            f"{timeout:.1f}s drain timeout (writer thread wedged; the "
+            f"unfinished write cannot corrupt any published checkpoint)")
+
+
 class _SaveItem:
     __slots__ = ("step", "write_fn", "final", "snapshot_ms", "done")
 
@@ -182,30 +200,44 @@ class AsyncCheckpointer:
         any) completed; re-raise any background-write error. timeout
         (per outstanding item) bounds the wait for cleanup paths — a
         final=True save drains WITHOUT one (the run must not end before
-        its last checkpoint is durable)."""
+        its last checkpoint is durable). A bounded drain that expires
+        raises CheckpointDrainTimeout NAMING the in-flight step (it
+        used to return silently, which let a hung write stall shutdown
+        indefinitely downstream — the caller had no way to know the
+        drain gave up)."""
         while True:
             with self._lock:
                 item = self._inflight or self._pending
             if item is None:
                 break
             if not item.done.wait(timeout):
-                return  # wedged write: the caller's close() abandons it
+                raise CheckpointDrainTimeout(item.step, timeout or 0.0)
         self._raise_pending_error()
 
-    def close(self, raise_errors: bool = True) -> None:
+    def close(self, raise_errors: bool = True,
+              drain_timeout: float = 600.0) -> None:
         """Drain outstanding writes (a snapshot already taken is a
         checkpoint worth finishing, even when the training loop died)
         and stop the writer thread. raise_errors=False swallows write
         errors — for exception-path cleanup where re-raising would mask
-        the original failure. The drain is BOUNDED here (generously —
-        any real write finishes in minutes; a dead filesystem never
-        does) so a wedged writer cannot hang cleanup forever: on
-        timeout the daemon thread is abandoned (atomic publication
-        means an unfinished write leaves no corrupt file behind), and
-        the writer thread is stopped/joined even when the drain
-        re-raises a stored write error (no thread leak)."""
+        the original failure. The drain is BOUNDED (generously — any
+        real write finishes in minutes; a dead filesystem never does)
+        so a wedged writer cannot hang cleanup forever: on
+        CheckpointDrainTimeout the daemon thread is ABANDONED — no
+        30-second join against a thread known to be stuck (atomic
+        publication means the unfinished write leaves no corrupt file
+        behind) — and with raise_errors the named timeout propagates so
+        shutdown reports WHICH step's recovery point was lost. The
+        writer thread is stopped/joined on every other path, including
+        when the drain re-raises a stored write error (no thread
+        leak)."""
+        wedged = False
         try:
-            self.drain(timeout=600.0)
+            self.drain(timeout=drain_timeout)
+        except CheckpointDrainTimeout:
+            wedged = True
+            if raise_errors:
+                raise
         except BaseException:
             if raise_errors:
                 raise
@@ -214,7 +246,7 @@ class AsyncCheckpointer:
                 self._stop = True
                 self._work.notify()
             if self._thread is not None:
-                self._thread.join(timeout=30.0)
+                self._thread.join(timeout=0.2 if wedged else 30.0)
                 self._thread = None
 
     # -- writer side ----------------------------------------------------------

@@ -21,9 +21,18 @@ import contextlib
 import json
 import os
 import struct
+import zlib
 from typing import Dict, Optional, Tuple
 
 import numpy as np
+
+
+class CheckpointIntegrityError(ValueError):
+    """A checkpoint failed its integrity contract: unreadable/truncated
+    file, missing or stale checksum manifest, or a per-tensor checksum
+    mismatch. A NAMED type so load paths can refuse corrupt artifacts
+    distinctly from ordinary I/O errors — the serve AdapterBank and the
+    train-path lineage fallback both key on it (DESIGN.md §20)."""
 
 
 @contextlib.contextmanager
@@ -180,6 +189,95 @@ class SafeTensorsReader:
     def load_all(self, promote_to_f32: bool = False) -> Dict[str, np.ndarray]:
         return {k: self.load(k, promote_to_f32) for k in self.entries}
 
+    def raw_bytes(self, name: str) -> bytes:
+        """One tensor's STORED payload bytes, undecoded — the unit the
+        integrity manifest checksums (a BF16 tensor hashes its on-disk
+        u16 bytes, not a decode). A truncated blob returns fewer bytes
+        than the header promised; the verifier treats that as corruption
+        rather than erroring here."""
+        if self._native is not None:
+            return bytes(self._native.raw(name))
+        begin, end = self.entries[name]["data_offsets"]
+        return bytes(self._blob[begin:min(end, len(self._blob))])
+
+
+# --------------------------- integrity manifest ------------------------------
+
+# The per-tensor checksum sidecar every writer publishes next to its
+# safetensors file (`<path>.manifest.json`, via the same atomic_publish).
+# Checksums cover the ENCODED payload bytes — exactly what lands on disk
+# — so a bit flip anywhere in the blob, a truncation, or a stale/partial
+# write is caught at load time instead of silently training/serving from
+# a corrupt artifact. The manifest is written AFTER the main file's
+# atomic rename: a crash in the window between the two leaves a stale
+# manifest, which verification reports as corruption — the load paths
+# then fall back down the checkpoint lineage (io/checkpoints.py), the
+# conservative failure.
+MANIFEST_VERSION = 1
+
+
+def manifest_path(path: str) -> str:
+    return path + ".manifest.json"
+
+
+def _write_manifest(path: str, entries: Dict[str, dict]) -> str:
+    mp = manifest_path(path)
+    payload = {"version": MANIFEST_VERSION,
+               "file": os.path.basename(path),
+               "tensors": entries}
+    with atomic_publish(mp) as tmp:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, separators=(",", ":"), sort_keys=True)
+    return mp
+
+
+def verify_report(path: str) -> Tuple[str, Optional[str]]:
+    """Integrity verdict for one safetensors file against its manifest:
+    ('ok', None) — manifest present, every tensor's stored bytes match;
+    ('unverified', reason) — the file parses but carries NO manifest
+    (pre-manifest checkpoint): loadable only as a last resort;
+    ('corrupt', reason) — missing/unparseable file, unreadable or stale
+    manifest, size or checksum mismatch. Never raises."""
+    if not os.path.exists(path):
+        return "corrupt", "missing_file"
+    try:
+        reader = SafeTensorsReader(path)
+    except (ValueError, OSError, MemoryError) as e:
+        return "corrupt", f"malformed:{type(e).__name__}"
+    mp = manifest_path(path)
+    if not os.path.exists(mp):
+        return "unverified", "manifest_missing"
+    try:
+        with open(mp, "r", encoding="utf-8") as f:
+            manifest = json.load(f)
+        tensors = manifest["tensors"]
+        assert isinstance(tensors, dict)
+    except (ValueError, KeyError, AssertionError, OSError):
+        return "corrupt", "manifest_unreadable"
+    if set(tensors) != set(reader.entries):
+        return "corrupt", "manifest_stale"
+    for name, spec in tensors.items():
+        try:
+            raw = reader.raw_bytes(name)
+        except Exception as e:  # mmap fault on a truncated blob etc.
+            return "corrupt", f"payload_unreadable:{name}:{type(e).__name__}"
+        if len(raw) != spec.get("nbytes"):
+            return "corrupt", f"size_mismatch:{name}"
+        if (zlib.crc32(raw) & 0xFFFFFFFF) != spec.get("crc32"):
+            return "corrupt", f"checksum_mismatch:{name}"
+    return "ok", None
+
+
+def verify_file(path: str) -> None:
+    """Raise CheckpointIntegrityError unless `path` verifies 'ok'
+    against its manifest (a missing manifest fails too — strict form,
+    used where an unverified artifact must not be trusted, e.g. the
+    serve AdapterBank's hot-swap path)."""
+    status, reason = verify_report(path)
+    if status != "ok":
+        raise CheckpointIntegrityError(
+            f"{path}: integrity verification failed ({reason})")
+
 
 def _tensor_spec(name, arr, bf16_keys):
     """(tag, shape, nbytes, encode) for one tensor — the single source of
@@ -210,7 +308,8 @@ def _encode_tensor(name, arr, bf16_keys) -> Tuple[str, tuple, bytes]:
 
 def save_safetensors(path: str, tensors: Dict[str, np.ndarray],
                      metadata: Optional[Dict[str, str]] = None,
-                     bf16_keys: Optional[set] = None):
+                     bf16_keys: Optional[set] = None,
+                     manifest: bool = True):
     """Write a safetensors file. Keys in `bf16_keys` (or arrays already
     passed as jax bfloat16 via float32 conversion upstream) are stored BF16.
     Uses the native streamed writer when available; the Python writer below
@@ -219,27 +318,52 @@ def save_safetensors(path: str, tensors: Dict[str, np.ndarray],
     EVERY write is atomically published (tmp + fsync + rename): since all
     checkpoint writers in the repo — adapters, full-model saves, the .opt
     optimizer sidecar — funnel through here, none of them can leave a
-    truncated file where a resumable checkpoint used to be.
+    truncated file where a resumable checkpoint used to be. With
+    `manifest` (the default) a `<path>.manifest.json` checksum sidecar is
+    published after the main rename, carrying crc32/nbytes per tensor
+    over the stored payload bytes — the verify-on-load contract
+    (`verify_report`/`verify_file`) every resume/rollback/adapter-swap
+    path checks. The checksums are computed from the same encode pass
+    the writer streams to disk, so the manifest costs no extra read.
     """
+    sums: Dict[str, dict] = {}
     with atomic_publish(path) as tmp:
-        _write_safetensors(tmp, tensors, metadata, bf16_keys)
+        _write_safetensors(tmp, tensors, metadata, bf16_keys,
+                           checksums=sums if manifest else None)
+    if manifest:
+        _write_manifest(path, sums)
 
 
 def _write_safetensors(path: str, tensors: Dict[str, np.ndarray],
                        metadata: Optional[Dict[str, str]] = None,
-                       bf16_keys: Optional[set] = None):
+                       bf16_keys: Optional[set] = None,
+                       checksums: Optional[Dict[str, dict]] = None):
+    def _record(name, tag, shape, raw):
+        if checksums is not None:
+            checksums[name] = {"crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+                               "nbytes": len(raw), "dtype": tag,
+                               "shape": list(shape)}
+
     nat = _native_mod()
     if nat is not None:
         # real write failures (IOError) propagate — a disk that rejects
         # the native writer would reject the Python writer too. Payloads
         # go in as callables: the native writer declares the header from
         # (tag, shape, nbytes) and encodes ONE tensor at a time during the
-        # data pass, so peak host memory is a single tensor's bytes.
-        nat.native_write(
-            path,
-            [(name,) + _tensor_spec(name, arr, bf16_keys)
-             for name, arr in tensors.items()],
-            metadata)
+        # data pass, so peak host memory is a single tensor's bytes. The
+        # checksum wrapper rides that same single encode call, so the
+        # manifest never forces a second encode pass.
+        items = []
+        for name, arr in tensors.items():
+            tag, shape, nbytes, encode = _tensor_spec(name, arr, bf16_keys)
+
+            def wrap(name=name, tag=tag, shape=shape, encode=encode):
+                raw = encode()
+                _record(name, tag, shape, raw)
+                return raw
+
+            items.append((name, tag, shape, nbytes, wrap))
+        nat.native_write(path, items, metadata)
         return
     header: Dict[str, object] = {}
     if metadata:
@@ -249,6 +373,7 @@ def _write_safetensors(path: str, tensors: Dict[str, np.ndarray],
     offset = 0
     for name, arr in tensors.items():
         tag, shape, raw = _encode_tensor(name, arr, bf16_keys)
+        _record(name, tag, shape, raw)
         header[name] = {"dtype": tag, "shape": list(shape),
                         "data_offsets": [offset, offset + len(raw)]}
         blobs.append(raw)

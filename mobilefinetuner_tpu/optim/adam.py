@@ -150,12 +150,19 @@ def clip_by_global_norm(grads, max_norm: float):
 
 # ----------------------------- state I/O ------------------------------------
 
-def save_state(path: str, state: dict, config: AdamConfig):
+def save_state(path: str, state: dict, config: AdamConfig,
+               extra_metadata: Optional[Dict[str, str]] = None):
     """Serialize optimizer state + config to a safetensors blob
     (Adam::save analog, adam.cpp:103+). Device leaves come to host via
     one batched issue-then-wait (io/async_ckpt.snapshot) instead of a
     serialized per-leaf pull; the write itself is atomically published
-    by save_safetensors."""
+    by save_safetensors (which also publishes the integrity manifest
+    the verify-on-load paths check). `extra_metadata` rides in the
+    safetensors header — the train CLIs stamp `loop_step` there: under
+    `--skip_nonfinite` the Adam step counter lags the loop step by the
+    skipped updates, so the sidecar's `step` tensor alone is the wrong
+    resume point (cli/common.maybe_resume_opt_state prefers the
+    metadata)."""
     from mobilefinetuner_tpu.io.async_ckpt import snapshot
     from mobilefinetuner_tpu.io.safetensors_io import save_safetensors
     state = snapshot(state)  # no-op on trees already on host
@@ -167,11 +174,14 @@ def save_state(path: str, state: dict, config: AdamConfig):
         flat[key] = np.asarray(leaf)
     md = {f"adam_{f.name}": str(getattr(config, f.name))
           for f in dataclasses.fields(config)}
+    if extra_metadata:
+        md.update({str(k): str(v) for k, v in extra_metadata.items()})
     save_safetensors(path, flat, metadata=md)
 
 
 def load_state(path: str, state_template: dict,
-               to_host: bool = False) -> Tuple[dict, AdamConfig]:
+               to_host: bool = False,
+               verify: bool = False) -> Tuple[dict, AdamConfig]:
     """Restore optimizer state into the template's structure. The
     template only contributes tree structure + leaf shape/dtype, so
     `jax.eval_shape` ShapeDtypeStructs work — no device allocation
@@ -179,8 +189,12 @@ def load_state(path: str, state_template: dict,
     leaves as HOST numpy (the elastic-resume path: the caller places
     them onto THIS run's mesh afterwards — `cli/common.place_opt_state`
     — so a sidecar saved at mesh (1,N) re-shards at any (1,M) instead
-    of landing committed to the default device)."""
-    from mobilefinetuner_tpu.io.safetensors_io import SafeTensorsReader
+    of landing committed to the default device). verify=True checks the
+    integrity manifest first (CheckpointIntegrityError on mismatch)."""
+    from mobilefinetuner_tpu.io.safetensors_io import (SafeTensorsReader,
+                                                       verify_file)
+    if verify:
+        verify_file(path)
     reader = SafeTensorsReader(path)
     raw = reader.load_all()
     leaves, treedef = jax.tree_util.tree_flatten_with_path(state_template)

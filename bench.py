@@ -494,9 +494,23 @@ def bench_input_pipeline(dtype, steps, model="gpt2", prefetch=2, B=8,
             "flops": 0}
 
 
+def cap_frac_of(peak_mb):
+    """peak_hbm_mb / per-device HBM capacity — how close to the ceiling
+    this row runs, the number the round-16 admission layer budgets
+    against (core/memory_guard.device_capacity_mb: memory_stats
+    bytes_limit, else the device-kind table). None when either side is
+    unknown (e.g. CPU smoke runs)."""
+    from mobilefinetuner_tpu.core.memory_guard import device_capacity_mb
+    cap, _ = device_capacity_mb()
+    if not cap or not peak_mb:
+        return None
+    return round(peak_mb / cap, 4)
+
+
 def pipe_finish(name, r, dtype, steps) -> dict:
     """Input-pipeline row shape: throughput + host/device breakdown."""
     toks_per_sec = r["tokens"] * steps / r["dt"]
+    peak_mb = round(r["peak_bytes"] / 2 ** 20, 1)
     return {
         "config": name,
         "tokens_per_sec_per_chip": round(toks_per_sec, 1),
@@ -507,7 +521,8 @@ def pipe_finish(name, r, dtype, steps) -> dict:
         "host_wait_frac": round(r["host_wait_ms"] / (r["dt"] * 1000), 4),
         "host_wait_ms_per_step": round(r["host_wait_ms"] / steps, 2),
         "mfu": None,
-        "peak_hbm_mb": round(r["peak_bytes"] / 2 ** 20, 1),
+        "peak_hbm_mb": peak_mb,
+        "cap_frac": cap_frac_of(peak_mb),
         "loss": round(r["loss"], 4),
     }
 
@@ -655,6 +670,7 @@ def bench_generate(B=8, P=128, N=64, dtype=jnp.bfloat16, pipeline=8,
 
 def finish(name, r, dtype, steps) -> dict:
     toks_per_sec = r["tokens"] * steps / r["dt"]
+    peak_mb = round(r["peak_bytes"] / 2 ** 20, 1)
     return {
         "config": name,
         "tokens_per_sec_per_chip": round(toks_per_sec, 1),
@@ -666,7 +682,10 @@ def finish(name, r, dtype, steps) -> dict:
         "mfu_executed": (round(r["flops_exec"] * steps / r["dt"]
                                / peak_flops(dtype), 4)
                          if r.get("flops_exec") else None),
-        "peak_hbm_mb": round(r["peak_bytes"] / 2 ** 20, 1),
+        "peak_hbm_mb": peak_mb,
+        # how close to the per-device HBM ceiling the row ran (the
+        # round-16 admission layer's cap source); None off-accelerator
+        "cap_frac": cap_frac_of(peak_mb),
         # held-out loss after >= LOSS_MARK_TOKENS training tokens on the
         # shared stream — comparable across rows of the same model
         "loss": round(r["loss"], 4),
@@ -742,6 +761,7 @@ def main():
             "vs_baseline": headline["vs_baseline"],
             "mfu": headline["mfu"],
             "peak_hbm_mb": headline["peak_hbm_mb"],
+            "cap_frac": headline.get("cap_frac"),
         }), flush=True)
     if on_tpu:  # the full suite is a TPU artifact; off-TPU is a smoke
         run(f"gpt2s_lora_f32_B{B}_S128", bench_gpt2_lora, f32, steps,
@@ -894,7 +914,7 @@ def main():
             "tokens_per_sec_per_chip": round(r["tokens"] / r["dt"], 1),
             "single_call_latency_ms": r["latency_ms"],
             "vs_baseline": None, "mfu": None, "peak_hbm_mb": None,
-            "loss": None}
+            "cap_frac": None, "loss": None}
         run("gpt2s_generate_e2e_B8_P128_N64",
             lambda dtype, steps: bench_generate(dtype=dtype), bf16, 1,
             finisher=gen_finish)

@@ -296,9 +296,65 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
                         "[:<n>] scrambles n batches' labels (loss "
                         "level-shift); ckpt_corrupt flips a byte in "
                         "the newest lineage checkpoint after its "
-                        "first periodic save. Each fires ONCE per "
+                        "first periodic save; hbm_pressure:<mb> "
+                        "allocates <mb> MB of device ballast before "
+                        "compile (on TPU a real RESOURCE_EXHAUSTED "
+                        "follows; backends that cannot genuinely OOM "
+                        "raise a simulated one at the first dispatch) "
+                        "— drives the --on_oom_risk degradation "
+                        "ladder end to end. Each fires ONCE per "
                         "process (latched), so a post-rollback replay "
                         "of the same steps runs clean")
+    add_mem_flags(p)
+
+
+def add_mem_flags(p: argparse.ArgumentParser):
+    """Memory-admission knobs (core/memory_guard.py, DESIGN.md §21) —
+    shared by the train CLIs (full preflight + degradation ladder) and
+    the eval CLIs (preflight only: eval has no ladder, so 'degrade'
+    behaves like 'warn' there)."""
+    g = p.add_argument_group("memory admission (DESIGN.md §21)")
+    g.add_argument("--hbm_cap_mb", type=int, default=0,
+                   help="per-device memory capacity override in MB for "
+                        "the admission preflight; 0 = auto (the "
+                        "backend's memory_stats bytes_limit, else a "
+                        "device-kind table of public HBM sizes). The "
+                        "override is what lets CPU tests drive the "
+                        "verdict deterministically")
+    g.add_argument("--hbm_headroom", type=float, default=0.1,
+                   help="admission margin: a config is OVER when its "
+                        "estimate exceeds capacity x (1 - headroom) — "
+                        "runtime allocations the compile-time analysis "
+                        "cannot see (collectives scratch, fragmentation) "
+                        "need somewhere to live")
+    g.add_argument("--on_oom_risk", choices=["fail", "degrade", "warn"],
+                   default="degrade",
+                   help="what a failed admission does: 'fail' raises a "
+                        "named MemoryAdmissionError immediately after "
+                        "compile — before data loading, not 40 steps in "
+                        "(the r13 controller reads it as an inadmissible "
+                        "CONFIG, not a restartable crash); 'degrade' "
+                        "(default) walks the bounded ladder — enable "
+                        "--remat, double grad-accum at constant global "
+                        "batch, enable weight offload/streaming — "
+                        "recompiling and re-preflighting at each rung "
+                        "(each decision is a `degrade` telemetry event; "
+                        "loss trajectory stays parity-pinned <=1e-5), "
+                        "raising the named error with the attempted "
+                        "ladder when the last rung still does not fit; "
+                        "'warn' logs and proceeds (the pre-round-16 "
+                        "behavior). A RESOURCE_EXHAUSTED caught at "
+                        "compile or first dispatch takes the same "
+                        "ladder. Verdict 'unknown' (no capacity source) "
+                        "always proceeds — admission never refuses on a "
+                        "guess")
+    g.add_argument("--prefetch_rss_mb", type=int, default=0,
+                   help="host-RSS shed guard for the async input "
+                        "pipeline: while the process RSS exceeds this "
+                        "many MB the producer stops assembling "
+                        "lookahead batches until the queue drains "
+                        "(degrade toward depth-1 instead of the OS "
+                        "OOM-killer picking a victim). 0 = off")
 
 
 def add_align_flags(p: argparse.ArgumentParser):
@@ -740,6 +796,84 @@ def resolve_resume_from(args) -> None:
                     f"alternative)")
 
 
+def offload_rung_state(args, params, mesh):
+    """The degradation ladder's offload-rung POLICY, shared by the two
+    LoRA CLIs so it cannot fork: force host offload at the streams-only
+    budget (whole-fetch leaves stay resident, [L,...] stacks stream per
+    layer) plus remat (streaming requires a remat'd scan body), then
+    re-place the frozen base through the CLI's own setup path. Returns
+    the new (params, fetch_fn, offload_arg) — or None when offload is
+    already on (nothing left to give back). The caller rebinds its
+    closure cells and hands (new_params, loss_fn) to run_training."""
+    if args.shard_enable:
+        return None
+    from mobilefinetuner_tpu.parallel.offload import streams_only_budget
+    args.shard_enable = True
+    args.remat = True
+    args.shard_budget_mb = max(
+        int(streams_only_budget(params)) // 2 ** 20, 1)
+    return setup_frozen_params(args, params, mesh)
+
+
+def preflight_eval_compile(make_compiled, args, tel, what="eval step",
+                           compiled_of=lambda out: out):
+    """Run an eval CLI's AOT compile UNDER the admission contract
+    (DESIGN.md §21): a RESOURCE_EXHAUSTED from the compiler itself is
+    an admission verdict, not an unnamed crash — it lands as
+    mem_check{verdict=over, phase=compile} plus a schema-valid run_end
+    before the named MemoryAdmissionError raises (fleet tooling must
+    read an inadmissible eval config, not a crashed host). On success
+    the result is preflighted as usual. `make_compiled` is the compile
+    thunk; `compiled_of` extracts the compiled executable from its
+    return value (identity by default — eval_mmlu's factory returns a
+    (logits_fn, compiled) pair)."""
+    from mobilefinetuner_tpu.core import memory_guard as mg
+    try:
+        out = make_compiled()
+    except Exception as e:
+        if not mg.is_resource_exhausted(e):
+            raise
+        cap, src = mg.device_capacity_mb(getattr(args, "hbm_cap_mb", 0))
+        check = mg.MemCheck(
+            est_mb=None, cap_mb=cap, verdict="over", phase="compile",
+            headroom=getattr(args, "hbm_headroom", 0.1), cap_source=src)
+        tel.emit("mem_check", **check.event())
+        tel.emit("run_end", steps=0, wall_s=0.0,
+                 exit="MemoryAdmissionError", goodput=None)
+        tel.close()
+        raise mg.MemoryAdmissionError(
+            f"{what} failed memory admission at compile: {e}",
+            check=check) from e
+    preflight_compiled_eval(compiled_of(out), args, tel, what=what)
+    return out
+
+
+def preflight_compiled_eval(compiled, args, tel, what="eval step"):
+    """Admission preflight for an eval CLI's compiled forward
+    (DESIGN.md §21): the same mem_check the train path emits, minus
+    the degradation ladder (eval has no remat/accum/offload levers, so
+    --on_oom_risk degrade behaves like warn here). Under 'fail' an
+    over verdict terminates the stream with a schema-valid run_end and
+    raises the named MemoryAdmissionError — before the eval data loop
+    starts."""
+    from mobilefinetuner_tpu.core import memory_guard as mg
+    check = mg.preflight(compiled, cap_mb=getattr(args, "hbm_cap_mb", 0),
+                         headroom=getattr(args, "hbm_headroom", 0.1))
+    tel.emit("mem_check", **check.event())
+    if check.verdict != "over":
+        return check
+    if getattr(args, "on_oom_risk", "warn") == "fail":
+        tel.emit("run_end", steps=0, wall_s=0.0,
+                 exit="MemoryAdmissionError", goodput=None)
+        tel.close()
+        raise mg.MemoryAdmissionError(
+            f"{what} failed memory admission ({check.describe()})",
+            check=check)
+    log.warning(f"memory admission ({what}): {check.describe()} "
+                f"(proceeding)")
+    return check
+
+
 def record_ckpt_files(args, final_path: str, step: int, files) -> None:
     """Write-hook tail shared by the train CLIs: record a completed
     save into `<final_path>.lineage.json` and GC past --keep_ckpts
@@ -778,18 +912,24 @@ def make_rollback_loader(tc: TrainConfig, mask, load_trainable):
 
 def parse_train_inject(spec: str):
     """--inject grammar -> (kind, step, n) | ('ckpt_corrupt', None, 1)
-    | None. Shared validation so a typo dies at startup, not at the
-    injection step."""
+    | ('hbm_pressure', None, <mb>) | None. Shared validation so a typo
+    dies at startup, not at the injection step."""
     if not spec:
         return None
     parts = spec.split(":")
     kind = parts[0]
     if kind == "ckpt_corrupt":
         return ("ckpt_corrupt", None, 1)
+    if kind == "hbm_pressure":
+        if len(parts) < 2:
+            raise SystemExit(f"--inject hbm_pressure needs a ballast "
+                             f"size in MB: {spec!r}")
+        return ("hbm_pressure", None, max(int(parts[1]), 1))
     if kind not in ("grad_nan", "loss_spike"):
         raise SystemExit(
             f"--inject must be grad_nan:<step>[:<n>] | "
-            f"loss_spike:<step>[:<n>] | ckpt_corrupt, got {spec!r}")
+            f"loss_spike:<step>[:<n>] | ckpt_corrupt | "
+            f"hbm_pressure:<mb>, got {spec!r}")
     if len(parts) < 2:
         raise SystemExit(f"--inject {kind} needs a step: {spec!r}")
     step = int(parts[1])
@@ -812,10 +952,45 @@ class FaultInjector:
         parsed = parse_train_inject(spec)
         self.kind, self.at, self.n = parsed if parsed else (None, None, 0)
         self.fired = 0
+        self.ballast = None  # hbm_pressure: the held device allocation
 
     @property
     def active(self) -> bool:
         return self.kind is not None
+
+    def arm_ballast(self) -> None:
+        """hbm_pressure:<mb>: allocate and HOLD <mb> MB on the default
+        device BEFORE the step compiles. On TPU that shrinks real free
+        HBM, so compile/first-dispatch hits a genuine
+        RESOURCE_EXHAUSTED when the config was near the ceiling; the
+        allocation also lands in memory_stats bytes_in_use, so the
+        preflight's live-bytes term sees it on any backend that
+        reports stats."""
+        if self.kind != "hbm_pressure" or self.ballast is not None:
+            return
+        mb = self.n
+        self.ballast = jax.device_put(
+            np.zeros(mb * 2 ** 20 // 4, np.float32))
+        self.ballast.block_until_ready()
+        log.warning(f"--inject hbm_pressure: holding {mb} MB of device "
+                    f"ballast")
+
+    def maybe_oom_dispatch(self, step: int) -> None:
+        """The dispatch half of hbm_pressure on backends that cannot
+        genuinely exhaust device memory (CPU grows the host heap
+        instead): raise ONE simulated RESOURCE_EXHAUSTED at the first
+        dispatch, so the ladder's caught-at-dispatch recovery path is
+        exercised end to end. On real accelerators (TPU/GPU) this is a
+        no-op — the held ballast produces the real thing."""
+        if self.kind != "hbm_pressure" or self.fired \
+                or jax.default_backend() in ("tpu", "gpu", "cuda",
+                                             "rocm"):
+            return  # real accelerators: the held ballast OOMs for real
+        self.fired = 1
+        raise RuntimeError(
+            f"RESOURCE_EXHAUSTED: simulated OOM at dispatch of step "
+            f"{step} (--inject hbm_pressure:{self.n} on a backend "
+            f"that cannot genuinely exhaust device memory)")
 
     def maybe_poison(self, step: int, batch: dict) -> dict:
         if self.kind == "grad_nan":
@@ -891,7 +1066,8 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                  dropout_rng=None, step_builder=None,
                  flops_per_step: Optional[float] = None,
                  load_hook: Optional[Callable] = None,
-                 ckpt_path: str = ""):
+                 ckpt_path: str = "",
+                 degrade_builders: Optional[dict] = None):
     """The shared optimizer-step loop: compiled step + eval cadence + EMA +
     metrics CSV + JSONL eval records + governor throttle + periodic saves
     + the run-telemetry event stream (--telemetry_out, core/telemetry.py).
@@ -917,6 +1093,20 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     newest VERIFIED lineage checkpoint at this run's mesh, rebuilds the
     data stream (byte-pinned skip_steps + --rollback_data_offset), and
     keeps training with the SAME compiled step (DESIGN.md §20).
+    degrade_builders: the CLI's hooks for the memory-admission
+    degradation ladder (DESIGN.md §21). The step is AOT-compiled BEFORE
+    the data stream exists (a zero probe batch with the stream's exact
+    shapes/placement) and preflighted against device capacity
+    (core/memory_guard.py); under --on_oom_risk=degrade a failed
+    admission — or a RESOURCE_EXHAUSTED caught at compile/first
+    dispatch — walks remat -> accum_x2 -> offload, recompiling and
+    re-preflighting at each rung. The remat rung flips args.remat
+    (every CLI's loss closure reads it at trace time); accum_x2 doubles
+    tc.grad_accum_steps for the STEP only (the stream keeps assembling
+    the original global batch, so batch shapes/shardings never change);
+    the "offload" key of degrade_builders, when provided, is
+    `() -> (new_frozen, loss_fn) | None` — it re-places the frozen base
+    with host offload enabled (None: not applicable / already on).
     Returns (trainable, opt_state, last_metrics).
     """
     from mobilefinetuner_tpu.parallel.distributed import (allgather_scalars,
@@ -1146,11 +1336,41 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                     repl if replicate_trainable
                     else params_shardings(opt_state, mesh),
                     repl)  # prefix: every metrics leaf replicates
-        if step_builder is not None:
-            step_fn = step_builder(loss_fn, tc, mask=mask, donate=True)
-        else:
-            step_fn = make_train_step(loss_fn, tc, mask=mask, donate=True,
-                                      out_shardings=out_shardings)
+        # the degradation ladder (DESIGN.md §21) may REBUILD the step:
+        # loss_fn is re-traced (the CLIs' loss closures read args.remat
+        # and their offload cell at trace time) and tc_step carries the
+        # accum_x2 rung's doubled micro-batch count. The STREAM keeps
+        # the original tc.grad_accum_steps — the step batch is the
+        # constant global batch either way, so batch shapes and
+        # shardings never change across rungs and neither do the
+        # donation/output-sharding pins above.
+        tc_step = tc
+
+        def build_step():
+            if step_builder is not None:
+                return step_builder(loss_fn, tc_step, mask=mask,
+                                    donate=True)
+            return make_train_step(loss_fn, tc_step, mask=mask,
+                                   donate=True,
+                                   out_shardings=out_shardings)
+
+        step_fn = build_step()
+
+        def place_state(tr_h, opt_h):
+            """Host trees -> this run's mesh placement (the r13
+            elastic-resume rule: replicate LoRA-style trainables, FSDP
+            re-shard otherwise) — ONE helper shared by the rollback
+            reload and the dispatch-OOM retry so the placement rule
+            cannot fork."""
+            if mesh is not None and replicate_trainable:
+                repl = replicated_sharding(mesh)
+                put = lambda x: device_put_global(jnp.asarray(x), repl)
+                return jax.tree.map(put, tr_h), jax.tree.map(put, opt_h)
+            if mesh is not None:
+                from mobilefinetuner_tpu.parallel.mesh import shard_params
+                return shard_params(tr_h, mesh), shard_params(opt_h, mesh)
+            return (jax.tree.map(jnp.asarray, tr_h),
+                    jax.tree.map(jnp.asarray, opt_h))
 
         ema = EMA(args.ema_beta)
         # async input pipeline: micro-batch assembly (tokenization, streaming
@@ -1196,7 +1416,235 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
             return Prefetcher(
                 itertools.islice(numbered(),
                                  max(total_steps - from_step, 0)),
-                depth=prefetch_depth, place_fn=place_step, lookahead=1)
+                depth=prefetch_depth, place_fn=place_step, lookahead=1,
+                rss_limit_mb=getattr(args, "prefetch_rss_mb", 0))
+
+        # ---- memory admission + degradation ladder (DESIGN.md §21) ------
+        # The step is AOT-compiled HERE, from a zero probe batch with the
+        # stream's exact shapes and placement — before the data stream
+        # (and its producer thread) exists — so an inadmissible config
+        # dies with a named error in seconds, and the `compile` event's
+        # peak-HBM estimate is the SAME number the preflight judges.
+        from mobilefinetuner_tpu.core import memory_guard as mg
+        oom_mode = getattr(args, "on_oom_risk", "warn")
+        adm_cap_mb = getattr(args, "hbm_cap_mb", 0)
+        adm_headroom = getattr(args, "hbm_headroom", 0.1)
+        compiled_step = None
+        peak_hbm = {"mb": 0.0}     # from the compiled step's memory analysis
+        rungs_applied: list = []
+        oom_snap = None            # host insurance for the dispatch retry
+        compile_err = {"e": None}  # original compile-time OOM (warn mode)
+
+        def probe_batch():
+            """The AOT compile's stand-in: zero arrays with exactly the
+            step-batch rows the ORIGINAL accum assembles, run through
+            the same place_step as real batches (injector grad_scale
+            key, dropout keys, mesh placement) — the compiled
+            executable serves the stream's batches unchanged."""
+            b = train_ds.config.batch_size
+            S = train_ds.config.seq_len
+            rows = b * tc.grad_accum_steps
+            zero = {"input_ids": np.zeros((rows, S), np.int32),
+                    "attention_mask": np.zeros((rows, S), np.float32),
+                    "labels": np.zeros((rows, S), np.int32)}
+            # the probe must not CONSUME an injector fire (an --inject
+            # grad_nan at the start step would otherwise spend one of
+            # its n poisons on a batch that never trains): run the real
+            # place_step for structural fidelity, then restore the latch
+            fired = injector.fired
+            placed = place_step((start_step, 0, zero))[2]
+            injector.fired = fired
+            return placed
+
+        def over_check(phase: str) -> "mg.MemCheck":
+            """A forced-over verdict for a REAL RESOURCE_EXHAUSTED (the
+            estimate side is moot: the device already said no)."""
+            cap, src = mg.device_capacity_mb(adm_cap_mb)
+            return mg.MemCheck(est_mb=None, cap_mb=cap, verdict="over",
+                               phase=phase, headroom=adm_headroom,
+                               cap_source=src)
+
+        def compile_and_check(at_step: int = start_step) -> "mg.MemCheck":
+            """AOT-compile the current step and preflight it: one
+            `compile` + one `mem_check` event per attempt (the ladder
+            re-enters here after every rung). A RESOURCE_EXHAUSTED from
+            the compiler itself IS an admission verdict, not a crash —
+            it leaves compiled_step as None with the original error in
+            compile_err (the warn-mode driver re-raises it: warn means
+            'proceed anyway', and with no executable there is nothing
+            to proceed WITH). The probe batch is built fresh per
+            attempt and dropped with the frame: a full step batch of
+            zeros must not sit in device memory for the whole run
+            inside the very feature that budgets memory."""
+            nonlocal compiled_step
+            probe = probe_batch()
+            meter.enter("compile")
+            t_comp = time.perf_counter()
+            try:
+                with pause():
+                    compiled_step = step_fn.lower(
+                        trainable, frozen, opt_state, probe,
+                        jnp.int32(start_step)).compile()
+            except Exception as e:
+                meter.enter("init")
+                if not mg.is_resource_exhausted(e):
+                    raise
+                log.warning(f"RESOURCE_EXHAUSTED at compile: {e}")
+                compiled_step = None
+                compile_err["e"] = e
+                c = over_check("compile")
+                tel.emit("mem_check", **c.event())
+                return c
+            meter.enter("init")
+            peak_hbm["mb"] = compiled_peak_mb(compiled_step)
+            xla_flops = compiled_flops(compiled_step)
+            # at_step: a mid-run ladder recompile (dispatch OOM) logs
+            # at the step that forced it, aligned with its degrade/
+            # mem_check neighbors — not back at start_step
+            tel.emit("compile", step=at_step,
+                     wall_s=round(time.perf_counter() - t_comp, 3),
+                     flops=xla_flops or None,
+                     peak_hbm_mb=peak_hbm["mb"] or None)
+            c = mg.preflight(compiled_step, cap_mb=adm_cap_mb,
+                             headroom=adm_headroom)
+            tel.emit("mem_check", **c.event())
+            if peak_hbm["mb"]:
+                log.info(f"compiled step peak HBM: {peak_hbm['mb']:.0f} "
+                         f"MB ({c.describe()})")
+            return c
+
+        def apply_rung(est_mb, at_step=None) -> bool:
+            """Walk ONE rung of the bounded ladder (memory_guard.LADDER
+            order: remat -> accum_x2 -> offload): mutate the config,
+            emit a `degrade` event, and let the caller recompile.
+            Returns False when no applicable rung remains."""
+            nonlocal loss_fn, tc_step, frozen
+            for rung in mg.LADDER:
+                if rung in rungs_applied:
+                    continue
+                if rung == "remat":
+                    if getattr(args, "remat", True):
+                        continue  # already on: nothing left to give
+                    # every CLI's loss closure reads args.remat at
+                    # trace time — the flip lands at the recompile
+                    args.remat = True
+                    frm, to = "remat=off", "remat=on"
+                elif rung == "accum_x2":
+                    rows = (train_ds.config.batch_size
+                            * tc.grad_accum_steps)
+                    new_accum = tc_step.grad_accum_steps * 2
+                    if new_accum > rows or rows % new_accum:
+                        continue  # micro-batch cannot split further
+                    import dataclasses as _dc
+                    tc_step = _dc.replace(tc_step,
+                                          grad_accum_steps=new_accum)
+                    frm = f"accum={new_accum // 2}"
+                    to = f"accum={new_accum}"
+                else:  # offload
+                    builder = (degrade_builders or {}).get("offload")
+                    out = builder() if builder is not None else None
+                    if out is None:
+                        continue  # no offload path / already enabled
+                    frozen, loss_fn = out
+                    frm, to = "offload=off", "offload=on"
+                rungs_applied.append(rung)
+                tel.emit("degrade", step=at_step,
+                         **{"rung": rung, "from": frm, "to": to,
+                            "est_mb": (round(est_mb, 2) if est_mb
+                                       else None)})
+                log.warning(
+                    f"DEGRADE rung {len(rungs_applied)} ({rung}: {frm} "
+                    f"-> {to})"
+                    + (f" — estimate {est_mb:.0f} MB over capacity"
+                       if est_mb else "")
+                    + "; recompiling")
+                return True
+            return False
+
+        def recover_dispatch_oom(e: BaseException, step: int) -> None:
+            """A RESOURCE_EXHAUSTED escaped the compiled step's
+            dispatch: under --on_oom_risk=degrade walk the remaining
+            ladder (recompile + re-preflight per rung), restore the
+            donated trees from the host insurance snapshot, and let the
+            loop retry the SAME batch — no process restart, no
+            checkpoint touched, no rollback triggered. Re-raises when
+            recovery is impossible (mode, no rungs left, or donated
+            state unrecoverable on a real accelerator)."""
+            nonlocal step_fn, trainable, opt_state, t_interval
+            can_retry = (oom_snap is not None
+                         or jax.default_backend() == "cpu")
+            if oom_mode != "degrade" or not can_retry:
+                raise e
+            tel.emit("mem_check", **over_check("dispatch").event())
+            log.warning(f"RESOURCE_EXHAUSTED at dispatch of step "
+                        f"{step}: walking the degradation ladder")
+            # settle the buffered steps first, then keep the recovery
+            # wall OUT of the next flush's per-step average (the rule
+            # the first-step compile block enforced and eval/save/
+            # rollback all follow): an inflated sample here would feed
+            # the watchdog deadline and the straggler window
+            flush_metrics(emit_log=False)
+            while True:
+                if not apply_rung(peak_hbm["mb"] or None, at_step=step):
+                    raise mg.MemoryAdmissionError(
+                        f"RESOURCE_EXHAUSTED at dispatch of step {step} "
+                        f"and the degradation ladder "
+                        f"{tuple(rungs_applied)} is exhausted",
+                        ladder=rungs_applied) from e
+                step_fn = build_step()
+                c = compile_and_check(at_step=step)
+                if c.verdict != "over":
+                    break
+            if oom_snap is not None:
+                trainable, opt_state = place_state(*oom_snap)
+            t_interval = time.perf_counter()  # recompile ≠ step time
+
+        if start_step < total_steps:
+            injector.arm_ballast()
+            check = compile_and_check()
+            while check.verdict == "over" and oom_mode != "warn":
+                if oom_mode == "fail":
+                    raise mg.MemoryAdmissionError(
+                        f"memory admission failed ({check.describe()}); "
+                        f"rerun with a smaller config, --on_oom_risk "
+                        f"degrade, or a larger device", check=check)
+                if not apply_rung(check.est_mb):
+                    raise mg.MemoryAdmissionError(
+                        f"memory admission failed after exhausting the "
+                        f"degradation ladder {tuple(rungs_applied)} "
+                        f"({check.describe()})", check=check,
+                        ladder=rungs_applied)
+                step_fn = build_step()
+                check = compile_and_check()
+            if compiled_step is None:
+                # warn mode with a compile-time RESOURCE_EXHAUSTED:
+                # 'proceed anyway' has nothing to proceed with — the
+                # honest outcome is the ORIGINAL compiler error, as
+                # before round 16 (not a NoneType crash 30 lines later)
+                raise compile_err["e"]
+            if check.verdict == "over":
+                log.warning(f"memory admission: {check.describe()} "
+                            f"(--on_oom_risk warn: proceeding)")
+            elif rungs_applied:
+                log.warning(f"admitted after degradation ladder "
+                            f"{tuple(rungs_applied)}: {check.describe()}")
+            # dispatch-retry insurance: ONLY under armed pressure
+            # injection keep a HOST copy of the donated trees until
+            # the first step retires — a failed dispatch consumes
+            # donated buffers on real accelerators, and the
+            # retry-at-next-rung contract needs intact state to
+            # re-place. In degrade mode this point is only reached
+            # with verdict ok/unknown (an over verdict walked a rung
+            # or raised), and neither justifies a whole-model
+            # device_get per run: 'unknown' is EVERY run on platforms
+            # without memory analysis. CPU ignores donation (retries
+            # in place, no copy); multi-host skips it (device_get
+            # cannot fetch cross-process shards — a pod-scale OOM is
+            # the controller's problem, not an in-process retry).
+            if (oom_mode == "degrade" and not multiproc
+                    and jax.default_backend() != "cpu"
+                    and injector.kind == "hbm_pressure"):
+                oom_snap = jax.device_get((trainable, opt_state))
 
         stream = make_stream(start_step, start_step)
         # in-process rollback state (armed only when the CLI wired the
@@ -1212,8 +1660,6 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                       getattr(args, "rollback_data_offset", 1), 0)}
         metrics = {}
         epoch = 0
-        compiled_step = None       # AOT-compiled at the first step
-        peak_hbm = {"mb": 0.0}     # from the compiled step's memory analysis
         profile_dir = getattr(args, "profile_dir", "")
         prof_start = start_step + getattr(args, "profile_start", 10)
         prof_end = prof_start + getattr(args, "profile_steps", 5)
@@ -1284,7 +1730,13 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
             step_clock.record(dt_ms / 1000.0)
             if wd is not None:
                 wd.pet(buffered[-1][0], dt_ms / 1000.0)
-            hbm = live_hbm_mb() or peak_hbm["mb"]
+            # live bytes when the backend reports them, else the
+            # compiled-peak estimate, else NULL — a backend with no
+            # memory accounting must not masquerade as 0 MB (round 16;
+            # live_hbm_mb logs the backend once)
+            hbm = live_hbm_mb()
+            if hbm is None:
+                hbm = peak_hbm["mb"] or None
             mfu = mfu_from(flops_per_step, dt_ms / 1000, peak_flops)
             for (s, ep, toks, _), m in zip(buffered, fetched):
                 loss = float(m["loss"])
@@ -1331,7 +1783,7 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                                     grad_norm=float(m["grad_norm"]),
                                     step_time_ms=dt_ms, host_wait_ms=wait_ms,
                                     tok_s=toks / (dt_ms / 1000), mfu=mfu,
-                                    hbm_mb=hbm)
+                                    hbm_mb=hbm if hbm is not None else 0.0)
             s, ep, toks, _ = buffered[-1]
             m = fetched[-1]
             opt_f = lambda k: (float(m[k]) if k in m else None)
@@ -1419,20 +1871,7 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                     rb["suppressed"] = True
                     return None
                 tr_h, opt_h = load_hook(resolved)
-                if mesh is not None and replicate_trainable:
-                    repl = replicated_sharding(mesh)
-                    put = lambda x: device_put_global(jnp.asarray(x),
-                                                      repl)
-                    trainable = jax.tree.map(put, tr_h)
-                    opt_state = jax.tree.map(put, opt_h)
-                elif mesh is not None:
-                    from mobilefinetuner_tpu.parallel.mesh import \
-                        shard_params
-                    trainable = shard_params(tr_h, mesh)
-                    opt_state = shard_params(opt_h, mesh)
-                else:
-                    trainable = jax.tree.map(jnp.asarray, tr_h)
-                    opt_state = jax.tree.map(jnp.asarray, opt_h)
+                trainable, opt_state = place_state(tr_h, opt_h)
             rb["count"] += 1
             rb["budget"] -= 1
             rb["streak"] = 0
@@ -1482,40 +1921,28 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 waited_ms += (time.perf_counter() - t_wait) * 1000
                 meter.enter("step")
                 assert step_i == step  # strict order preservation
-                if compiled_step is None:
-                    # AOT compile once: the SAME executable serves every step
-                    # (shapes are static), and its memory analysis gives peak
-                    # HBM for free — no second trace/compile on the jit cache
-                    # path.
-                    meter.enter("compile")
-                    t_comp = time.perf_counter()
-                    # pause the watchdog: a pod-scale compile can exceed
-                    # any grace window, and the loop KNOWS it is compiling
-                    with pause():
-                        compiled_step = step_fn.lower(
-                            trainable, frozen, opt_state, batch,
-                            jnp.int32(step)).compile()
-                    meter.enter("step")
-                    peak_hbm["mb"] = compiled_peak_mb(compiled_step)
-                    xla_flops = compiled_flops(compiled_step)
-                    tel.emit("compile", step=step,
-                             wall_s=round(time.perf_counter() - t_comp, 3),
-                             flops=xla_flops or None,
-                             peak_hbm_mb=peak_hbm["mb"] or None)
-                    if peak_hbm["mb"]:
-                        log.info(f"compiled step peak HBM: "
-                                 f"{peak_hbm['mb']:.0f} MB")
-                    # compile ≠ step time: restart the interval AND its
-                    # accumulators — the pre-compile first-batch wait
-                    # belongs to the init/input_wait goodput buckets,
-                    # not to the first flush's host_wait_ms (it could
-                    # exceed the post-compile dt and report >100%)
-                    t_interval = time.perf_counter()
-                    waited_ms = 0.0
-                    slept_ms = 0.0
                 maybe_profile(step)
-                trainable, opt_state, metrics = compiled_step(
-                    trainable, frozen, opt_state, batch, jnp.int32(step))
+                # the step was AOT-compiled (and admission-checked)
+                # BEFORE the stream existed; a RESOURCE_EXHAUSTED that
+                # still escapes the dispatch walks the remaining
+                # degradation ladder and retries the SAME batch (the
+                # batch is not donated — only trainable/opt are, and
+                # recover_dispatch_oom restores those)
+                while True:
+                    try:
+                        if injector.kind == "hbm_pressure":
+                            injector.maybe_oom_dispatch(step)
+                        trainable, opt_state, metrics = compiled_step(
+                            trainable, frozen, opt_state, batch,
+                            jnp.int32(step))
+                        break
+                    except (KeyboardInterrupt, SystemExit):
+                        raise
+                    except Exception as e:
+                        if not mg.is_resource_exhausted(e):
+                            raise
+                        recover_dispatch_oom(e, step)
+                oom_snap = None  # a retired step ends the retry window
                 toks = batch["input_ids"].shape[0] * batch["input_ids"].shape[1]
                 buffered.append((step, epoch, toks, metrics))
                 log_boundary = bool(args.log_interval) \

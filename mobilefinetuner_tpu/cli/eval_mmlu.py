@@ -66,6 +66,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="items per forward (bucketed batching; the "
                         "reference runs per-item — on the MXU that "
                         "leaves the batch dimension idle)")
+    from mobilefinetuner_tpu.cli.common import add_mem_flags
+    add_mem_flags(p)
     return p
 
 
@@ -105,10 +107,16 @@ def setup_family(args):
 
 
 def make_batched_logits_fn(hidden_fn, head_key, compute_dtype, params,
-                           lora):
+                           lora, worst_shape=None):
     """Batched bucketed last-REAL-token logits: (ids [B,S], last [B]) ->
     [B, V]. Only the selected positions go through the lm_head (a full
-    [B, S, V] would cost ~1 MB/token on Gemma's 262k vocab)."""
+    [B, S, V] would cost ~1 MB/token on Gemma's 262k vocab).
+
+    `worst_shape` (B, S): additionally AOT-compile that bucket and
+    return the compiled executable (the round-16 admission preflight's
+    subject). Calls matching it dispatch through the SAME executable —
+    an AOT compile does not seed the jit cache, and without the routing
+    the eval's most expensive bucket would compile twice."""
 
     @jax.jit
     def fwd(params, lora, ids, last_idx):
@@ -124,11 +132,21 @@ def make_batched_logits_fn(hidden_fn, head_key, compute_dtype, params,
             logits = maybe_lora(logits, rows, lora["blocks"]["lm_head"])
         return logits
 
+    compiled_worst = None
+    if worst_shape is not None:
+        compiled_worst = fwd.lower(
+            params, lora,
+            jax.ShapeDtypeStruct(worst_shape, jnp.int32),
+            jax.ShapeDtypeStruct((worst_shape[0],), jnp.int32)).compile()
+
     def logits_fn(ids: np.ndarray, last: np.ndarray) -> np.ndarray:
+        if compiled_worst is not None and ids.shape == worst_shape:
+            return np.asarray(compiled_worst(
+                params, lora, jnp.asarray(ids), jnp.asarray(last)))
         return np.asarray(fwd(params, lora, jnp.asarray(ids),
                               jnp.asarray(last)))
 
-    return logits_fn
+    return logits_fn, compiled_worst
 
 
 def main(argv=None) -> int:
@@ -148,8 +166,30 @@ def main(argv=None) -> int:
     log.info(f"MMLU {args.split}: {len(by_subject)} subjects, "
              f"{n_items} items, fewshot={args.fewshot}")
 
-    logits_fn = make_batched_logits_fn(hidden_fn, head_key,
-                                       compute_dtype, params, lora)
+    # memory-admission preflight (DESIGN.md §21) on the REAL worst-case
+    # bucket: the work list is materialized (prompts encoded once, the
+    # same list the runner consumes) and the largest bucket it actually
+    # lands in — not the theoretical max_len cap — is what gets
+    # compiled and checked. The runner always pads batches to
+    # eval_batch rows, so the preflight's compiled executable SERVES
+    # every batch of that bucket (logits_fn routes matching shapes
+    # through it): the check costs no extra compile. Same flags and
+    # mem_check event as the train path; no ladder (eval has no
+    # levers), so --on_oom_risk fail raises before any item is scored
+    # and degrade/warn proceed with a warning.
+    from mobilefinetuner_tpu.cli.common import preflight_eval_compile
+    work, _totals = mmlu.materialize_work(
+        by_subject, tok.encode, fewshot_k=args.fewshot,
+        max_items_per_subject=args.max_items, max_len=max_len)
+    worst_S = max((mmlu.bucket_for(len(w[4]), max_len=max_len)
+                   for w in work), default=max_len)
+    B = max(args.eval_batch, 1)
+    logits_fn, compiled_worst = preflight_eval_compile(
+        lambda: make_batched_logits_fn(
+            hidden_fn, head_key, compute_dtype, params, lora,
+            worst_shape=(B, worst_S)),
+        args, tel, what=f"eval_mmlu worst-case bucket [{B}, {worst_S}]",
+        compiled_of=lambda out: out[1])
     done = [0]
 
     def progress(subject, i, n):
@@ -161,7 +201,7 @@ def main(argv=None) -> int:
         by_subject, logits_fn, tok.encode, fewshot_k=args.fewshot,
         progress_fn=progress, max_items_per_subject=args.max_items,
         letter_encode_fn=letter_encode,
-        batch_size=max(args.eval_batch, 1), max_len=max_len)
+        batch_size=B, max_len=max_len, work=work)
 
     from mobilefinetuner_tpu.eval.mmlu_categories import category_rollup
     categories = category_rollup(result)

@@ -73,6 +73,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--telemetry_out", default="",
                    help="JSONL run-telemetry stream (core/telemetry.py): "
                         "run_start manifest + eval progress + run_end")
+    from mobilefinetuner_tpu.cli.common import add_mem_flags
+    add_mem_flags(p)
     return p
 
 
@@ -139,6 +141,28 @@ def main(argv=None) -> int:
     # (coordinator at the given path; merge with tools/fleet_report.py)
     tel = Telemetry.for_process(args.telemetry_out)
     tel.emit("run_start", **run_manifest(vars(args)))
+    # memory-admission preflight (DESIGN.md §21): AOT-compile the
+    # dominant full-shape batch and check it against device capacity
+    # BEFORE the data loop — the same mem_check the train path emits,
+    # minus the degradation ladder (--on_oom_risk fail raises the
+    # named MemoryAdmissionError here; degrade/warn proceed with a
+    # warning). The compiled executable then serves every full-shape
+    # batch below, so the preflight compile IS the run's compile — the
+    # short epoch tail (drop_last=False) falls back to the jit cache.
+    from mobilefinetuner_tpu.cli.common import preflight_eval_compile
+    full_shape = (args.batch_size, args.seq_len)
+    spec = {"input_ids": jax.ShapeDtypeStruct(full_shape, jnp.int32),
+            "attention_mask": jax.ShapeDtypeStruct(full_shape,
+                                                   jnp.float32),
+            "labels": jax.ShapeDtypeStruct(full_shape, jnp.int32)}
+    compiled_step = preflight_eval_compile(
+        lambda: step.lower(params, lora, spec).compile(), args, tel,
+        what="eval_ppl compiled step")
+
+    def run_step(batch):
+        if batch["input_ids"].shape == full_shape:
+            return compiled_step(params, lora, batch)
+        return step(params, lora, batch)
     # device-side accumulation: per-batch float(s)/int(c) forced a full
     # device sync per eval step — the sums stay on device (tiny adds on
     # the async dispatch queue) and come to host only at progress-log
@@ -152,9 +176,10 @@ def main(argv=None) -> int:
     if args.max_batches:
         source = itertools.islice(source, args.max_batches)
     with Prefetcher(source, depth=args.prefetch,
-                    place_fn=jax.device_put) as batches:
+                    place_fn=jax.device_put,
+                    rss_limit_mb=args.prefetch_rss_mb) as batches:
         for n, batch in enumerate(batches):
-            s, c = step(params, lora, batch)
+            s, c = run_step(batch)
             total = s if total is None else total + s
             count = c if count is None else count + c
             n_done = n + 1

@@ -282,7 +282,13 @@ def main(argv=None) -> int:
                    else common.make_rollback_loader(
                        tc, None,
                        lambda p: _load_full_gemma(p, config))),
-        ckpt_path="" if args.opt_offload else args.output_path)
+        ckpt_path="" if args.opt_offload else args.output_path,
+        # memory-admission ladder (DESIGN.md §21): remat + accum_x2
+        # rungs only (loss_fn reads args.remat at trace time; the
+        # accum rung re-invokes step_builder with the doubled count —
+        # the opt-offload builder takes the same (loss_fn, tc) surface
+        # as make_train_step). No frozen base, so no offload rung.
+        degrade_builders=None)
     return 0
 
 

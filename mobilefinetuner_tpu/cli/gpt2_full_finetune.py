@@ -180,7 +180,13 @@ def main(argv=None) -> int:
         replicate_trainable=False, dropout_rng=base_rng,
         flops_per_step=flops,
         load_hook=common.make_rollback_loader(tc, None, load_trainable),
-        ckpt_path=args.output_path)
+        ckpt_path=args.output_path,
+        # memory-admission ladder (DESIGN.md §21): full FT gets the
+        # remat and accum_x2 rungs (loss_fn reads args.remat at trace
+        # time; accum doubles inside run_training at constant global
+        # batch). No offload rung — the TRAINABLE tree is the HBM cost
+        # here and offload targets frozen bases only.
+        degrade_builders=None)
     return 0
 
 

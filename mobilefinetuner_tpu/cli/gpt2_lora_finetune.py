@@ -167,6 +167,10 @@ def main(argv=None) -> int:
         args, {t: GPT2_TARGETS[t](config) for t in spec.targets or []},
         spec.rank, compute_dtype)
 
+    # loss/nll read args.remat and the offload cells AT TRACE TIME: the
+    # memory-admission degradation ladder (common.run_training,
+    # DESIGN.md §21) re-traces them after flipping remat or enabling
+    # offload, so the rungs need no separate loss builders
     def loss_fn(lora_t, frozen, mb):
         # per-(step, micro-batch) dropout key, threaded via the batch
         rng = mb["dropout_rng"][0] if "dropout_rng" in mb else None
@@ -188,6 +192,18 @@ def main(argv=None) -> int:
                               offload=offload_arg, cp_mesh=cp_mesh,
                               lora_impl=args.lora_impl)
         return lm_cross_entropy_sum(logits, mb["labels"])
+
+    def offload_rung():
+        """The ladder's last rung (policy shared with the Gemma LoRA
+        CLI via common.offload_rung_state): re-place the frozen base
+        with host offload at the streams-only budget. The loss/nll
+        closures read the rebound cells at the ladder's recompile."""
+        nonlocal params, fetch_fn, offload_arg
+        out = common.offload_rung_state(args, params, mesh)
+        if out is None:
+            return None
+        params, fetch_fn, offload_arg = out
+        return params, loss_fn
 
     if args.align_dump_dir:
         from mobilefinetuner_tpu.align.dump import run_align_dump
@@ -260,7 +276,12 @@ def main(argv=None) -> int:
         # (--rollback_budget) against the lineage at --lora_out
         load_hook=common.make_rollback_loader(
             tc, mask, lambda p: peft_io.load_adapter(p)[0]),
-        ckpt_path=args.lora_out)
+        ckpt_path=args.lora_out,
+        # memory-admission degradation ladder (DESIGN.md §21): remat
+        # and accum_x2 need no hooks (run_training flips args.remat /
+        # tc.grad_accum_steps and re-traces); offload re-places the
+        # frozen base through this CLI's own setup path
+        degrade_builders={"offload": offload_rung})
     return 0
 
 

@@ -154,11 +154,26 @@ def main(argv=None) -> int:
     def resolve(frozen):
         """Fetch offloaded top-level leaves (incl. the embed table, reused
         by the tied-lm-head chunked CE) once; block weights stream per
-        layer via the returned stream fn."""
+        layer via the returned stream fn. Reads the offload cells at
+        TRACE time, so the degradation ladder's offload rung takes
+        effect at its recompile (DESIGN.md §21)."""
         from mobilefinetuner_tpu.parallel.offload import resolve_offload
         if offload_arg is None:
             return fetch_fn(frozen), None
         return resolve_offload(frozen, offload_arg)
+
+    def offload_rung():
+        """Memory-admission ladder, last rung (policy shared with the
+        GPT-2 LoRA CLI via common.offload_rung_state): re-place the
+        frozen base with host offload at the streams-only budget — the
+        262k embed stays resident, block stacks stream per layer
+        inside the remat'd scan. None when offload is already on."""
+        nonlocal params, fetch_fn, offload_arg
+        out = common.offload_rung_state(args, params, mesh)
+        if out is None:
+            return None
+        params, fetch_fn, offload_arg = out
+        return params, loss_fn
 
     # vocab-parallel CE on multi-device meshes: the fsdp-sharded 262k
     # embed must not be all-gathered per step (ops/loss.py). In
@@ -273,7 +288,9 @@ def main(argv=None) -> int:
         flops_per_step=flops,
         load_hook=common.make_rollback_loader(
             tc, mask, lambda p: peft_io.load_adapter(p)[0]),
-        ckpt_path=os.path.join(args.output_dir, "gemma_lora.safetensors"))
+        ckpt_path=os.path.join(args.output_dir, "gemma_lora.safetensors"),
+        # memory-admission degradation ladder (DESIGN.md §21)
+        degrade_builders={"offload": offload_rung})
     return 0
 
 

@@ -103,7 +103,10 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
                                     # skipped updates in this flush
                                     # interval (None on step builders
                                     # without the guard's metric)
-        "hbm_mb": _NUM,
+        "hbm_mb": _OPT_NUM,         # null when neither live memory_stats
+                                    # nor a compiled-peak estimate exists
+                                    # (round 16: a backend without stats
+                                    # used to masquerade as 0 MB)
         "queue_depth": _OPT_NUM,    # input-pipeline gauge (None: no stream)
         "host_step_ms": (dict, type(None)),  # {host: per-step ms} from the
                                     # last straggler-cadence gather; None
@@ -236,6 +239,37 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "rejected": (int,),
         "timeout": (int,),
         "error": (int,),
+        # round-16 HBM fields (optional on read: r14 streams): live
+        # device bytes (null on backends without memory_stats) and the
+        # static KV-pool footprint the admission preflight charged
+        "hbm_mb": _OPT_NUM,
+        "pool_mb": _OPT_NUM,
+    },
+    # one memory-admission verdict (core/memory_guard.py, DESIGN.md
+    # §21): immediately post-compile (phase=preflight), on a caught
+    # RESOURCE_EXHAUSTED at dispatch (phase=dispatch), or at serve
+    # build (phase=serve_build). est_mb = compiled peak + unaccounted
+    # live bytes; cap_mb = --hbm_cap_mb | memory_stats bytes_limit |
+    # device-kind table; verdict "unknown" when either side is
+    # unavailable (admission never refuses on a guess).
+    "mem_check": {
+        "est_mb": _OPT_NUM,
+        "cap_mb": _OPT_NUM,
+        "verdict": (str,),          # "ok" | "over" | "unknown"
+        "phase": (str,),
+    },
+    # one degradation-ladder decision (cli/common.run_training): a
+    # failed preflight (or dispatch RESOURCE_EXHAUSTED) under
+    # --on_oom_risk=degrade walked one rung — remat -> accum_x2 ->
+    # offload — recompiling and re-preflighting after each. est_mb is
+    # the estimate that FORCED the rung (the next mem_check carries
+    # the post-rung estimate).
+    "degrade": {
+        "step": _OPT_NUM,           # None at preflight (no step ran yet)
+        "rung": (str,),             # a memory_guard.LADDER name
+        "from": (str,),
+        "to": (str,),
+        "est_mb": _OPT_NUM,
     },
     # one checkpoint-integrity verdict per candidate a load path
     # visited (io/checkpoints.resolve_checkpoint — --resume_from, the
@@ -314,6 +348,7 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
 # when present they are type-checked as usual.
 OPTIONAL_FIELDS: Dict[str, frozenset] = {
     "step_stats": frozenset({"host_step_ms", "skipped"}),
+    "serve_stats": frozenset({"hbm_mb", "pool_mb"}),
     "run_end": frozenset({"goodput", "reason"}),
     "checkpoint": frozenset({"snapshot_ms", "write_ms", "bytes", "mb_s",
                              "async"}),

@@ -41,25 +41,45 @@ def shaped_all_gathers(compiled, shape, dtypes=("f32", "bf16")) -> list:
             if "all-gather" in ln and any(n in ln for n in needles)]
 
 
-def live_hbm_mb(devices=None) -> float:
+_no_stats_logged = set()  # backends already warned about (log once)
+
+
+def live_hbm_mb(devices=None):
     """MAX device bytes-in-use across the local devices, when the
     platform exposes memory_stats() (the tunneled TPU platform does not;
-    CPU and direct TPU do). The max — not device 0 — because shards can
-    be imbalanced (e.g. a vocab-parallel embed remainder landing on one
-    chip) and the binding constraint is the fullest device.
-    `devices`: override for tests; defaults to jax.local_devices()."""
+    direct TPU does; this jax's CPU backend returns an empty dict). The
+    max — not device 0 — because shards can be imbalanced (e.g. a
+    vocab-parallel embed remainder landing on one chip) and the binding
+    constraint is the fullest device.
+
+    Returns None — not 0.0 — when NO device reported a bytes_in_use:
+    a zero would silently masquerade as "nothing allocated" in the
+    telemetry hbm_mb field, when the truth is "this backend cannot
+    say" (the field is emitted as null and a one-time log names the
+    backend). `devices`: override for tests; defaults to
+    jax.local_devices()."""
     if devices is None:
         try:
             devices = jax.local_devices()
         except Exception:
-            return 0.0
-    peak = 0.0
+            return None
+    peak = None
+    platform = "unknown"
     for d in devices:
+        platform = getattr(d, "platform", platform)
         try:
             stats = d.memory_stats() or {}
-            peak = max(peak, stats.get("bytes_in_use", 0) / 2 ** 20)
+            if "bytes_in_use" in stats:
+                peak = max(peak or 0.0, stats["bytes_in_use"] / 2 ** 20)
         except Exception:
             continue  # a device without stats must not zero the others
+    if peak is None and platform not in _no_stats_logged:
+        _no_stats_logged.add(platform)
+        from mobilefinetuner_tpu.core.logging import get_logger
+        get_logger().info(
+            f"backend {platform!r} exposes no memory_stats bytes_in_use; "
+            f"live-HBM telemetry will be null (compiled-peak estimates "
+            f"still apply)")
     return peak
 
 

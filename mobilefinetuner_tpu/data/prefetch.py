@@ -75,7 +75,8 @@ class Prefetcher:
 
     def __init__(self, source: Iterable, depth: int = 2,
                  place_fn: Optional[Callable[[Any], Any]] = None,
-                 lookahead: int = 1):
+                 lookahead: int = 1, rss_limit_mb: float = 0,
+                 rss_fn: Optional[Callable[[], Optional[float]]] = None):
         if depth < 0:
             raise ValueError(f"depth must be >= 0, got {depth}")
         self._place = place_fn if place_fn is not None else (lambda x: x)
@@ -86,6 +87,20 @@ class Prefetcher:
         self._error: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
         self._q = None
+        # host-RSS shed guard (core/memory_guard.host_rss_mb): while the
+        # process RSS sits above rss_limit_mb the producer stops
+        # assembling lookahead batches until the consumer drains the
+        # queue — the pipeline degrades toward depth-1 instead of the
+        # OS OOM-killer picking a victim. 0 = off. rss_fn is injectable
+        # for tests; a backend whose RSS cannot be read disables the
+        # guard (never block on a sensor that cannot answer).
+        self._rss_limit = max(float(rss_limit_mb), 0.0)
+        if rss_fn is None:
+            from mobilefinetuner_tpu.core.memory_guard import host_rss_mb
+            rss_fn = host_rss_mb
+        self._rss_fn = rss_fn
+        self._rss_logged = False
+        self.rss_sheds = 0  # lookahead batches deferred under pressure
         if depth > 0:
             self._q = queue.Queue(maxsize=depth)
             self._stop = threading.Event()
@@ -108,12 +123,50 @@ class Prefetcher:
                 continue
         return False
 
+    def _shed_on_rss(self) -> None:
+        """Hold the producer BEFORE it assembles the next batch while
+        host RSS exceeds the limit and the consumer still has queued
+        batches to drain: under memory pressure the lookahead is the
+        one host allocation this pipeline controls, so it is the first
+        thing to give back. Resumes as soon as RSS drops below the
+        limit or the queue empties (a starved consumer always wins —
+        shedding must degrade throughput, never deadlock it)."""
+        if not self._rss_limit:
+            return
+        rss = self._rss_fn()
+        if rss is None:
+            self._rss_limit = 0  # unreadable sensor: guard off, once
+            return
+        if rss <= self._rss_limit:
+            return
+        self.rss_sheds += 1
+        if not self._rss_logged:
+            self._rss_logged = True
+            from mobilefinetuner_tpu.core.logging import get_logger
+            get_logger().warning(
+                f"host RSS {rss:.0f} MB over the {self._rss_limit:.0f} "
+                f"MB prefetch guard: shedding lookahead depth until "
+                f"pressure clears")
+        while not self._stop.is_set() and self._q.qsize() > 0:
+            rss = self._rss_fn()
+            if rss is None or rss <= self._rss_limit:
+                break
+            self._stop.wait(0.02)
+
     def _produce(self, source):
         try:
-            for item in source:
+            it = iter(source)
+            while True:
+                self._shed_on_rss()
+                if self._stop.is_set():
+                    return
+                try:
+                    item = next(it)
+                except StopIteration:
+                    self._put(_DONE)
+                    return
                 if not self._put(item):
                     return
-            self._put(_DONE)
         except BaseException as e:  # noqa: BLE001 — carried to the consumer
             self._put(_Failure(e))
 

@@ -231,6 +231,40 @@ def letter_token_ids(encode_fn: Callable[[str], List[int]]) -> List[int]:
     return out
 
 
+def bucket_for(n_ids: int, min_bucket: int = 32,
+               max_len: int = 1024) -> int:
+    """The power-of-two length bucket a prompt of n_ids tokens lands in
+    (clamped to [min_bucket, max_len]) — ONE rule shared by the runner
+    and the round-16 admission preflight, so the bucket the CLI
+    preflights is exactly a bucket the runner will feed."""
+    return min(max(1 << (n_ids - 1).bit_length(), min_bucket), max_len)
+
+
+def materialize_work(by_subject: Dict[str, List[MCQItem]],
+                     encode_fn: Callable[[str], List[int]],
+                     fewshot_k: int = 0,
+                     max_items_per_subject: int = 0,
+                     max_len: int = 1024):
+    """(work, totals): the exact evaluate() work list — (subject,
+    item_no, n_subject, item, token_ids) per item, same shot exclusion
+    — encoded ONCE. Split out of evaluate_batched (round 16) so the
+    CLI can size its admission preflight from the REAL max bucket and
+    then hand the list back without re-encoding every prompt."""
+    work = []
+    totals: Dict[str, int] = {}
+    for subject in sorted(by_subject):
+        items = by_subject[subject]
+        if max_items_per_subject:
+            items = items[:max_items_per_subject]
+        shots = items[:fewshot_k] if fewshot_k > 0 else []
+        totals[subject] = len(items)
+        for n, item in enumerate(items):
+            shots_ex = [s for s in shots if s is not item]
+            ids = encode_fn(build_prompt(item, shots_ex or None)) or [0]
+            work.append((subject, n, len(items), item, ids[-max_len:]))
+    return work, totals
+
+
 def evaluate_batched(by_subject: Dict[str, List[MCQItem]],
                      batched_logits_fn: Callable[[np.ndarray, np.ndarray],
                                                  np.ndarray],
@@ -243,7 +277,8 @@ def evaluate_batched(by_subject: Dict[str, List[MCQItem]],
                                                          List[int]]] = None,
                      batch_size: int = 16,
                      max_len: int = 1024,
-                     min_bucket: int = 32) -> MMLUResult:
+                     min_bucket: int = 32,
+                     work=None) -> MMLUResult:
     """TPU-first runner: identical predictions/reporting to evaluate(),
     but prompts are grouped into power-of-two length buckets and fed
     batch_size at a time — one compiled program per (bucket, batch) shape
@@ -261,25 +296,20 @@ def evaluate_batched(by_subject: Dict[str, List[MCQItem]],
     final reports are order-identical.
     """
     letter_ids = letter_token_ids(letter_encode_fn or encode_fn)
-    # materialize the exact evaluate() work list (same shot exclusion)
-    work = []   # (subject, item_no_in_subject, n_subject, item, ids)
-    totals: Dict[str, int] = {}
-    for subject in sorted(by_subject):
-        items = by_subject[subject]
-        if max_items_per_subject:
-            items = items[:max_items_per_subject]
-        shots = items[:fewshot_k] if fewshot_k > 0 else []
-        totals[subject] = len(items)
-        for n, item in enumerate(items):
-            shots_ex = [s for s in shots if s is not item]
-            ids = encode_fn(build_prompt(item, shots_ex or None)) or [0]
-            work.append((subject, n, len(items), item, ids[-max_len:]))
+    if work is None:
+        work, totals = materialize_work(
+            by_subject, encode_fn, fewshot_k=fewshot_k,
+            max_items_per_subject=max_items_per_subject,
+            max_len=max_len)
+    else:
+        totals = {}
+        for subject, _n, n_sub, _item, _ids in work:
+            totals[subject] = n_sub
 
     by_bucket: Dict[int, list] = {}
     for w in work:
-        bucket = 1 << (len(w[4]) - 1).bit_length()
-        by_bucket.setdefault(min(max(bucket, min_bucket), max_len),
-                             []).append(w)
+        by_bucket.setdefault(
+            bucket_for(len(w[4]), min_bucket, max_len), []).append(w)
 
     correct: Dict[str, int] = {s: 0 for s in totals}
     for bucket in sorted(by_bucket):

@@ -115,6 +115,13 @@ class ServeConfig:
                                   # exception (fail in-flight, keep
                                   # serving) or "raise" after containing
     stats_every: int = 0      # serve_stats cadence (decode steps); 0=off
+    # --- memory admission (round 16, core/memory_guard.py) ----------
+    hbm_cap_mb: int = 0       # capacity override MB; 0 = auto (the
+                              # backend's bytes_limit, else the
+                              # device-kind HBM table) — tests drive
+                              # the refusal deterministically with it
+    hbm_headroom: float = 0.1  # admission margin (same meaning as the
+                              # train path's --hbm_headroom)
 
     def validate(self) -> None:
         from mobilefinetuner_tpu.models.lora_apply import \
@@ -232,7 +239,6 @@ class ServeEngine:
         else:
             raise ValueError(f"unknown family {family!r}")
         self.family, self.config, self.cfg = family, config, cfg
-        self.params = jax.tree.map(jnp.asarray, params)
         self.bank = bank
         self.eos_id, self.pad_id = eos_id, pad_id
         self.dtype = jnp.dtype(cfg.dtype)
@@ -240,6 +246,44 @@ class ServeEngine:
         S = cfg.num_slots
         self.M = blocks_for(cfg.max_prompt + cfg.max_new_tokens - 1,
                             cfg.block_T)
+        # ---- memory admission at BUILD (round 16, DESIGN.md §21):
+        # params + adapter bank + both KV pools are the engine's static
+        # HBM footprint — refuse an infeasible num_blocks/num_slots
+        # BEFORE anything lands on device (the sizes come from the RAW
+        # input trees: a params-dominated over-capacity config must be
+        # refused by name, not crash in the placement below), naming
+        # the max feasible values so the retry is a calculation.
+        from mobilefinetuner_tpu.core import memory_guard as mg
+        per_block_mb = (2 * L * KV * cfg.block_T * D
+                        * self.dtype.itemsize) / 2 ** 20
+        self.pool_mb = per_block_mb * cfg.num_blocks
+
+        def tree_mb(t):
+            return sum(
+                int(np.prod(np.shape(x)))
+                * np.dtype(getattr(x, "dtype", np.float32)).itemsize
+                for x in jax.tree.leaves(t)) / 2 ** 20
+
+        params_mb = tree_mb(params)
+        bank_mb = tree_mb(bank.tree) if bank is not None else 0.0
+        self.mem_check = mg.analytic_check(
+            params_mb + bank_mb + self.pool_mb, cap_mb=cfg.hbm_cap_mb,
+            headroom=cfg.hbm_headroom)
+        if self.mem_check.verdict == "over":
+            budget = (self.mem_check.cap_mb * (1 - cfg.hbm_headroom)
+                      - params_mb - bank_mb)
+            max_blocks = max(int(budget // per_block_mb), 0)
+            max_slots = max((max_blocks - 1) // self.M, 0)
+            raise mg.MemoryAdmissionError(
+                f"serve config refused at build: "
+                f"{self.mem_check.describe()} (params "
+                f"{params_mb:.0f} MB + adapter bank {bank_mb:.0f} MB "
+                f"+ KV pool {self.pool_mb:.0f} MB). Max feasible "
+                f"num_blocks={max_blocks} "
+                f"({per_block_mb:.2f} MB/page), which serves at most "
+                f"num_slots={max_slots} worst-case requests of "
+                f"{self.M} pages each", check=self.mem_check)
+        self.params = jax.tree.map(jnp.asarray, params)
         self.alloc = BlockAllocator(cfg.num_blocks)
         self._pool_dims = (L, KV, D)   # for the containment pool reset
         self.pool_k, self.pool_v = init_pools(
@@ -340,6 +384,11 @@ class ServeEngine:
             "max_queue": cfg.max_queue, "shed_policy": cfg.shed_policy,
             "on_step_error": cfg.on_step_error,
             "stats_every": cfg.stats_every}))
+        # the admission verdict that let this engine build (the refusal
+        # path raised before the stream existed): est vs cap is the
+        # "how many more blocks/slots could this chip hold" number the
+        # ROADMAP's adapter-packing and KV-sizing questions start from
+        self.telemetry.emit("mem_check", **self.mem_check.event())
 
     # ------------------------------------------------------------ helpers ---
     @staticmethod
@@ -788,6 +837,8 @@ class ServeEngine:
         ms = sorted(self._step_ms)
         p95 = (round(ms[min(int(0.95 * len(ms)), len(ms) - 1)], 3)
                if ms else None)
+        from mobilefinetuner_tpu.core.xla_stats import live_hbm_mb
+        hbm = live_hbm_mb()
         return {
             "queue_depth": len(self.queue),
             "active": len(self.active),
@@ -797,6 +848,12 @@ class ServeEngine:
             "p95_step_ms": p95,
             "decode_steps": self.decode_steps,
             "draining": self.draining,
+            # round-16 HBM vitals: live device bytes (null where the
+            # backend reports none) + the static pool footprint the
+            # admission charged — pressure is visible BEFORE it
+            # becomes an allocator failure
+            "hbm_mb": round(hbm, 2) if hbm is not None else None,
+            "pool_mb": round(self.pool_mb, 2),
             "counts": {s: int(self.counts.get(s, 0))
                        for s in Request.TERMINAL},
         }
@@ -809,7 +866,8 @@ class ServeEngine:
             "serve_stats", step=self.decode_steps,
             queue_depth=h["queue_depth"], active=h["active"],
             occupancy=h["occupancy"], free_blocks=h["free_blocks"],
-            p95_step_ms=h["p95_step_ms"], **h["counts"])
+            p95_step_ms=h["p95_step_ms"], hbm_mb=h["hbm_mb"],
+            pool_mb=h["pool_mb"], **h["counts"])
 
     # ------------------------------------------------------------ teardown --
     def close(self, exit: str = "ok", reason: Optional[str] = None) -> None:

@@ -58,7 +58,16 @@ class TrainConfig:
 
 
 def reshape_for_accum(batch: dict, accum: int) -> dict:
-    """[accum*micro_b, ...] arrays -> [accum, micro_b, ...] for lax.scan."""
+    """[accum*micro_b, ...] arrays -> [accum, micro_b, ...] for lax.scan.
+
+    The step's accum and the data stream's accum are allowed to differ:
+    the memory-admission degradation ladder (cli/common.run_training,
+    DESIGN.md §21) rebuilds the step with DOUBLED grad_accum_steps at
+    constant global batch — the same [rows, ...] step batch simply
+    scans as twice as many half-size micro-batches, so batch shapes,
+    shardings, and donation are untouched and only float reassociation
+    moves (loss parity <=1e-5). The divisibility assert below is the
+    ladder's gate: a rung that cannot split further is skipped."""
     def r(x):
         total = x.shape[0]
         assert total % accum == 0, (total, accum)

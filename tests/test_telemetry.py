@@ -72,7 +72,15 @@ REPRESENTATIVE = {
     # headroom/p95 step latency + cumulative terminal-state counters
     "serve_stats": dict(step=50, queue_depth=3, active=8, occupancy=1.0,
                         free_blocks=120, p95_step_ms=12.5, finished=40,
-                        cancelled=1, rejected=2, timeout=1, error=0),
+                        cancelled=1, rejected=2, timeout=1, error=0,
+                        hbm_mb=512.0, pool_mb=64.0),
+    # round-16 memory admission (DESIGN.md §21): one verdict per
+    # preflight/dispatch/serve-build check, one event per degradation-
+    # ladder rung walked
+    "mem_check": dict(est_mb=8.5, cap_mb=3.0, verdict="over",
+                      phase="preflight"),
+    "degrade": {"step": None, "rung": "accum_x2", "from": "accum=1",
+                "to": "accum=2", "est_mb": 3.7},
     # round-15 numerical-fault recovery (DESIGN.md §20): checkpoint-
     # integrity verdicts on every load path and the in-process
     # divergence→rollback decisions
@@ -267,7 +275,33 @@ def test_live_hbm_mb_reports_max_across_devices():
     # one broken device must not zero the others
     devs = [_FakeDev(0, broken=True), _FakeDev(300 * 2 ** 20)]
     assert live_hbm_mb(devices=devs) == pytest.approx(300.0)
-    assert live_hbm_mb(devices=[]) == 0.0
+
+
+class _NoStatsDev:
+    platform = "faketpu"
+
+    def memory_stats(self):
+        return {}  # this jax's CPU backend shape: stats exist, empty
+
+
+def test_live_hbm_mb_is_none_when_no_device_reports():
+    """Round-16 satellite: a backend without bytes_in_use must report
+    None — not a silent 0.0 that masquerades as 'nothing allocated' in
+    the telemetry hbm_mb field — and record the backend for its
+    one-time log (the `_no_stats_logged` latch is the observable; the
+    project logger does not propagate to caplog)."""
+    from mobilefinetuner_tpu.core import xla_stats
+    from mobilefinetuner_tpu.core.xla_stats import live_hbm_mb
+    xla_stats._no_stats_logged.discard("faketpu")
+    assert live_hbm_mb(devices=[]) is None
+    assert "faketpu" not in xla_stats._no_stats_logged
+    assert live_hbm_mb(devices=[_NoStatsDev()]) is None
+    assert "faketpu" in xla_stats._no_stats_logged  # logged, latched
+    assert live_hbm_mb(devices=[_NoStatsDev()]) is None  # 2nd: quiet
+    # a broken device alongside a reporting one still yields the max
+    assert live_hbm_mb(
+        devices=[_FakeDev(0, broken=True),
+                 _FakeDev(64 * 2 ** 20)]) == pytest.approx(64.0)
 
 
 # --------------------------- named-scope tracing ----------------------------

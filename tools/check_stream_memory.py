@@ -28,6 +28,7 @@ TPU) and runnable standalone:  python tools/check_stream_memory.py
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -95,17 +96,65 @@ def check_queue_memory(steps: int = 120, warm_steps: int = 10,
             "queue_depth": depth}
 
 
+def check_rss_shed(steps: int = 60, depth: int = 4) -> dict:
+    """Prove the producer's host-RSS guard (round 16, data/prefetch.py
+    rss_limit_mb): under simulated memory pressure — an injected rss_fn
+    reporting over-limit for a window of steps — the producer defers
+    lookahead assembly (rss_sheds > 0, queue drains toward empty)
+    instead of filling the bounded queue, then recovers to full depth
+    when pressure clears, with the consumed batch sequence untouched.
+    Pure host-side; runs anywhere, CPU included."""
+    import itertools
+
+    from mobilefinetuner_tpu.data.prefetch import Prefetcher
+
+    def batches():
+        for i in itertools.count():
+            yield {"i": i, "payload": np.zeros(4096, np.int32)}
+
+    # pressure window: over-limit between consumer step 15 and 35,
+    # keyed off a shared cell the consumer advances
+    seen = {"n": 0}
+    limit = 100.0
+    pressure = lambda: 999.0 if 15 <= seen["n"] < 35 else 0.0
+    order = []
+    depths_under_pressure = []
+    with Prefetcher(batches(), depth=depth, rss_limit_mb=limit,
+                    rss_fn=pressure) as stream:
+        for _ in range(steps):
+            b = next(stream)
+            order.append(b["i"])
+            seen["n"] += 1
+            if 20 <= seen["n"] < 35:
+                # settled pressure regime: the producer must be shed
+                # (at most the one batch it held mid-build in flight)
+                time.sleep(0.005)
+                depths_under_pressure.append(stream.queue_depth())
+        sheds = stream.rss_sheds
+        # after pressure clears the producer must refill
+        time.sleep(0.2)
+        depth_after = stream.queue_depth()
+    ok = (order == list(range(steps)) and sheds > 0
+          and max(depths_under_pressure) <= 2
+          and depth_after >= depth - 1)
+    return {"ok": bool(ok), "sheds": int(sheds),
+            "max_depth_under_pressure": max(depths_under_pressure),
+            "depth_after_recovery": depth_after,
+            "sequence_intact": order == list(range(steps))}
+
+
 def main() -> int:
     queue = check_queue_memory()
+    rss = check_rss_shed()
     if jax.devices()[0].platform == "cpu":
         # the offload half needs accelerator memory-space accounting; the
-        # queue half has already run — surface its verdict in the exit
-        # code (2 keeps test_offload's "no TPU" skip contract)
+        # queue + rss halves have already run — surface their verdict in
+        # the exit code (2 keeps test_offload's "no TPU" skip contract)
         print(json.dumps({"ok": False,
                           "reason": "cpu backend has no host/device "
                                     "memory-space accounting",
-                          "queue": queue}))
-        return 2 if queue["ok"] else 1
+                          "queue": queue, "rss": rss}))
+        return 2 if (queue["ok"] and rss["ok"]) else 1
 
     from mobilefinetuner_tpu.core.config import GPT2Config
     from mobilefinetuner_tpu.lora.lora import LoRASpec, init_lora_gpt2
@@ -159,8 +208,8 @@ def main() -> int:
           and stm["host_args"] > 0.8 * blocks_bytes
           and stm["temp"] < 3 * per_layer + 32 * 2 ** 20
           and dev_peak_stm < dev_peak_res / 2
-          and queue["ok"])
-    print(json.dumps({"ok": bool(ok), "queue": queue,
+          and queue["ok"] and rss["ok"])
+    print(json.dumps({"ok": bool(ok), "queue": queue, "rss": rss,
                       "blocks_bytes": blocks_bytes,
                       "per_layer_bytes": int(per_layer),
                       "resident": res, "streamed": stm}))

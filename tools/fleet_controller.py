@@ -77,9 +77,13 @@ class ShardTail:
     """Incremental reader over one worker's telemetry shard: consumes
     only COMPLETE lines (a worker killed mid-write leaves a partial
     tail; we wait for the newline rather than mis-parse), tracking the
-    facts the live policy needs — last observed step and hang-event
-    count (exit CODES carry the rest; run_end records are the dry-run
-    replay's input, not the live tail's)."""
+    facts the live policy needs — last observed step, hang-event
+    count, and the latest run_end's exit NAME (round 16: an exit of
+    MemoryAdmissionError marks an inadmissible CONFIG — the one class
+    of nonzero exit a restart can never fix, so the policy gives up
+    instead of burning the budget re-proving the same arithmetic;
+    plain exit CODES carry the rest, and full run_end records remain
+    the dry-run replay's input, not the live tail's)."""
 
     def __init__(self, path: str):
         self.path = path
@@ -94,6 +98,7 @@ class ShardTail:
             self._off = 0
         self.last_step: Optional[int] = None
         self.hangs = 0
+        self.last_exit: Optional[str] = None  # latest run_end exit name
 
     def poll(self) -> None:
         try:
@@ -121,6 +126,8 @@ class ShardTail:
                 self.last_step = rec["step"]
             elif ev == "hang":
                 self.hangs += 1
+            elif ev == "run_end":
+                self.last_exit = rec.get("exit")
 
 
 # --------------------------- decision function ------------------------------
@@ -144,6 +151,13 @@ def decide_worker(events) -> dict:
     end = ends[-1]
     if end.get("reason") == "preempted" or end.get("exit") == "preempted":
         return {"decision": "resume", "reason": "preempted",
+                "step": last_step}
+    if end.get("exit") == "MemoryAdmissionError":
+        # inadmissible CONFIG (round-16 memory admission): the same
+        # flags re-fail the same preflight on every launch — restarting
+        # burns the budget proving arithmetic. The operator must change
+        # the config (or let --on_oom_risk degrade walk the ladder).
+        return {"decision": "give_up", "reason": "inadmissible_config",
                 "step": last_step}
     if end.get("exit") != "ok":
         return {"decision": "restart",
@@ -297,6 +311,18 @@ class FleetController:
             self.record("down", worker=w.host, reason="preempted",
                         step=w.tail.last_step)
             w.relaunch_at = w.down_t + w.backoff
+            return
+        if w.tail.last_exit == "MemoryAdmissionError":
+            # the shard names an INADMISSIBLE CONFIG (round-16 memory
+            # admission): deterministic — every relaunch re-fails the
+            # same preflight, so give up now with the budget intact
+            # (mirrors decide_worker's 'give_up/inadmissible_config')
+            self.record("down", worker=w.host,
+                        reason="inadmissible_config",
+                        step=w.tail.last_step)
+            self.give_up(f"worker {w.host} config failed memory "
+                         f"admission (MemoryAdmissionError) — a "
+                         f"restart cannot fix it")
             return
         self.record("down", worker=w.host, reason=reason,
                     step=w.tail.last_step)

@@ -29,7 +29,8 @@ from telemetry_report import (_fmt, checkpoint_lines,  # noqa: E402
                               checkpoint_summary, controller_entries,
                               controller_lines, controller_summary,
                               goodput_lines, hang_entries, hang_lines,
-                              load_events, percentile, recovery_lines,
+                              load_events, memory_lines, memory_summary,
+                              percentile, recovery_lines,
                               recovery_summary, split_latest_run,
                               straggler_entries, straggler_lines)
 
@@ -93,6 +94,9 @@ def shard_summary(host: int, events: list, n_invalid: int) -> dict:
         # round-15 numerical-fault recovery rollup (shared builder):
         # skipped updates, rollbacks + steps lost, ckpt_verify failures
         "recovery": recovery_summary(scope),
+        # round-16 memory-admission rollup (shared builder): mem_check
+        # verdicts (est vs cap) + degradation-ladder decisions
+        "memory": memory_summary(scope),
         "run_end": ({"steps": ends[-1]["steps"],
                      "wall_s": ends[-1]["wall_s"],
                      "exit": ends[-1]["exit"],
@@ -217,6 +221,8 @@ def print_fleet(s: dict):
     h0 = s["per_host"].get(0)
     if h0:
         for line in checkpoint_lines(h0["checkpoints"]):
+            print(line)
+        for line in memory_lines(h0.get("memory")):
             print(line)
         for line in recovery_lines(h0.get("recovery")):
             print(line)

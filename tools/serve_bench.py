@@ -159,7 +159,8 @@ def build_engine(model: str, num_slots: int, block_T: int,
                  seed: int = 0, max_queue: int = 0,
                  shed_policy: str = "reject",
                  on_step_error: str = "fail_active",
-                 stats_every: int = 0, watchdog=None):
+                 stats_every: int = 0, watchdog=None,
+                 hbm_cap_mb: int = 0, hbm_headroom: float = 0.1):
     """model: gpt2s | gemma270m | tiny-gpt2 | tiny-gemma. The tiny
     modes are the CPU contract/smoke path (tests/test_serve.py)."""
     from mobilefinetuner_tpu.core.config import GPT2Config, Gemma3TextConfig
@@ -190,7 +191,8 @@ def build_engine(model: str, num_slots: int, block_T: int,
                       max_new_tokens=max_new, dtype=dtype,
                       max_queue=max_queue, shed_policy=shed_policy,
                       on_step_error=on_step_error,
-                      stats_every=stats_every)
+                      stats_every=stats_every,
+                      hbm_cap_mb=hbm_cap_mb, hbm_headroom=hbm_headroom)
     eng = ServeEngine(family, config, params, cfg, bank=bank,
                       telemetry=Telemetry(telemetry_out),
                       watchdog=watchdog)
@@ -296,7 +298,8 @@ def run_rows(model: str, rates, n_requests: int, adapters: int,
              max_queue: int = 0, shed_policy: str = "reject",
              on_step_error: str = "fail_active", deadline_ms=None,
              stats_every: int = 0, inject: str = "", drain: bool = True,
-             watchdog_mode: int = 0, watchdog_min_s: float = 60.0) -> list:
+             watchdog_mode: int = 0, watchdog_min_s: float = 60.0,
+             hbm_cap_mb: int = 0, hbm_headroom: float = 0.1) -> list:
     """One engine, one warmup request, then one row per offered rate.
     `drain` arms the SIGTERM PreemptionGuard; `inject` fires its fault
     during the FIRST rate's run (the spec names an absolute decode
@@ -313,7 +316,9 @@ def run_rows(model: str, rates, n_requests: int, adapters: int,
                               telemetry_out=telemetry_out, seed=seed,
                               max_queue=max_queue, shed_policy=shed_policy,
                               on_step_error=on_step_error,
-                              stats_every=stats_every, watchdog=wd)
+                              stats_every=stats_every, watchdog=wd,
+                              hbm_cap_mb=hbm_cap_mb,
+                              hbm_headroom=hbm_headroom)
     if wd is not None:
         wd.on_hang = lambda p: eng.telemetry.emit("hang", **p)
         wd.stacks_file = (eng.telemetry.path + ".stacks"
@@ -435,6 +440,14 @@ def main(argv=None) -> int:
                     help="contain a step-dispatch exception (fail the "
                          "in-flight requests, keep serving) or re-raise "
                          "after containing")
+    ap.add_argument("--hbm_cap_mb", type=int, default=0,
+                    help="memory-admission capacity override for the "
+                         "engine's build-time preflight (DESIGN.md "
+                         "§21); an infeasible num_blocks/num_slots "
+                         "is refused with the max feasible values "
+                         "named. 0 = auto")
+    ap.add_argument("--hbm_headroom", type=float, default=0.1,
+                    help="admission margin for the build preflight")
     ap.add_argument("--stats_every", type=int, default=0,
                     help="emit a serve_stats health snapshot every N "
                          "decode steps (0 = off)")
@@ -471,7 +484,9 @@ def main(argv=None) -> int:
                     stats_every=args.stats_every, inject=args.inject,
                     drain=bool(args.drain),
                     watchdog_mode=args.watchdog,
-                    watchdog_min_s=args.watchdog_min_s)
+                    watchdog_min_s=args.watchdog_min_s,
+                    hbm_cap_mb=args.hbm_cap_mb,
+                    hbm_headroom=args.hbm_headroom)
     if args.out:
         art = {"device": jax.devices()[0].device_kind,
                "jax": jax.__version__, "rows": []}

@@ -138,6 +138,7 @@ def summarize(events, n_invalid=0) -> dict:
                   for e in by.get("eval", [])],
         "checkpoints": checkpoint_summary(scope),
         "recovery": recovery_summary(scope),
+        "memory": memory_summary(scope),
         "requests": request_summary(scope),
         "serve": serve_stats_summary(scope),
         "stragglers": straggler_entries(scope),
@@ -264,6 +265,57 @@ def recovery_lines(r) -> list:
                 f"checkpoint / budget exhausted)")
     for f in r["ckpt_verify_failures"]:
         lines.append(f"    CKPT REJECTED: {f['path']} ({f['reason']})")
+    return lines
+
+
+def memory_summary(events) -> dict:
+    """Roll up the round-16 memory-admission events (DESIGN.md §21):
+    every `mem_check` verdict (est vs cap, the cap_frac headroom
+    number) and every `degrade` ladder decision. None when the stream
+    carries neither — ONE builder shared with tools/fleet_report.py
+    like the checkpoint/recovery sections."""
+    checks = [e for e in events if e.get("event") == "mem_check"]
+    degrades = [e for e in events if e.get("event") == "degrade"]
+    if not (checks or degrades):
+        return None
+    last = checks[-1] if checks else None
+    row = lambda c: {"phase": c.get("phase"), "est_mb": c.get("est_mb"),
+                     "cap_mb": c.get("cap_mb"), "verdict": c["verdict"],
+                     "cap_frac": c.get("cap_frac")}
+    return {
+        "checks": [row(c) for c in checks],
+        "final": row(last) if last else None,
+        "over": sum(1 for c in checks if c["verdict"] == "over"),
+        "degrades": [{"step": d.get("step"), "rung": d["rung"],
+                      "from": d.get("from"), "to": d.get("to"),
+                      "est_mb": d.get("est_mb")} for d in degrades],
+    }
+
+
+def memory_lines(m) -> list:
+    """Render a memory_summary (shared with fleet_report)."""
+    if not m:
+        return []
+    bits = []
+    f = m["final"]
+    if f:
+        bits.append(f"est {_fmt(f['est_mb'], 0)} MB vs cap "
+                    f"{_fmt(f['cap_mb'], 0)} MB"
+                    + (f" ({100 * f['cap_frac']:.0f}% of cap)"
+                       if f.get("cap_frac") else "")
+                    + f", verdict {f['verdict']}")
+    if m["over"]:
+        bits.append(f"{m['over']} over-capacity check(s)")
+    if m["degrades"]:
+        bits.append(f"{len(m['degrades'])} ladder rung(s)")
+    lines = ["  memory: " + "; ".join(bits)]
+    for d in m["degrades"]:
+        lines.append(
+            f"    DEGRADE {d['rung']}: {d['from']} -> {d['to']}"
+            + (f" (est {d['est_mb']:.0f} MB over)"
+               if d.get("est_mb") else "")
+            + (f" @ step {d['step']}" if d.get("step") is not None
+               else " @ preflight"))
     return lines
 
 
@@ -574,6 +626,8 @@ def print_summary(s: dict):
             print(f"  eval @ step {e['step']}: loss={_fmt(e['loss'], 4)} "
                   f"ppl={_fmt(e['ppl'])}")
     for line in checkpoint_lines(s["checkpoints"]):
+        print(line)
+    for line in memory_lines(s.get("memory")):
         print(line)
     for line in recovery_lines(s.get("recovery")):
         print(line)

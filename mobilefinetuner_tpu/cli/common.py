@@ -210,6 +210,63 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
     g.add_argument("--straggler_mult", type=float, default=1.5,
                    help="straggler threshold: host median step time vs "
                         "fleet median")
+    # live observability plane (DESIGN.md §22)
+    g.add_argument("--trace_spans", type=int, default=0, choices=[0, 1],
+                   help="1 = emit `span` events (core/trace.py) into "
+                        "the telemetry stream: the goodput phases "
+                        "(init/compile/step/input_wait/eval/checkpoint/"
+                        "...) on a 'phase' track, each async checkpoint "
+                        "write on 'ckpt', each prefetch-producer batch "
+                        "on 'prefetch' — one tools/trace_export.py run "
+                        "turns the stream into a Perfetto-loadable "
+                        "timeline whose per-phase span sums reconcile "
+                        "with run_end's goodput buckets. Opt-in: a "
+                        "traced loop emits a handful of events per "
+                        "step. Requires --telemetry_out")
+    g.add_argument("--auto_profile", type=int, default=0, choices=[0, 1],
+                   help="1 = flight recorder: arm a ONE-SHOT "
+                        "jax.profiler capture when a sensor fires — a "
+                        "flush interval slower than "
+                        "auto_profile_slow_mult x the rolling median, "
+                        "a loss_spike/divergence anomaly, a straggler "
+                        "attribution, or the hang watchdog pre-exit — "
+                        "saving the device trace of the BAD step next "
+                        "to the stack dumps (a pre-scheduled "
+                        "--profile_dir window cannot catch these). "
+                        "Each capture emits a `profile_capture` event; "
+                        "cooldown + budget bound the disk cost")
+    g.add_argument("--auto_profile_dir", default="",
+                   help="capture root (default: <telemetry_out>"
+                        ".profiles); each capture lands in its own "
+                        "cap<k>_<trigger>_step<n> subdirectory")
+    g.add_argument("--auto_profile_steps", type=int, default=2,
+                   help="steps per triggered capture")
+    g.add_argument("--auto_profile_cooldown", type=float, default=300.0,
+                   help="seconds between captures (a persistently sick "
+                        "run produces a few traces, not a disk full)")
+    g.add_argument("--auto_profile_budget", type=int, default=2,
+                   help="max captures per run")
+    g.add_argument("--auto_profile_slow_mult", type=float, default=3.0,
+                   help="slow-step trigger: capture when a flush "
+                        "interval's per-step time exceeds this multiple "
+                        "of the rolling median (<= 0 disables the "
+                        "slow-step sensor; anomaly/straggler/hang "
+                        "triggers stay armed)")
+    g.add_argument("--metrics_port", type=int, default=0,
+                   help="serve a live OpenMetrics /metrics endpoint + "
+                        "/healthz on this port (core/metrics_http.py): "
+                        "step-time/TTFT histograms, tok/s, MFU, live "
+                        "HBM, queue depth, goodput fractions, skip/"
+                        "rollback/degrade counters — fed from the same "
+                        "emit path the telemetry sink uses (no second "
+                        "instrumentation layer, zero added device "
+                        "syncs). Coordinator-only under multi-host. "
+                        "0 = off")
+    g.add_argument("--metrics_addr", default="127.0.0.1",
+                   help="bind address for --metrics_port (default "
+                        "loopback: the endpoint exposes operational "
+                        "detail; exporting it beyond the host is an "
+                        "explicit decision)")
     # elastic fleet (DESIGN.md §18)
     g.add_argument("--on_preempt", choices=["drain", "off"],
                    default="drain",
@@ -913,7 +970,9 @@ def make_rollback_loader(tc: TrainConfig, mask, load_trainable):
 def parse_train_inject(spec: str):
     """--inject grammar -> (kind, step, n) | ('ckpt_corrupt', None, 1)
     | ('hbm_pressure', None, <mb>) | None. Shared validation so a typo
-    dies at startup, not at the injection step."""
+    dies at startup, not at the injection step. slow_step's third slot
+    is the sleep in ms (the FaultInjector re-reads it); its optional
+    FOURTH slot is the repeat count."""
     if not spec:
         return None
     parts = spec.split(":")
@@ -925,11 +984,25 @@ def parse_train_inject(spec: str):
             raise SystemExit(f"--inject hbm_pressure needs a ballast "
                              f"size in MB: {spec!r}")
         return ("hbm_pressure", None, max(int(parts[1]), 1))
+    if kind == "slow_step":
+        # host-side straggler step(s): sleep <ms> before dispatching
+        # step(s) >= <step> — the sensor food for --auto_profile's
+        # slow-step trigger and the straggler/latency-tail harness
+        # (the serve-side twin is serve_bench --inject slow_step)
+        if len(parts) < 3:
+            raise SystemExit(f"--inject slow_step needs a step and ms: "
+                             f"slow_step:<step>:<ms>[:<n>], got {spec!r}")
+        ms = float(parts[2])  # validated here, stored by the injector
+        if not (ms >= 0) or math.isinf(ms):  # `not >=` catches NaN too
+            raise SystemExit(f"--inject slow_step ms must be a finite "
+                             f"non-negative number, got {parts[2]!r}")
+        n = int(parts[3]) if len(parts) > 3 else 1
+        return ("slow_step", int(parts[1]), max(n, 1))
     if kind not in ("grad_nan", "loss_spike"):
         raise SystemExit(
             f"--inject must be grad_nan:<step>[:<n>] | "
-            f"loss_spike:<step>[:<n>] | ckpt_corrupt | "
-            f"hbm_pressure:<mb>, got {spec!r}")
+            f"loss_spike:<step>[:<n>] | slow_step:<step>:<ms>[:<n>] | "
+            f"ckpt_corrupt | hbm_pressure:<mb>, got {spec!r}")
     if len(parts) < 2:
         raise SystemExit(f"--inject {kind} needs a step: {spec!r}")
     step = int(parts[1])
@@ -953,6 +1026,22 @@ class FaultInjector:
         self.kind, self.at, self.n = parsed if parsed else (None, None, 0)
         self.fired = 0
         self.ballast = None  # hbm_pressure: the held device allocation
+        self.slow_ms = (float(spec.split(":")[2])
+                        if self.kind == "slow_step" else 0.0)
+
+    def maybe_slow(self, step: int) -> None:
+        """slow_step:<step>:<ms>[:<n>]: a host-side sleep before the
+        dispatch of n consecutive steps from <step> — a real straggler
+        step the flush-interval timing (and therefore the slow-step
+        sensor, the straggler window, and the watchdog median) sees,
+        without doctoring any metric."""
+        if self.kind != "slow_step" or self.fired >= self.n \
+                or step < self.at:
+            return
+        self.fired += 1
+        log.warning(f"--inject slow_step: sleeping {self.slow_ms:.0f} ms "
+                    f"before step {step} ({self.fired}/{self.n})")
+        time.sleep(self.slow_ms / 1000.0)
 
     @property
     def active(self) -> bool:
@@ -1133,14 +1222,26 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     for _ev in getattr(args, "_ckpt_verify_events", None) or []:
         tel.emit("ckpt_verify", **_ev)
     t_start = time.time()
+    # span tracing (--trace_spans, core/trace.py): ONE tracer threaded
+    # to every producer — the goodput meter's phase track, the async
+    # checkpoint writer, the prefetch producer — all emitting `span`
+    # events into the same stream tools/trace_export.py converts
+    from mobilefinetuner_tpu.core.trace import AutoProfiler, Tracer
+    tracer = (Tracer(tel.emit)
+              if getattr(args, "trace_spans", 0) and tel.enabled
+              else None)
     # wall-clock bucket accounting over run_training's whole span; the
-    # buckets sum to run_end.wall_s by construction (DESIGN.md §14)
-    meter = GoodputMeter()
+    # buckets sum to run_end.wall_s by construction (DESIGN.md §14);
+    # under --trace_spans every phase segment also lands as a span, so
+    # the exported timeline reconciles with the buckets structurally
+    meter = GoodputMeter(tracer=tracer)
     done_steps = 0
     governor = None  # assigned in setup; end_run late-binds the local
     wd = None        # assigned in setup; the outer finally stops it
     ckpt = None      # async checkpointer; end_run drains it
     guard = None     # preemption guard; the outer finally uninstalls it
+    metrics_srv = None  # live /metrics endpoint; outer finally closes it
+    auto_prof = None    # anomaly-triggered profiler; ditto
 
     def end_run(exit_name: str, steps: int, **extra_fields):
         """Terminate the stream exactly once on any exit path: run_end
@@ -1155,6 +1256,12 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
         mask the exception that brought us down)."""
         if ckpt is not None:
             ckpt.close(raise_errors=False)
+        # a capture still open at exit must land its profile_capture
+        # event BEFORE run_end closes the stream (emit on a closed
+        # stream is a hard no-op — the on-disk trace would lose its
+        # pointer); the outer finally's close() is then idempotent
+        if auto_prof is not None:
+            auto_prof.close()
         extra = dict(extra_fields)
         if governor is not None:
             extra["governor_slept_ms"] = round(governor.total_slept_ms, 1)
@@ -1171,6 +1278,36 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     try:
         governor = governor_from_args(
             args, event_sink=lambda p: tel.emit("throttle", **p))
+        # live OpenMetrics endpoint (--metrics_port, DESIGN.md §22):
+        # the registry attaches as a telemetry OBSERVER — every number
+        # a scraper reads came through the same emit call the JSONL
+        # sink wrote, and the registry never touches a device (it has
+        # no jax import to touch one with). Coordinator-only: one
+        # endpoint per run, like the CSV/JSONL sinks. A bind failure
+        # raises HERE, before data loading, under the run_end contract.
+        if getattr(args, "metrics_port", 0) > 0 and coord:
+            from mobilefinetuner_tpu.core.metrics_http import \
+                start_metrics
+            metrics_srv = start_metrics(
+                tel, args.metrics_port,
+                addr=getattr(args, "metrics_addr", "127.0.0.1"))
+            log.info(f"metrics endpoint: http://{metrics_srv.addr}:"
+                     f"{metrics_srv.port}/metrics (+ /healthz)")
+        # anomaly-triggered profiler capture (--auto_profile, DESIGN.md
+        # §22): a one-shot jax.profiler capture armed by the sensors —
+        # slow step, loss spike/divergence, straggler, hang pre-exit —
+        # under a budget and cooldown; each capture is a
+        # `profile_capture` event pointing at the trace on disk
+        if getattr(args, "auto_profile", 0):
+            prof_root = getattr(args, "auto_profile_dir", "") or \
+                ((tel.path + ".profiles") if tel.path
+                 else "auto_profile_traces")
+            auto_prof = AutoProfiler(
+                prof_root, sink=tel.emit,
+                steps=getattr(args, "auto_profile_steps", 2),
+                cooldown_s=getattr(args, "auto_profile_cooldown", 300.0),
+                budget=getattr(args, "auto_profile_budget", 2))
+        slow_mult = getattr(args, "auto_profile_slow_mult", 3.0)
         # preemption drain (core/preempt.py, DESIGN.md §18): SIGTERM/
         # SIGINT flips a flag the loop checks at every step boundary —
         # finish the step, one final atomic save, run_end{reason=
@@ -1203,7 +1340,7 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
         from mobilefinetuner_tpu.io.async_ckpt import AsyncCheckpointer
         ckpt = AsyncCheckpointer(
             enabled=bool(getattr(args, "async_save", 1)),
-            event_sink=tel.emit)
+            event_sink=tel.emit, tracer=tracer)
         spikes = SpikeDetector(SpikeConfig(
             zscore=getattr(args, "spike_z", 8.0),
             beta=getattr(args, "spike_beta", 0.98),
@@ -1246,6 +1383,11 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 probe_fn=lambda: jax.device_put(
                     jnp.zeros(())).block_until_ready(),
                 on_hang=lambda p: (
+                    # pre-exit flight recorder: grab the device trace
+                    # of the wedged state BEFORE a --watchdog 2 abort
+                    # can os._exit (bounded hold; never raises)
+                    (auto_prof.capture_now("hang", p["step"])
+                     if auto_prof is not None else None),
                     tel.emit("hang", last_seq=tel.last_seq, **p),
                     log.error(
                         f"HANG: no step for {p['stall_s']:.1f}s "
@@ -1417,7 +1559,8 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 itertools.islice(numbered(),
                                  max(total_steps - from_step, 0)),
                 depth=prefetch_depth, place_fn=place_step, lookahead=1,
-                rss_limit_mb=getattr(args, "prefetch_rss_mb", 0))
+                rss_limit_mb=getattr(args, "prefetch_rss_mb", 0),
+                tracer=tracer)
 
         # ---- memory admission + degradation ladder (DESIGN.md §21) ------
         # The step is AOT-compiled HERE, from a zero probe batch with the
@@ -1727,9 +1870,25 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
             # timing consumers — the straggler window and the watchdog's
             # deadline median — from here, the same number step_stats
             # publishes
+            prior_n, prior_med = step_clock.n, step_clock.median_ms()
             step_clock.record(dt_ms / 1000.0)
             if wd is not None:
                 wd.pet(buffered[-1][0], dt_ms / 1000.0)
+            # slow-step flight-recorder trigger (--auto_profile): this
+            # flush interval ran a multiple of the rolling median —
+            # arm a capture over the NEXT steps while whatever made it
+            # slow is plausibly still happening. The median is the
+            # PRIOR window's (the slow sample must not judge itself),
+            # and the manual --profile_dir window keeps priority.
+            if (auto_prof is not None and slow_mult > 0 and prior_n >= 3
+                    and prior_med > 0 and dt_ms > slow_mult * prior_med
+                    and not prof_active):
+                if auto_prof.trigger("slow_step", buffered[-1][0] + 1):
+                    log.warning(
+                        f"auto_profile: step time {dt_ms:.1f} ms > "
+                        f"{slow_mult:g}x rolling median "
+                        f"{prior_med:.1f} ms — capturing "
+                        f"{auto_prof.steps} step(s)")
             # live bytes when the backend reports them, else the
             # compiled-peak estimate, else NULL — a backend with no
             # memory accounting must not masquerade as 0 MB (round 16;
@@ -1749,6 +1908,8 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                         f"anomaly @ step {s + 1}: {anom['kind']} "
                         f"loss={loss:.4f}"
                         + (f" z={anom['zscore']}" if anom["zscore"] else ""))
+                    if auto_prof is not None and not prof_active:
+                        auto_prof.trigger(anom["kind"], s + 1)
                 if rb is not None:
                     # rollback triggers, evaluated per flushed step:
                     # sustained divergence (the detector's escalated
@@ -1922,6 +2083,8 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 meter.enter("step")
                 assert step_i == step  # strict order preservation
                 maybe_profile(step)
+                if injector.kind == "slow_step":
+                    injector.maybe_slow(step)
                 # the step was AOT-compiled (and admission-checked)
                 # BEFORE the stream existed; a RESOURCE_EXHAUSTED that
                 # still escapes the dispatch walks the remaining
@@ -1945,6 +2108,12 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 oom_snap = None  # a retired step ends the retry window
                 toks = batch["input_ids"].shape[0] * batch["input_ids"].shape[1]
                 buffered.append((step, epoch, toks, metrics))
+                if auto_prof is not None and auto_prof.active:
+                    # countdown an armed capture; the stop syncs the
+                    # device first so the async-dispatched step work is
+                    # actually inside the captured window
+                    auto_prof.tick(step, sync=lambda m=metrics:
+                                   jax.device_get(m["loss"]))
                 log_boundary = bool(args.log_interval) \
                     and (step + 1) % args.log_interval == 0
                 if log_boundary or (step + 1) % flush_every == 0:
@@ -1977,6 +2146,10 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                                     f"straggler: host {h} at {v:.1f} "
                                     f"ms/step vs fleet median "
                                     f"{med:.1f} ms ({v / med:.2f}x)")
+                                if auto_prof is not None \
+                                        and not prof_active:
+                                    auto_prof.trigger("straggler",
+                                                      step + 1)
                     step_clock.reset()
 
                 if (args.eval_interval and valid_ds is not None
@@ -2158,6 +2331,13 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
         # path — return, loop exception, tail exception, setup failure
         if wd is not None:
             wd.stop()
+        # a capture left open by an exiting loop is stopped (the trace
+        # of the steps that DID run is worth keeping), and the metrics
+        # endpoint goes down with the run it described
+        if auto_prof is not None:
+            auto_prof.close()
+        if metrics_srv is not None:
+            metrics_srv.close()
         # belt-and-braces: end_run already drained the writer on every
         # path (close is idempotent) — this guards exits that never
         # reached an end_run, e.g. a failure inside end_run itself

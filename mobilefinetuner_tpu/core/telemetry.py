@@ -311,6 +311,35 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "step": (int,),             # the drain step (last completed + 1)
         "signal": (str,),           # "SIGTERM" | "SIGINT"
     },
+    # one completed host span (core/trace.py Tracer, opt-in via
+    # --trace_spans / ServeConfig.trace_spans): t0 is a MONOTONIC
+    # time.perf_counter() stamp — the same clock as the envelope's
+    # t_mono — and dur_ms the span's length, so tools/trace_export.py
+    # places it on the wall timeline via the per-host (t - t_mono)
+    # offset without NTP-step jitter. `track` names the Perfetto row
+    # inside the host's process: "phase" (the GoodputMeter buckets),
+    # "ckpt" (the async writer thread), "prefetch" (the producer
+    # thread), "req:<id>" (one serve request's lifecycle).
+    "span": {
+        "name": (str,),
+        "track": (str,),
+        "t0": _NUM,
+        "dur_ms": _NUM,
+    },
+    # one completed anomaly-triggered profiler capture (core/trace.py
+    # AutoProfiler, --auto_profile): a sensor fired — slow_step |
+    # loss_spike | divergence | straggler | hang — and the device
+    # trace of the bad window landed at `path`, budget/cooldown
+    # permitting. The event is the pointer a post-mortem follows from
+    # the stream to the trace.
+    "profile_capture": {
+        "step": (int,),
+        "trigger": (str,),
+        "path": (str,),
+        "steps": _OPT_NUM,          # capture length in steps (None on
+                                    # the hang path's bounded hold)
+        "budget_left": _OPT_NUM,
+    },
     # one fleet-controller decision (tools/fleet_controller.py, written
     # to <telemetry_base>.controller): the recovery layer's own
     # timeline, rendered by fleet_report next to the goodput buckets so
@@ -397,6 +426,13 @@ def validate_event(rec: Any) -> Optional[str]:
                           or isinstance(rec["host"], bool)
                           or rec["host"] < 0):
         return f"{ev}: bad host {rec.get('host')!r}"
+    # t_mono is envelope too (round 17): a monotonic perf_counter stamp
+    # next to wall `t`, so trace_export span alignment never jitters
+    # across NTP steps. Optional on read — pre-round-17 streams carry
+    # only `t` and must keep parsing in both report tools.
+    if "t_mono" in rec and (isinstance(rec["t_mono"], bool)
+                            or not isinstance(rec["t_mono"], (int, float))):
+        return f"{ev}: bad t_mono {rec.get('t_mono')!r}"
     for field, types in EVENT_SCHEMA[ev].items():
         if field not in rec:
             if field in OPTIONAL_FIELDS.get(ev, ()):
@@ -488,6 +524,14 @@ class Telemetry:
     (fleet merge key together with seq); emit is lock-serialized so the
     hang watchdog's daemon thread can report through the same stream as
     the step loop.
+
+    Observers (`add_observer`) see every emitted record in-process —
+    the live-metrics registry (core/metrics_http.py) rides here, so the
+    `/metrics` endpoint is fed from the SAME emit path the JSONL sink
+    uses: one measurement, two consumers, no second instrumentation
+    layer to drift. Observers run even when the stream has no file
+    (metrics without --telemetry_out), and an observer exception never
+    reaches the emitter.
     """
 
     def __init__(self, path: str = "", enabled: bool = True,
@@ -498,6 +542,8 @@ class Telemetry:
         self._f = None
         self._seq = 0
         self._lock = threading.Lock()
+        self._closed = False
+        self._observers: List[Callable] = []
         self.resumed = False
         self.trailing_step_stats: List[dict] = []
         if self.enabled:
@@ -527,19 +573,46 @@ class Telemetry:
         stream exists to capture; the `anomaly` event's kind field
         carries the non-finiteness."""
         with self._lock:
-            if not self.enabled or self._f is None:
+            # a CLOSED stream is a hard no-op for observers too: the
+            # end_run double-emission guard ("emit/close no-op once
+            # closed, nested handlers compose") must hold for the
+            # metrics registry or a crash path would double-count
+            # run_end. A stream that never had a file (metrics without
+            # --telemetry_out) still feeds observers.
+            if self._closed:
+                return None
+            writable = self.enabled and self._f is not None
+            if not writable and not self._observers:
                 return None
             # envelope last: a payload field may not shadow the stream's
-            # identity keys (event/seq/t/host) — the straggler event
-            # learned this the hard way (its slow-host field is named
-            # slow_host for exactly this reason)
+            # identity keys (event/seq/t/t_mono/host) — the straggler
+            # event learned this the hard way (its slow-host field is
+            # named slow_host for exactly this reason). t_mono is the
+            # monotonic sibling of wall `t` (round 17): span alignment
+            # in trace_export reads the per-host (t - t_mono) offset,
+            # immune to NTP steps moving wall time mid-run.
             rec = {**{k: _json_finite(v) for k, v in fields.items()},
                    "event": event, "seq": self._seq, "t": time.time(),
-                   "host": self.host}
+                   "t_mono": time.perf_counter(), "host": self.host}
             self._seq += 1
-            self._f.write(json.dumps(rec) + "\n")
-            self._f.flush()
-            return rec
+            if writable:
+                self._f.write(json.dumps(rec) + "\n")
+                self._f.flush()
+            for ob in self._observers:
+                try:
+                    ob(rec)
+                except Exception:
+                    pass  # a broken observer must not kill the emitter
+            # contract: None exactly when nothing was durably written
+            # (observers are best-effort consumers, not the stream)
+            return rec if writable else None
+
+    def add_observer(self, fn: Callable[[dict], Any]) -> None:
+        """Register an in-process consumer of every emitted record
+        (called under the emit lock, record-at-a-time, exceptions
+        swallowed). The live-metrics registry attaches here."""
+        with self._lock:
+            self._observers.append(fn)
 
     def flush_tail(self):
         """Best-effort durability barrier before a hard exit
@@ -573,6 +646,7 @@ class Telemetry:
                 self._f.close()
                 self._f = None
             self.enabled = False
+            self._closed = True
 
     def __enter__(self) -> "Telemetry":
         return self
@@ -767,12 +841,19 @@ class GoodputMeter:
     phase, `enter(phase)` charges the elapsed time to the previous one,
     so the buckets sum to total wall-clock BY CONSTRUCTION (the
     acceptance criterion's within-1% identity is structural, not
-    approximate). `summary()` is the run_end `goodput` payload."""
+    approximate). `summary()` is the run_end `goodput` payload.
 
-    def __init__(self):
+    With a `tracer` (core/trace.py, --trace_spans) every phase SEGMENT
+    additionally lands as a `span` event on the "phase" track — the
+    same transition that charges the bucket emits the span, so the
+    exported timeline's per-bucket span sums reconcile with run_end's
+    goodput buckets by construction (trace_export prints the check)."""
+
+    def __init__(self, tracer=None):
         self.buckets = {b: 0.0 for b in GOODPUT_BUCKETS}
         self._phase = "init"
         self._mark = time.perf_counter()
+        self._tracer = tracer
 
     @property
     def phase(self) -> str:
@@ -782,6 +863,9 @@ class GoodputMeter:
         assert phase in self.buckets, phase
         now = time.perf_counter()
         self.buckets[self._phase] += now - self._mark
+        if self._tracer is not None:
+            self._tracer.emit_span(self._phase, "phase", self._mark,
+                                   (now - self._mark) * 1000.0)
         self._mark = now
         self._phase = phase
 

@@ -1,0 +1,255 @@
+"""Host-span tracing + anomaly-triggered profiler capture (DESIGN.md §22).
+
+The telemetry stream (core/telemetry.py) records WHAT happened; this
+module records WHEN, precisely enough to draw: `span` events carry a
+monotonic begin stamp and a duration on a named TRACK, so a whole run —
+the GoodputMeter's exclusive phases, the serve loop's per-request
+queue/prefill/decode lifecycle, the async-checkpoint writer thread, the
+prefetch producer — renders as one timeline in ui.perfetto.dev after
+`tools/trace_export.py` converts the stream. Spans ride the SAME
+crash-durable JSONL stream as every other event (one `span` record per
+completed span, emitted at span END), so a killed run keeps every span
+that finished before the kill and the exporter needs no second file.
+
+Clock discipline: span `t0` uses time.perf_counter() — the same
+monotonic clock the telemetry envelope's `t_mono` stamp uses — so the
+exporter can place spans on the wall-clock timeline via the per-host
+(t - t_mono) offset without NTP-step jitter corrupting durations.
+
+Span emission is OPT-IN (--trace_spans / ServeConfig.trace_spans): a
+traced step loop emits a handful of spans per step, which is exactly
+what you want while looking at a problem and more than you want in a
+month-long stream. Everything here is host-side: no device access, no
+jax import on the Tracer path (the zero-sync invariant extends to the
+trace layer — tests pin it structurally).
+
+The second half is the flight recorder (`AutoProfiler`, --auto_profile):
+a manually pre-scheduled --profile_dir window is useless for the
+anomalies the sensors actually catch, so this arms a ONE-SHOT
+jax.profiler capture when a sensor fires — slow-step multiple over the
+rolling median, loss-spike/divergence anomaly, straggler attribution,
+hang watchdog pre-exit — saving the device trace of the BAD step next
+to the stack dumps, under a capture budget and a cooldown so a
+persistently sick run produces a few traces, not a disk full of them.
+Every capture decision is a `profile_capture` telemetry event.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+
+class Tracer:
+    """Span emitter over a telemetry sink (`Telemetry.emit` signature).
+
+    One emit site for the whole repo: every producer — the goodput
+    meter, the serve engine, the checkpoint writer, the prefetch
+    producer — routes through `emit_span`, so the `span` event shape
+    cannot fork between threads or subsystems. Thread-safety is the
+    sink's problem (Telemetry.emit is lock-serialized), which is what
+    lets the checkpoint writer and prefetch producer threads trace
+    through the same stream as the step loop.
+    """
+
+    def __init__(self, sink: Optional[Callable] = None,
+                 enabled: bool = True):
+        self._sink = sink
+        self.enabled = bool(sink) and enabled
+
+    def emit_span(self, name: str, track: str, t0: float, dur_ms: float,
+                  **extra) -> None:
+        """Record one completed span: `t0` is a time.perf_counter()
+        stamp (the envelope's t_mono clock), dur_ms its length. Extra
+        fields ride along (the schema is a floor)."""
+        if not self.enabled:
+            return
+        self._sink(event="span", name=name, track=track,
+                   t0=round(t0, 6), dur_ms=round(dur_ms, 3), **extra)
+
+    @contextlib.contextmanager
+    def span(self, name: str, track: str = "main", **extra):
+        """Lexical span: emits on exit, exception or not (the span that
+        raised is exactly the one a post-mortem wants on the timeline)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.emit_span(name, track, t0,
+                           (time.perf_counter() - t0) * 1000.0, **extra)
+
+
+class AutoProfiler:
+    """One-shot anomaly-triggered jax.profiler capture (--auto_profile).
+
+    State machine: IDLE -> (sensor trigger, budget left, cooldown
+    elapsed) -> CAPTURING (jax.profiler trace started into its own
+    subdirectory; the step loop calls `tick` after each step and the
+    capture stops after `steps` of them, syncing the device first so
+    the trace actually contains the dispatched work) -> COOLDOWN.
+    A `profile_capture` event records every completed capture — step,
+    trigger kind, path, budget left — so the stream says where the
+    trace of the bad step lives.
+
+    The hang path is different: when the watchdog fires, the step loop
+    is by definition not ticking, so `capture_now` takes a bounded
+    capture on the CALLER's thread (start, hold, stop) — whatever the
+    device is doing while wedged lands in the trace, before a
+    --watchdog 2 abort can os._exit.
+
+    `profiler_start`/`profiler_stop` are injectable so tests never
+    depend on jax.profiler internals; the default binds lazily (no jax
+    import at module load). Failures inside the profiler NEVER
+    propagate — a broken capture must not kill the training run it was
+    meant to diagnose.
+
+    Thread-safety: `capture_now` runs on the WATCHDOG thread while
+    `trigger`/`tick` run on the step loop, so every state transition is
+    lock-serialized — without it, a loop that unwedges during a hang
+    capture's hold could tick the hold capture to a premature stop and
+    double-finish it (and two threads could double-start the one
+    profiler). The lock is held across `capture_now`'s bounded hold:
+    blocking a just-unwedged loop for the hold is noise next to the
+    stall that fired the watchdog, and it is what keeps the profiler
+    single-owner.
+    """
+
+    def __init__(self, out_dir: str, sink: Optional[Callable] = None,
+                 steps: int = 2, cooldown_s: float = 300.0,
+                 budget: int = 2,
+                 profiler_start: Optional[Callable[[str], None]] = None,
+                 profiler_stop: Optional[Callable[[], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.out_dir = out_dir
+        self._sink = sink
+        self.steps = max(int(steps), 1)
+        self.cooldown_s = float(cooldown_s)
+        self.budget = max(int(budget), 0)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._start = profiler_start or self._jax_start
+        self._stop = profiler_stop or self._jax_stop
+        self._last_capture_t: Optional[float] = None
+        self._active_path: Optional[str] = None
+        self._steps_left = 0
+        self._trigger: Optional[str] = None
+        self._n = 0
+        self.captured = 0  # completed captures (test observable)
+
+    @staticmethod
+    def _jax_start(path: str) -> None:
+        import jax
+        jax.profiler.start_trace(path)
+
+    @staticmethod
+    def _jax_stop() -> None:
+        import jax
+        jax.profiler.stop_trace()
+
+    @property
+    def active(self) -> bool:
+        return self._active_path is not None
+
+    def _ready(self) -> bool:
+        if self.active or self.budget <= 0:
+            return False
+        if self._last_capture_t is not None and \
+                self._clock() - self._last_capture_t < self.cooldown_s:
+            return False
+        return True
+
+    def _capture_path(self, trigger: str, step: int) -> str:
+        path = os.path.join(self.out_dir,
+                            f"cap{self._n}_{trigger}_step{step}")
+        self._n += 1
+        return path
+
+    def trigger(self, kind: str, step: int) -> bool:
+        """A sensor fired: start a capture unless one is active, the
+        budget is spent, or the cooldown has not elapsed. Returns True
+        exactly when a capture STARTED."""
+        with self._lock:
+            if not self._ready():
+                return False
+            path = self._capture_path(kind, step)
+            try:
+                os.makedirs(path, exist_ok=True)
+                self._start(path)
+            except Exception:
+                return False  # a broken profiler must not kill the run
+            self._active_path = path
+            self._trigger = kind
+            self._steps_left = self.steps
+            return True
+
+    def tick(self, step: int, sync: Optional[Callable] = None) -> bool:
+        """One step completed under an active capture; stops the trace
+        after `steps` ticks (running `sync` first so the async-
+        dispatched device work is actually IN the window). Returns True
+        when the capture completed on this tick."""
+        with self._lock:
+            if not self.active:
+                return False
+            self._steps_left -= 1
+            if self._steps_left > 0:
+                return False
+            if sync is not None:
+                try:
+                    sync()
+                except Exception:
+                    pass
+            return self._finish(step, steps=self.steps)
+
+    def capture_now(self, kind: str, step: int,
+                    hold_s: float = 1.0) -> bool:
+        """Bounded immediate capture for callers with no step loop to
+        tick — the hang watchdog's pre-exit hook: start, hold while the
+        wedged device does whatever it is doing, stop, record. Never
+        raises. Holds the lock for the whole start-hold-stop so the
+        step loop can never tick this capture to a premature stop."""
+        with self._lock:
+            if not self._ready():
+                return False
+            path = self._capture_path(kind, step)
+            try:
+                os.makedirs(path, exist_ok=True)
+                self._start(path)
+            except Exception:
+                return False
+            self._active_path, self._trigger = path, kind
+            time.sleep(max(hold_s, 0.0))
+            # steps=None: a bounded hold, not a counted step window
+            # (the schema documents exactly this)
+            return self._finish(step, steps=None)
+
+    def _finish(self, step: int, steps) -> bool:
+        # caller holds self._lock; `steps` is what the capture ACTUALLY
+        # covered (None for the hang path's bounded hold), not the
+        # configured window — a close() mid-capture reports the steps
+        # that ran, so post-mortem tooling never overstates the trace
+        path, trigger = self._active_path, self._trigger
+        try:
+            self._stop()
+        except Exception:
+            self._active_path = None
+            return False
+        self._active_path = None
+        self._last_capture_t = self._clock()
+        self.budget -= 1
+        self.captured += 1
+        if self._sink is not None:
+            self._sink(event="profile_capture", step=step,
+                       trigger=trigger, path=path, steps=steps,
+                       budget_left=self.budget)
+        return True
+
+    def close(self) -> None:
+        """Stop a capture left open by an exiting loop (the trace of
+        the steps that DID run is still worth keeping — and reported
+        with the tick count that actually elapsed)."""
+        with self._lock:
+            if self.active:
+                self._finish(-1, steps=self.steps - self._steps_left)

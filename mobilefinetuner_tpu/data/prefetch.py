@@ -76,9 +76,17 @@ class Prefetcher:
     def __init__(self, source: Iterable, depth: int = 2,
                  place_fn: Optional[Callable[[Any], Any]] = None,
                  lookahead: int = 1, rss_limit_mb: float = 0,
-                 rss_fn: Optional[Callable[[], Optional[float]]] = None):
+                 rss_fn: Optional[Callable[[], Optional[float]]] = None,
+                 tracer=None):
         if depth < 0:
             raise ValueError(f"depth must be >= 0, got {depth}")
+        # span tracing (core/trace.py, --trace_spans): each batch the
+        # producer thread assembles lands as a `span` on the "prefetch"
+        # track, so the exported timeline shows host batch assembly
+        # overlapping device steps — the overlap IS this module's
+        # claim, and the trace makes it visible instead of inferred
+        # from host_wait_ms
+        self._tracer = tracer
         self._place = place_fn if place_fn is not None else (lambda x: x)
         self._lookahead = max(lookahead, 0) if depth > 0 else 0
         self._buf: collections.deque = collections.deque()
@@ -155,16 +163,24 @@ class Prefetcher:
 
     def _produce(self, source):
         try:
+            import time as _time
             it = iter(source)
+            n = 0
             while True:
                 self._shed_on_rss()
                 if self._stop.is_set():
                     return
+                t0 = _time.perf_counter()
                 try:
                     item = next(it)
                 except StopIteration:
                     self._put(_DONE)
                     return
+                if self._tracer is not None:
+                    self._tracer.emit_span(
+                        f"produce[{n}]", "prefetch", t0,
+                        (_time.perf_counter() - t0) * 1000.0)
+                n += 1
                 if not self._put(item):
                     return
         except BaseException as e:  # noqa: BLE001 — carried to the consumer

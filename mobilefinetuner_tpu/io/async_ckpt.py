@@ -142,9 +142,15 @@ class AsyncCheckpointer:
     """
 
     def __init__(self, enabled: bool = True,
-                 event_sink: Optional[Callable] = None):
+                 event_sink: Optional[Callable] = None, tracer=None):
         self.enabled = bool(enabled)
         self._sink = event_sink
+        # span tracing (core/trace.py, --trace_spans): each disk write
+        # lands as a `span` on the "ckpt" track — emitted from the
+        # writer THREAD, so the exported timeline shows the background
+        # write overlapping `step` time (the overlap is this module's
+        # whole point; the trace draws it)
+        self._tracer = tracer
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._pending: Optional[_SaveItem] = None
@@ -272,6 +278,9 @@ class AsyncCheckpointer:
         finally:
             item.done.set()
         write_ms = (time.perf_counter() - t0) * 1000.0
+        if self._tracer is not None:
+            self._tracer.emit_span(f"ckpt_write(step {item.step})",
+                                   "ckpt", t0, write_ms, step=item.step)
         nbytes = 0
         for p in paths:
             try:

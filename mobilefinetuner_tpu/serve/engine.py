@@ -115,6 +115,13 @@ class ServeConfig:
                                   # exception (fail in-flight, keep
                                   # serving) or "raise" after containing
     stats_every: int = 0      # serve_stats cadence (decode steps); 0=off
+    # --- observability (round 17, DESIGN.md §22) ---------------------
+    trace_spans: bool = False  # emit queue/prefill/decode `span` events
+                              # per request (track "req:<id>") into the
+                              # telemetry stream — tools/trace_export.py
+                              # renders a serve session as one Perfetto
+                              # timeline. Host-side only: span emission
+                              # can never cost a retrace.
     # --- memory admission (round 16, core/memory_guard.py) ----------
     hbm_cap_mb: int = 0       # capacity override MB; 0 = auto (the
                               # backend's bytes_limit, else the
@@ -373,6 +380,12 @@ class ServeEngine:
             lora_impl_resolved = impl_summary(
                 dims, S, rank, cfg.lora_impl, self.dtype.itemsize)
         self.telemetry = telemetry or Telemetry("")
+        # request-lifecycle span tracing (core/trace.py): queue/prefill/
+        # decode spans per request on its own "req:<id>" track. Pure
+        # host bookkeeping over stamps the engine already takes.
+        from mobilefinetuner_tpu.core.trace import Tracer
+        self.tracer = Tracer(self.telemetry.emit,
+                             enabled=cfg.trace_spans)
         self.telemetry.emit("run_start", **run_manifest({
             "serve_family": family, "num_slots": S,
             "block_T": cfg.block_T, "num_blocks": cfg.num_blocks,
@@ -439,6 +452,22 @@ class ServeEngine:
         req.finish_t = time.perf_counter()
         self.counts[state] += 1
         self._emit_request(req, phase=phase)
+        if self.tracer.enabled:
+            # the request's last span: decode for admitted requests
+            # (admit -> terminal; partial output from a timeout/error
+            # still shows its decode time), queue for ones that died
+            # waiting (reject/shed/queued-timeout never prefilled)
+            trk = f"req:{req.id}"
+            if req.admit_t:
+                self.tracer.emit_span(
+                    "decode", trk, req.admit_t,
+                    (req.finish_t - req.admit_t) * 1000.0, id=req.id,
+                    outcome=state)
+            else:
+                self.tracer.emit_span(
+                    "queue", trk, req.enqueue_t,
+                    (req.finish_t - req.enqueue_t) * 1000.0, id=req.id,
+                    outcome=state)
 
     # ------------------------------------------------------------ tenancy ---
     def load_adapter(self, name: str, source, verify: bool = True) -> int:
@@ -591,6 +620,7 @@ class ServeEngine:
         mask = np.zeros((1, cfg.max_prompt), np.int32)
         ids[0, :P], mask[0, :P] = req.prompt, 1
         bank_tree = self.bank.tree if self.bank else None
+        t_prefill = time.perf_counter()
         tok0, k, v = self._prefill(self.params, bank_tree,
                                    jnp.asarray(ids), jnp.asarray(mask),
                                    jnp.asarray([req.aid], jnp.int32))
@@ -608,6 +638,17 @@ class ServeEngine:
         tok0 = int(tok0)                 # host sync: the first token
         now = time.perf_counter()
         req.admit_t = req.first_token_t = now
+        if self.tracer.enabled:
+            # queue span closes where prefill begins; prefill span runs
+            # through the first-token host sync (both on the request's
+            # own track, stamps the engine already takes)
+            trk = f"req:{req.id}"
+            self.tracer.emit_span(
+                "queue", trk, req.enqueue_t,
+                (t_prefill - req.enqueue_t) * 1000.0, id=req.id)
+            self.tracer.emit_span(
+                "prefill", trk, t_prefill, (now - t_prefill) * 1000.0,
+                id=req.id)
         req.tokens.append(tok0)
         self._tok[slot], self._pos[slot] = tok0, P
         self._tbl[slot] = TRASH_BLOCK

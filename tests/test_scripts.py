@@ -121,6 +121,106 @@ def test_multihost_smoke_shards_merge_through_fleet_report(tmp_path):
     assert "STRAGGLER" in r.stdout and "skew" in r.stdout
 
 
+def test_trace_export_converts_two_host_fleet_fixture(tmp_path):
+    """Round-17 recipe guard: the simulated two-host shard set (the
+    same fixture the fleet_report test merges) converts to ONE
+    Perfetto-loadable trace-event file with a process row per host —
+    real subprocess invocations, like an operator would run."""
+    import json
+    import sys
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    base = str(tmp_path / "pod.jsonl")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "multihost_smoke.py"),
+         "--write_shards", base],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr
+    out = str(tmp_path / "pod.trace.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_export.py"),
+         base, "-o", out],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "perfetto" in r.stdout
+    trace = json.load(open(out))
+    evs = trace["traceEvents"]
+    assert evs
+    pids = {e["pid"] for e in evs}
+    assert pids == {0, 1}  # one process row per host
+    proc_names = {e["args"]["name"] for e in evs
+                  if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert proc_names == {"host 0 (coordinator)", "host 1"}
+    for e in evs:
+        assert e["ph"] in ("X", "i", "C", "M"), e
+
+
+def test_bench_compare_cli_gates_on_regression(tmp_path):
+    """Round-17 recipe guard: bench_compare diffs two artifacts as a
+    subprocess and exits nonzero past --threshold (the CI contract)."""
+    import json
+    import sys
+    old = tmp_path / "BENCH_old.json"
+    new = tmp_path / "BENCH_new.json"
+    old.write_text(json.dumps({"rows": [
+        {"config": "gpt2s_lora", "tokens_per_sec_per_chip": 100.0,
+         "peak_hbm_mb": 500.0}]}))
+    new.write_text(json.dumps({"rows": [
+        {"config": "gpt2s_lora", "tokens_per_sec_per_chip": 60.0,
+         "peak_hbm_mb": 480.0}]}))
+    cmd = [sys.executable, os.path.join(REPO, "tools", "bench_compare.py"),
+           str(old), str(new)]
+    r = subprocess.run(cmd + ["--threshold", "5"],
+                       capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 2, (r.stdout, r.stderr)
+    assert "REGRESSED" in r.stdout
+    # improvement-only diff passes the same gate
+    r = subprocess.run(
+        [cmd[0], cmd[1], str(new), str(old), "--threshold", "5"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    # --json is machine-readable
+    r = subprocess.run(cmd + ["--json"], capture_output=True, text=True,
+                       cwd=REPO)
+    assert r.returncode == 0
+    c = json.loads(r.stdout)
+    assert c["shared_rows"] == ["gpt2s_lora"]
+    assert not c["regressions"]  # no threshold -> report only
+
+
+def test_report_tools_format_json_matches_legacy_alias(tmp_path):
+    """Round-17 satellite: --format json on BOTH report tools goes
+    through one shared serializer; the legacy --json alias emits the
+    identical document."""
+    import json
+    import sys
+    from mobilefinetuner_tpu.core.telemetry import Telemetry
+    base = str(tmp_path / "run.jsonl")
+    with Telemetry(base) as tel:
+        tel.emit("run_start", jax_version="x", mesh_shape=None,
+                 process_count=1, process_index=0, device_kind="cpu",
+                 device_count=1, config={})
+        tel.emit("step_stats", step=1, loss=3.0, ema=3.0, lr=1e-4,
+                 grad_norm=0.5, step_time_ms=10.0, host_wait_ms=0.1,
+                 slept_ms=0.0, tok_s=100.0, mfu=None, param_norm=1.0,
+                 update_ratio=1e-3, nonfinite_count=0, skipped=0,
+                 hbm_mb=None, queue_depth=0, host_step_ms=None)
+        tel.emit("run_end", steps=1, wall_s=0.1, exit="ok",
+                 goodput=None)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    for tool in ("telemetry_report.py", "fleet_report.py"):
+        path = os.path.join(REPO, "tools", tool)
+        outs = {}
+        for flag in (["--format", "json"], ["--json"]):
+            r = subprocess.run([sys.executable, path, base] + flag,
+                               capture_output=True, text=True, cwd=REPO,
+                               env=env)
+            assert r.returncode == 0, (tool, flag, r.stderr)
+            outs[tuple(flag)] = json.loads(r.stdout)
+        assert outs[("--format", "json")] == outs[("--json",)], tool
+
+
 def test_plot_loss_runs_on_metrics_csv(tmp_path):
     import sys
     p = tmp_path / "m.csv"

@@ -90,6 +90,14 @@ REPRESENTATIVE = {
     "rollback": dict(step=8, reason="skip_streak", ok=True, to_step=6,
                      steps_lost=2, ckpt="/tmp/a_step6.safetensors",
                      data_offset=1, budget_left=1),
+    # round-17 live observability (DESIGN.md §22): one completed host
+    # span (monotonic t0 + duration on a named track; trace_export
+    # renders them) and one anomaly-triggered profiler capture
+    "span": dict(name="step", track="phase", t0=1234.567891,
+                 dur_ms=10.5),
+    "profile_capture": dict(step=12, trigger="slow_step",
+                            path="/tmp/run.jsonl.profiles/cap0",
+                            steps=2, budget_left=1),
     # round-13 elastic fleet (DESIGN.md §18): the drain marker and the
     # fleet controller's decision timeline
     "preempt": dict(step=7, signal="SIGTERM"),

@@ -1,0 +1,200 @@
+"""Diff two BENCH_*.json artifacts: the bench trajectory as a CI gate.
+
+Every round's bench capture lands as rows keyed by a `config` string
+(bench.py JSONL, BENCH_SERVE's {"rows": [...]}, BENCH_CKPT, the
+driver's {"tail": "<jsonl>"} wrapper — all four shapes load here).
+This tool matches rows by that key across two artifacts, prints the
+per-metric % delta for every shared numeric metric, and — with
+`--threshold P` — exits NONZERO when any direction-aware metric
+regressed by more than P percent, so "did this PR slow the bench" is a
+CI check instead of a human squinting at two JSON files.
+
+Direction is inferred from the metric name (throughput-ish names are
+higher-better, latency/memory-ish names lower-better, everything else
+informational — reported, never gated):
+
+  higher better:  *tok*_s*, *tokens_per_sec*, mfu*, req_s, mb_s
+  lower better:   *_ms (incl. nested ttft_ms.p50 etc.), *_mb, *stall*,
+                  *blocking*, *bytes*
+
+Nested dicts one level deep (the serve rows' ttft_ms/tpot_ms
+percentile dicts) are flattened to dotted keys.
+
+Usage:
+  python tools/bench_compare.py OLD.json NEW.json
+  python tools/bench_compare.py OLD.json NEW.json --threshold 5
+  python tools/bench_compare.py OLD.json NEW.json --json
+Exit codes: 0 = ok, 1 = usage/load error or no shared rows,
+2 = regression beyond --threshold.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_HIGHER = ("tokens_per_sec", "tok_s", "mfu", "req_s", "mb_s",
+           "productive_frac", "requests")
+_LOWER = ("_ms", "_mb", "stall", "blocking", "bytes", "elapsed_s",
+          "retraces")
+_SKIP = ("vs_baseline",)  # relative-to-moving-target noise
+
+
+def direction(name: str) -> int:
+    """+1 higher-better, -1 lower-better, 0 informational."""
+    n = name.lower()
+    if any(s in n for s in _SKIP):
+        return 0
+    if any(s in n for s in _HIGHER):
+        return +1
+    if any(s in n for s in _LOWER):
+        return -1
+    return 0
+
+
+def _flatten(row: dict) -> dict:
+    out = {}
+    for k, v in row.items():
+        if isinstance(v, dict):
+            for kk, vv in v.items():
+                if isinstance(vv, (int, float)) \
+                        and not isinstance(vv, bool):
+                    out[f"{k}.{kk}"] = float(vv)
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[k] = float(v)
+    return out
+
+
+def load_rows(path: str, key: str = "config") -> dict:
+    """{config: flattened numeric row} from any of the artifact shapes
+    this repo produces (rows list, bare list, driver tail wrapper,
+    plain JSONL)."""
+    with open(path) as f:
+        txt = f.read()
+    rows = []
+    try:
+        data = json.loads(txt)
+        if isinstance(data, list):
+            rows = data
+        elif isinstance(data, dict) and isinstance(data.get("rows"), list):
+            rows = data["rows"]
+        elif isinstance(data, dict) and isinstance(data.get("tail"), str):
+            # the driver's bench capture: rc/cmd wrapper whose tail is
+            # the benchmark's JSONL stdout
+            rows = [json.loads(ln) for ln in data["tail"].splitlines()
+                    if ln.strip().startswith("{")]
+        elif isinstance(data, dict):
+            rows = [data]
+    except json.JSONDecodeError:
+        # plain JSONL
+        for ln in txt.splitlines():
+            ln = ln.strip()
+            if ln.startswith("{"):
+                try:
+                    rows.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    continue
+    out = {}
+    for r in rows:
+        if isinstance(r, dict) and isinstance(r.get(key), str):
+            out[r[key]] = _flatten(r)
+    return out
+
+
+def compare(old: dict, new: dict, threshold: float = 0.0) -> dict:
+    """Row-matched per-metric deltas. A REGRESSION is a direction-aware
+    metric worse by more than `threshold` percent (threshold <= 0:
+    nothing gates, everything reports)."""
+    shared = sorted(set(old) & set(new))
+    rows = []
+    regressions = []
+    for cfg in shared:
+        o, n = old[cfg], new[cfg]
+        for metric in sorted(set(o) & set(n)):
+            ov, nv = o[metric], n[metric]
+            if ov == 0:
+                continue  # % delta undefined; absolute-only metrics skip
+            delta_pct = (nv - ov) / abs(ov) * 100.0
+            d = direction(metric)
+            worse_pct = -delta_pct * d if d else 0.0
+            regressed = bool(d and threshold > 0
+                             and worse_pct > threshold)
+            rows.append({"config": cfg, "metric": metric,
+                         "old": ov, "new": nv,
+                         "delta_pct": round(delta_pct, 3),
+                         "direction": {1: "higher", -1: "lower",
+                                       0: None}[d],
+                         "regressed": regressed})
+            if regressed:
+                regressions.append(rows[-1])
+    return {
+        "shared_rows": shared,
+        "only_old": sorted(set(old) - set(new)),
+        "only_new": sorted(set(new) - set(old)),
+        "threshold_pct": threshold,
+        "metrics": rows,
+        "regressions": regressions,
+    }
+
+
+def print_compare(c: dict) -> None:
+    if c["only_old"]:
+        print(f"rows only in OLD: {', '.join(c['only_old'])}")
+    if c["only_new"]:
+        print(f"rows only in NEW: {', '.join(c['only_new'])}")
+    cur = None
+    for m in c["metrics"]:
+        if m["config"] != cur:
+            cur = m["config"]
+            print(f"{cur}:")
+        arrow = {"higher": "^", "lower": "v", None: " "}[m["direction"]]
+        flag = "  REGRESSED" if m["regressed"] else ""
+        print(f"  {m['metric']:<28} {m['old']:>12.4g} -> "
+              f"{m['new']:>12.4g}  {m['delta_pct']:>+8.2f}% "
+              f"{arrow}{flag}")
+    if c["regressions"]:
+        print(f"\n{len(c['regressions'])} metric(s) regressed beyond "
+              f"{c['threshold_pct']:g}%")
+    elif c["threshold_pct"] > 0:
+        print(f"\nno regression beyond {c['threshold_pct']:g}% across "
+              f"{len(c['shared_rows'])} shared row(s)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two BENCH_*.json artifacts by config row")
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--key", default="config",
+                    help="row-matching key (default: config)")
+    ap.add_argument("--threshold", type=float, default=0.0,
+                    help="exit 2 when any direction-aware metric is "
+                         "worse by more than this percent (0 = report "
+                         "only)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable comparison instead of text")
+    args = ap.parse_args(argv)
+    try:
+        old = load_rows(args.old, key=args.key)
+        new = load_rows(args.new, key=args.key)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if not old or not new:
+        print(f"error: no keyed rows in "
+              f"{args.old if not old else args.new}", file=sys.stderr)
+        return 1
+    c = compare(old, new, threshold=args.threshold)
+    if not c["shared_rows"]:
+        print("error: no shared rows to compare", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(c, indent=1))
+    else:
+        print_compare(c)
+    return 2 if c["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,20 +19,22 @@ from __future__ import annotations
 
 import argparse
 import glob
-import json
 import os
 import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from telemetry_report import (_fmt, checkpoint_lines,  # noqa: E402
+from telemetry_report import (_fmt, add_format_flags,  # noqa: E402
+                              checkpoint_lines,
                               checkpoint_summary, controller_entries,
                               controller_lines, controller_summary,
-                              goodput_lines, hang_entries, hang_lines,
-                              load_events, memory_lines, memory_summary,
-                              percentile, recovery_lines,
-                              recovery_summary, split_latest_run,
-                              straggler_entries, straggler_lines)
+                              emit_output, goodput_lines, hang_entries,
+                              hang_lines, load_events, memory_lines,
+                              memory_summary, observability_lines,
+                              observability_summary, percentile,
+                              recovery_lines, recovery_summary,
+                              split_latest_run, straggler_entries,
+                              straggler_lines)
 
 from mobilefinetuner_tpu.core.telemetry import (controller_path,  # noqa: E402
                                                 partial_goodput)
@@ -97,6 +99,9 @@ def shard_summary(host: int, events: list, n_invalid: int) -> dict:
         # round-16 memory-admission rollup (shared builder): mem_check
         # verdicts (est vs cap) + degradation-ladder decisions
         "memory": memory_summary(scope),
+        # round-17 observability rollup (shared builder): span counts
+        # by track + anomaly-triggered profile captures
+        "observability": observability_summary(scope),
         "run_end": ({"steps": ends[-1]["steps"],
                      "wall_s": ends[-1]["wall_s"],
                      "exit": ends[-1]["exit"],
@@ -226,6 +231,8 @@ def print_fleet(s: dict):
             print(line)
         for line in recovery_lines(h0.get("recovery")):
             print(line)
+        for line in observability_lines(h0.get("observability")):
+            print(line)
     if s["hosts_missing_run_end"]:
         print(f"  hosts without run_end: {s['hosts_missing_run_end']}")
     for line in goodput_lines(s["goodput"]):  # one shared renderer
@@ -241,8 +248,7 @@ def main(argv=None) -> int:
     ap.add_argument("jsonl", help="coordinator stream (--telemetry_out "
                                   "base path; .host<k> shards are "
                                   "discovered next to it)")
-    ap.add_argument("--json", action="store_true",
-                    help="machine-readable summary instead of text")
+    add_format_flags(ap)
     args = ap.parse_args(argv)
     paths = discover_shards(args.jsonl)
     if not paths:
@@ -267,14 +273,8 @@ def main(argv=None) -> int:
             controller, _ = load_events(cpath)
         except OSError:
             controller = None
-    s = fleet_summary(shards, controller=controller)
-    try:
-        if args.json:
-            print(json.dumps(s, indent=1))
-        else:
-            print_fleet(s)
-    except BrokenPipeError:  # `fleet_report run.jsonl | head` is normal
-        pass
+    emit_output(fleet_summary(shards, controller=controller), args,
+                print_fleet)
     return 0
 
 

@@ -160,9 +160,18 @@ def build_engine(model: str, num_slots: int, block_T: int,
                  shed_policy: str = "reject",
                  on_step_error: str = "fail_active",
                  stats_every: int = 0, watchdog=None,
-                 hbm_cap_mb: int = 0, hbm_headroom: float = 0.1):
+                 hbm_cap_mb: int = 0, hbm_headroom: float = 0.1,
+                 trace_spans: bool = False, metrics_port: int = 0,
+                 metrics_addr: str = "127.0.0.1"):
     """model: gpt2s | gemma270m | tiny-gpt2 | tiny-gemma. The tiny
-    modes are the CPU contract/smoke path (tests/test_serve.py)."""
+    modes are the CPU contract/smoke path (tests/test_serve.py).
+
+    metrics_port > 0 serves the live OpenMetrics endpoint
+    (core/metrics_http.py) over the engine's telemetry emit path, with
+    /healthz riding engine.health(); the server lands on
+    `engine.metrics_server` (run_rows closes it). Everything is
+    host-side bookkeeping — a scrape can never cost a retrace
+    (tests/test_observability.py pins it under live load)."""
     from mobilefinetuner_tpu.core.config import GPT2Config, Gemma3TextConfig
     from mobilefinetuner_tpu.core.telemetry import Telemetry
     from mobilefinetuner_tpu.models import gemma3, gpt2
@@ -192,10 +201,26 @@ def build_engine(model: str, num_slots: int, block_T: int,
                       max_queue=max_queue, shed_policy=shed_policy,
                       on_step_error=on_step_error,
                       stats_every=stats_every,
-                      hbm_cap_mb=hbm_cap_mb, hbm_headroom=hbm_headroom)
+                      hbm_cap_mb=hbm_cap_mb, hbm_headroom=hbm_headroom,
+                      trace_spans=trace_spans)
+    tel = Telemetry(telemetry_out)
+    registry = None
+    if metrics_port > 0:
+        # observer attached BEFORE the engine builds, so run_start and
+        # the build-time mem_check land in the registry too
+        from mobilefinetuner_tpu.core.metrics_http import MetricsRegistry
+        registry = MetricsRegistry()
+        tel.add_observer(registry.observe)
     eng = ServeEngine(family, config, params, cfg, bank=bank,
-                      telemetry=Telemetry(telemetry_out),
-                      watchdog=watchdog)
+                      telemetry=tel, watchdog=watchdog)
+    eng.metrics_server = None
+    if registry is not None:
+        from mobilefinetuner_tpu.core.metrics_http import MetricsServer
+        eng.metrics_server = MetricsServer(
+            registry, port=metrics_port, addr=metrics_addr,
+            health_fn=eng.health)
+        print(f"metrics endpoint: http://{eng.metrics_server.addr}:"
+              f"{eng.metrics_server.port}/metrics (+ /healthz)")
     if adapters:
         for n, t in zip(names, trees):
             eng.load_adapter(n, t)
@@ -299,7 +324,9 @@ def run_rows(model: str, rates, n_requests: int, adapters: int,
              on_step_error: str = "fail_active", deadline_ms=None,
              stats_every: int = 0, inject: str = "", drain: bool = True,
              watchdog_mode: int = 0, watchdog_min_s: float = 60.0,
-             hbm_cap_mb: int = 0, hbm_headroom: float = 0.1) -> list:
+             hbm_cap_mb: int = 0, hbm_headroom: float = 0.1,
+             trace_spans: bool = False, metrics_port: int = 0,
+             metrics_addr: str = "127.0.0.1") -> list:
     """One engine, one warmup request, then one row per offered rate.
     `drain` arms the SIGTERM PreemptionGuard; `inject` fires its fault
     during the FIRST rate's run (the spec names an absolute decode
@@ -318,7 +345,10 @@ def run_rows(model: str, rates, n_requests: int, adapters: int,
                               on_step_error=on_step_error,
                               stats_every=stats_every, watchdog=wd,
                               hbm_cap_mb=hbm_cap_mb,
-                              hbm_headroom=hbm_headroom)
+                              hbm_headroom=hbm_headroom,
+                              trace_spans=trace_spans,
+                              metrics_port=metrics_port,
+                              metrics_addr=metrics_addr)
     if wd is not None:
         wd.on_hang = lambda p: eng.telemetry.emit("hang", **p)
         wd.stacks_file = (eng.telemetry.path + ".stacks"
@@ -385,6 +415,8 @@ def run_rows(model: str, rates, n_requests: int, adapters: int,
     finally:
         if wd is not None:
             wd.stop()
+        if getattr(eng, "metrics_server", None) is not None:
+            eng.metrics_server.close()
         eng.close()
     if fired is not None and not fired:
         # the armed fault never triggered (step already consumed by
@@ -451,6 +483,21 @@ def main(argv=None) -> int:
     ap.add_argument("--stats_every", type=int, default=0,
                     help="emit a serve_stats health snapshot every N "
                          "decode steps (0 = off)")
+    # --- live observability (round 17, DESIGN.md §22) -----------------
+    ap.add_argument("--trace_spans", type=int, default=0, choices=[0, 1],
+                    help="1 = emit per-request queue/prefill/decode "
+                         "`span` events (track req:<id>) into the "
+                         "telemetry stream; tools/trace_export.py "
+                         "renders the session as one Perfetto timeline")
+    ap.add_argument("--metrics_port", type=int, default=0,
+                    help="serve a live OpenMetrics /metrics endpoint + "
+                         "/healthz (engine.health()) on this port, fed "
+                         "from the engine's telemetry emit path "
+                         "(core/metrics_http.py); scraping can never "
+                         "cost a retrace. 0 = off")
+    ap.add_argument("--metrics_addr", default="127.0.0.1",
+                    help="bind address for --metrics_port (loopback by "
+                         "default)")
     ap.add_argument("--inject", default="",
                     help="fault harness: step_error:<n> | hang:<n>[:<s>]"
                          " | slow_step:<n>:<ms> | adapter_load_fail")
@@ -486,7 +533,10 @@ def main(argv=None) -> int:
                     watchdog_mode=args.watchdog,
                     watchdog_min_s=args.watchdog_min_s,
                     hbm_cap_mb=args.hbm_cap_mb,
-                    hbm_headroom=args.hbm_headroom)
+                    hbm_headroom=args.hbm_headroom,
+                    trace_spans=bool(args.trace_spans),
+                    metrics_port=args.metrics_port,
+                    metrics_addr=args.metrics_addr)
     if args.out:
         art = {"device": jax.devices()[0].device_kind,
                "jax": jax.__version__, "rows": []}

@@ -139,6 +139,7 @@ def summarize(events, n_invalid=0) -> dict:
         "checkpoints": checkpoint_summary(scope),
         "recovery": recovery_summary(scope),
         "memory": memory_summary(scope),
+        "observability": observability_summary(scope),
         "requests": request_summary(scope),
         "serve": serve_stats_summary(scope),
         "stragglers": straggler_entries(scope),
@@ -316,6 +317,51 @@ def memory_lines(m) -> list:
                if d.get("est_mb") else "")
             + (f" @ step {d['step']}" if d.get("step") is not None
                else " @ preflight"))
+    return lines
+
+
+def observability_summary(events) -> dict:
+    """Roll up the round-17 live-observability events (DESIGN.md §22):
+    span count by track (the timeline's shape at a glance — the spans
+    themselves belong in tools/trace_export.py, not a text report) and
+    every anomaly-triggered `profile_capture` with its trigger and
+    on-disk path. None when the stream carries neither — ONE builder
+    shared with tools/fleet_report.py like the other sections."""
+    spans = [e for e in events if e.get("event") == "span"]
+    caps = [e for e in events if e.get("event") == "profile_capture"]
+    if not (spans or caps):
+        return None
+    by_track = {}
+    for s in spans:
+        by_track[s["track"]] = by_track.get(s["track"], 0) + 1
+    return {
+        "spans": len(spans),
+        "span_tracks": by_track,
+        "profile_captures": [{"step": c["step"],
+                              "trigger": c["trigger"],
+                              "path": c["path"],
+                              "budget_left": c.get("budget_left")}
+                             for c in caps],
+    }
+
+
+def observability_lines(o) -> list:
+    """Render an observability_summary (shared with fleet_report)."""
+    if not o:
+        return []
+    lines = []
+    if o["spans"]:
+        tracks = ", ".join(f"{k} {v}" for k, v in
+                           sorted(o["span_tracks"].items())[:6])
+        more = len(o["span_tracks"]) - 6
+        lines.append(f"  spans: {o['spans']} across "
+                     f"{len(o['span_tracks'])} track(s) ({tracks}"
+                     + (f", +{more} more" if more > 0 else "") + ")"
+                     + " — export with tools/trace_export.py")
+    for c in o["profile_captures"]:
+        lines.append(f"  PROFILE CAPTURED @ step {c['step']} "
+                     f"({c['trigger']}): {c['path']} "
+                     f"(budget left {c['budget_left']})")
     return lines
 
 
@@ -631,6 +677,8 @@ def print_summary(s: dict):
         print(line)
     for line in recovery_lines(s.get("recovery")):
         print(line)
+    for line in observability_lines(s.get("observability")):
+        print(line)
     for line in request_lines(s.get("requests")):
         print(line)
     for line in serve_stats_lines(s.get("serve")):
@@ -656,11 +704,37 @@ def print_summary(s: dict):
                 print(line)
 
 
+def add_format_flags(ap: argparse.ArgumentParser) -> None:
+    """--format {text,json} (+ the legacy --json alias), shared by both
+    report tools so the output contract cannot drift between them."""
+    ap.add_argument("--format", choices=["text", "json"], default="text",
+                    help="'json' = machine-readable summary (the same "
+                         "section builders the text report renders — "
+                         "dashboards and CI consume the numbers humans "
+                         "read)")
+    ap.add_argument("--json", action="store_true",
+                    help="alias for --format json (kept for existing "
+                         "callers)")
+
+
+def emit_output(summary: dict, args, text_printer) -> None:
+    """ONE serializer for both report tools: the summary dict the
+    section builders assembled is either json.dumps'd verbatim or
+    handed to the tool's text printer — the JSON output IS the text
+    report's input, so the two can never disagree."""
+    try:
+        if args.json or args.format == "json":
+            print(json.dumps(summary, indent=1))
+        else:
+            text_printer(summary)
+    except BrokenPipeError:  # `report run.jsonl | head` is a normal use
+        pass
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("jsonl", help="telemetry stream (--telemetry_out)")
-    ap.add_argument("--json", action="store_true",
-                    help="machine-readable summary instead of text")
+    add_format_flags(ap)
     args = ap.parse_args(argv)
     try:
         events, bad = load_events(args.jsonl)
@@ -671,14 +745,7 @@ def main(argv=None) -> int:
         print(f"error: no valid telemetry events in {args.jsonl}",
               file=sys.stderr)
         return 1
-    s = summarize(events, bad)
-    try:
-        if args.json:
-            print(json.dumps(s, indent=1))
-        else:
-            print_summary(s)
-    except BrokenPipeError:  # `report run.jsonl | head` is a normal use
-        pass
+    emit_output(summarize(events, bad), args, print_summary)
     return 0
 
 

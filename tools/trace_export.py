@@ -1,0 +1,394 @@
+"""Telemetry stream(s) -> ONE Perfetto/Chrome-trace-event timeline.
+
+A fleet run (or serve session) leaves per-host JSONL telemetry shards;
+this tool converts a coordinator stream — plus every `.host<k>` shard
+found next to it, plus optionally a `jax.profiler` device trace — into
+a single trace-event JSON file that opens in ui.perfetto.dev (or
+chrome://tracing): one PROCESS row per host, one THREAD row per track
+("phase" for the goodput buckets, "ckpt" for the async writer thread,
+"prefetch" for the producer, "req:<id>" per serve request), counter
+tracks for loss/tok_s/queue depth, and instant markers for every
+incident event (anomaly, straggler, hang, rollback, degrade, preempt,
+profile_capture, over-capacity mem_check).
+
+Clock discipline: `span` events carry a MONOTONIC t0 (time.perf_counter,
+the envelope's `t_mono` clock). Each host's monotonic clock is placed
+on the wall timeline via the median (t - t_mono) offset over its own
+records — NTP steps move wall time, never a span's duration or its
+position relative to its host's other spans. Streams that predate
+`t_mono` still convert (instants and counters use wall `t`; they carry
+no spans to place).
+
+Reconciliation: with `--trace_spans` the goodput meter emits one span
+per phase segment from the SAME transitions that charge the run_end
+buckets, so per-phase span sums match `run_end.goodput` by
+construction — the tool prints the check (and `phase_reconcile` is the
+test's oracle).
+
+Device-trace merge (`--profile DIR|FILE`): jax.profiler writes a
+Chrome-trace `*.trace.json.gz` under its log dir; its events are
+appended under their own process rows. Alignment is BEST-EFFORT (the
+profiler's clock zero is its own): the profiler timeline is shifted so
+its start coincides with the stream's first `profile_capture` event
+when one exists, else with the stream's start.
+
+Usage:
+  python tools/trace_export.py run.jsonl -o trace.json
+  python tools/trace_export.py run.jsonl --profile prof_dir -o all.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as globmod
+import gzip
+import json
+import os
+import statistics
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root
+sys.path.insert(0, _HERE)                   # sibling tools
+
+from fleet_report import discover_shards          # noqa: E402
+from telemetry_report import load_events          # noqa: E402
+
+# incident events rendered as instant markers (name rule per event)
+_INSTANT_EVENTS = ("anomaly", "straggler", "hang", "preempt", "rollback",
+                   "degrade", "mem_check", "ckpt_verify",
+                   "profile_capture", "throttle", "ckpt_dropped")
+
+# step_stats fields rendered as counter tracks
+_COUNTERS = ("loss", "tok_s", "queue_depth", "hbm_mb", "step_time_ms")
+
+
+def latest_run(events):
+    """Slice one shard's events to its LATEST run (from the last
+    run_start onward; the whole stream when none). A resumed stream
+    appends runs from different processes, whose perf_counter epochs
+    share nothing — one median (t - t_mono) offset over both would
+    misplace the minority run's spans by the epoch gap, and summing
+    both runs' phase spans against the final run_end's buckets would
+    report the by-construction identity as violated on a healthy
+    resumed run. One timeline = one run (the same latest-run scoping
+    rule the report tools apply to truncated streams)."""
+    idx = max((i for i, e in enumerate(events)
+               if e.get("event") == "run_start"), default=-1)
+    return events[idx:] if idx > 0 else events
+
+
+def mono_offset(events):
+    """Median wall-minus-monotonic offset for one host's records: maps
+    a span's monotonic t0 onto the wall timeline. None when the stream
+    predates t_mono."""
+    ds = [e["t"] - e["t_mono"] for e in events
+          if isinstance(e.get("t_mono"), (int, float))
+          and isinstance(e.get("t"), (int, float))]
+    return statistics.median(ds) if ds else None
+
+
+def _instant_name(e) -> str:
+    ev = e["event"]
+    if ev == "anomaly":
+        return f"anomaly:{e.get('kind')}"
+    if ev == "straggler":
+        return f"straggler:host{e.get('slow_host')}"
+    if ev == "mem_check":
+        return f"mem_check:{e.get('verdict')}"
+    if ev == "ckpt_verify":
+        return ("ckpt_verify:ok" if e.get("ok")
+                else "ckpt_verify:REJECTED")
+    if ev == "rollback":
+        return f"rollback:{e.get('reason')}"
+    if ev == "degrade":
+        return f"degrade:{e.get('rung')}"
+    if ev == "profile_capture":
+        return f"profile_capture:{e.get('trigger')}"
+    return ev
+
+
+def _span_args(e) -> dict:
+    skip = {"event", "seq", "t", "t_mono", "host", "name", "track",
+            "t0", "dur_ms"}
+    return {k: v for k, v in e.items() if k not in skip}
+
+
+def host_trace_events(host, events, t_base):
+    """One host's trace events (ts in us relative to t_base). Returns
+    (trace_events, track_names_seen)."""
+    out = []
+    off = mono_offset(events)
+    tracks = {}  # track name -> tid
+
+    def tid_for(track):
+        if track not in tracks:
+            # stable, readable ordering: phase first, then the engine
+            # threads, request tracks in arrival order after
+            tracks[track] = len(tracks) + 1
+        return tracks[track]
+
+    spans = [e for e in events if e["event"] == "span"]
+    have = {e.get("track", "") for e in spans}
+    for e in spans:
+        if off is None:
+            continue  # no clock bridge: a pre-t_mono stream has no
+            # spans anyway (same round introduced both)
+        wall = e["t0"] + off
+        out.append({
+            "ph": "X", "pid": host, "tid": tid_for(e["track"]),
+            "ts": round((wall - t_base) * 1e6, 3),
+            "dur": round(e["dur_ms"] * 1000.0, 3),
+            "name": e["name"], "cat": "span", "args": _span_args(e),
+        })
+    # requests: if the engine did not trace spans (trace_spans off),
+    # synthesize queue/decode spans from the request lifecycle events
+    # the stream always carries — wall-clock precision, same tracks
+    if not any(t.startswith("req:") for t in have):
+        reqs = {}
+        for e in events:
+            if e["event"] == "request":
+                reqs.setdefault(e["id"], []).append(e)
+        for rid, recs in sorted(reqs.items()):
+            by_phase = {r["phase"]: r for r in recs}
+            enq = by_phase.get("enqueue")
+            admit = by_phase.get("admit")
+            term = next((r for r in recs
+                         if r["phase"] in ("finish", "cancel", "reject",
+                                           "timeout", "error")), None)
+            track = f"req:{rid}"
+            if enq and admit:
+                out.append({
+                    "ph": "X", "pid": host, "tid": tid_for(track),
+                    "ts": round((enq["t"] - t_base) * 1e6, 3),
+                    "dur": round(max(admit["t"] - enq["t"], 0) * 1e6, 3),
+                    "name": "queue", "cat": "request",
+                    "args": {"id": rid}})
+            if admit and term:
+                out.append({
+                    "ph": "X", "pid": host, "tid": tid_for(track),
+                    "ts": round((admit["t"] - t_base) * 1e6, 3),
+                    "dur": round(max(term["t"] - admit["t"], 0) * 1e6, 3),
+                    "name": "decode", "cat": "request",
+                    "args": {"id": rid, "outcome": term["phase"],
+                             "new_tokens": term.get("new_tokens")}})
+    # checkpoint writes: derive write spans from the checkpoint events
+    # (emitted at write END with write_ms) when the writer wasn't traced
+    if "ckpt" not in have:
+        for e in events:
+            if e["event"] == "checkpoint" and e.get("write_ms"):
+                t_end = e["t"]
+                out.append({
+                    "ph": "X", "pid": host, "tid": tid_for("ckpt"),
+                    "ts": round((t_end - e["write_ms"] / 1000.0
+                                 - t_base) * 1e6, 3),
+                    "dur": round(e["write_ms"] * 1000.0, 3),
+                    "name": f"ckpt_write(step {e['step']})",
+                    "cat": "checkpoint",
+                    "args": {"step": e["step"], "bytes": e.get("bytes"),
+                             "async": e.get("async")}})
+    # instants: every incident event is a marker on its host's row
+    for e in events:
+        if e["event"] in _INSTANT_EVENTS:
+            if e["event"] == "mem_check" and e.get("verdict") == "ok":
+                continue  # a clean preflight is not an incident
+            out.append({
+                "ph": "i", "pid": host, "tid": tid_for("events"),
+                "ts": round((e["t"] - t_base) * 1e6, 3), "s": "p",
+                "name": _instant_name(e), "cat": e["event"],
+                "args": {k: v for k, v in e.items()
+                         if k not in ("event", "seq", "t", "t_mono",
+                                      "host")}})
+    # counters: the step_stats trend lines, drawable next to the spans
+    for e in events:
+        if e["event"] == "step_stats":
+            ts = round((e["t"] - t_base) * 1e6, 3)
+            for f in _COUNTERS:
+                v = e.get(f)
+                if isinstance(v, (int, float)):
+                    out.append({"ph": "C", "pid": host, "tid": 0,
+                                "ts": ts, "name": f,
+                                "args": {f: round(float(v), 4)}})
+        elif e["event"] == "serve_stats":
+            ts = round((e["t"] - t_base) * 1e6, 3)
+            for f in ("queue_depth", "active", "free_blocks"):
+                v = e.get(f)
+                if isinstance(v, (int, float)):
+                    out.append({"ph": "C", "pid": host, "tid": 0,
+                                "ts": ts, "name": f"serve_{f}",
+                                "args": {f: round(float(v), 4)}})
+    # metadata: name the process and thread rows
+    meta = [{"ph": "M", "pid": host, "name": "process_name",
+             "args": {"name": f"host {host}"
+                      + (" (coordinator)" if host == 0 else "")}},
+            {"ph": "M", "pid": host, "name": "process_sort_index",
+             "args": {"sort_index": host}}]
+    for track, tid in tracks.items():
+        meta.append({"ph": "M", "pid": host, "tid": tid,
+                     "name": "thread_name", "args": {"name": track}})
+    return meta + out, set(tracks)
+
+
+def find_profiler_trace(path):
+    """Locate a jax.profiler Chrome trace: the path itself when it is a
+    .json/.json.gz file, else the newest *.trace.json.gz under it."""
+    if os.path.isfile(path):
+        return path
+    hits = sorted(globmod.glob(os.path.join(
+        globmod.escape(path), "**", "*.trace.json.gz"), recursive=True),
+        key=os.path.getmtime)
+    return hits[-1] if hits else None
+
+
+def load_profiler_events(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8", errors="replace") as f:
+        data = json.load(f)
+    evs = data.get("traceEvents", data) or []
+    return [e for e in evs if isinstance(e, dict)]
+
+
+def merge_profiler(trace_events, prof_events, anchor_us):
+    """Append the device trace under its own process rows (pids offset
+    by 9000), shifted so its earliest timestamp lands at `anchor_us` —
+    best-effort alignment (the profiler's epoch is its own)."""
+    ts0 = min((e["ts"] for e in prof_events
+               if isinstance(e.get("ts"), (int, float))), default=0.0)
+    out = []
+    for e in prof_events:
+        e = dict(e)
+        if isinstance(e.get("pid"), int):
+            e["pid"] = 9000 + e["pid"]
+        else:
+            e["pid"] = 9000
+        if isinstance(e.get("ts"), (int, float)):
+            e["ts"] = round(e["ts"] - ts0 + anchor_us, 3)
+        out.append(e)
+    trace_events.extend(out)
+
+
+def phase_sums(trace, pid: int = 0) -> dict:
+    """Per-name sums (seconds) over ONE host's goodput-phase spans —
+    the reconciliation oracle the acceptance test compares against
+    that host's run_end.goodput. Scoped to a single pid: each host
+    runs its own GoodputMeter, so summing phase spans across a fleet
+    against one host's buckets would report the by-construction
+    identity as violated on a perfectly healthy run."""
+    sums = {}
+    for e in trace["traceEvents"]:
+        if e.get("ph") == "X" and e.get("cat") == "span" \
+                and e.get("pid") == pid \
+                and e.get("name") and "dur" in e:
+            # phase spans carry bucket names; other span tracks carry
+            # names outside the bucket set, so keying by name is safe
+            sums[e["name"]] = sums.get(e["name"], 0.0) \
+                + e["dur"] / 1e6
+    return sums
+
+
+def phase_reconcile(trace, goodput, pid: int = 0) -> dict:
+    """{bucket: (span_sum_s, bucket_s, abs_delta_s)} for every goodput
+    bucket the trace carries spans for, scoped to `pid`'s host."""
+    sums = phase_sums(trace, pid=pid)
+    out = {}
+    for k, v in (goodput or {}).items():
+        if not k.endswith("_s") or k == "total_s":
+            continue
+        b = k[:-2]
+        if b in sums:
+            out[b] = (round(sums[b], 4), v, round(abs(sums[b] - v), 4))
+    return out
+
+
+def export(shards, profile=None) -> dict:
+    """shards: {host: events}. Returns the trace-event JSON dict.
+    Each shard is scoped to its latest run first (see latest_run)."""
+    shards = {h: latest_run(evs) for h, evs in shards.items()}
+    all_events = [e for evs in shards.values() for e in evs]
+    t_base = min((e["t"] for e in all_events
+                  if isinstance(e.get("t"), (int, float))), default=0.0)
+    trace_events = []
+    for host, events in sorted(shards.items()):
+        evs, _tracks = host_trace_events(host, events, t_base)
+        trace_events.extend(evs)
+    if profile:
+        caps = [e for e in all_events
+                if e["event"] == "profile_capture"]
+        anchor = ((caps[0]["t"] - t_base) * 1e6) if caps else 0.0
+        merge_profiler(trace_events, profile, anchor)
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms",
+            "otherData": {"source": "mobilefinetuner_tpu trace_export",
+                          "hosts": len(shards),
+                          "t_base_unix": t_base}}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="telemetry stream(s) -> Perfetto trace-event JSON")
+    ap.add_argument("jsonl", help="telemetry stream (--telemetry_out "
+                                  "base path; .host<k> shards are "
+                                  "discovered and merged)")
+    ap.add_argument("-o", "--out", default="",
+                    help="output file (default: <stream>.trace.json)")
+    ap.add_argument("--profile", default="",
+                    help="jax.profiler log dir (or trace.json[.gz]) to "
+                         "merge as device-trace process rows")
+    args = ap.parse_args(argv)
+    paths = discover_shards(args.jsonl)
+    if not paths:
+        print(f"error: no telemetry shards at {args.jsonl}",
+              file=sys.stderr)
+        return 1
+    shards, n_bad = {}, 0
+    for h, p in sorted(paths.items()):
+        try:
+            events, bad = load_events(p)
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        n_bad += bad
+        if events:
+            shards[h] = events
+    if not shards:
+        print(f"error: no valid telemetry events in "
+              f"{sorted(paths.values())}", file=sys.stderr)
+        return 1
+    prof = None
+    if args.profile:
+        found = find_profiler_trace(args.profile)
+        if found is None:
+            print(f"error: no *.trace.json.gz under {args.profile}",
+                  file=sys.stderr)
+            return 1
+        prof = load_profiler_events(found)
+        print(f"device trace: {found} ({len(prof)} events)")
+    trace = export(shards, profile=prof)
+    out = args.out or (args.jsonl + ".trace.json")
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(trace, f)
+    os.replace(tmp, out)
+    n_span = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+    print(f"{out}: {len(trace['traceEvents'])} trace events "
+          f"({n_span} spans) from {len(shards)} host shard(s)"
+          + (f", {n_bad} invalid lines skipped" if n_bad else "")
+          + " — open in ui.perfetto.dev")
+    # reconciliation check: the COORDINATOR's phase-span sums vs its
+    # run_end goodput buckets (the acceptance identity; per-host by
+    # construction, so the comparison is scoped to pid 0)
+    ends = [e for e in shards.get(0, []) if e["event"] == "run_end"
+            and isinstance(e.get("goodput"), dict)]
+    if ends:
+        rec = phase_reconcile(trace, ends[-1]["goodput"], pid=0)
+        if rec:
+            total = ends[-1]["goodput"].get("total_s") or 0.0
+            worst = max(d for _, _, d in rec.values())
+            print(f"goodput reconciliation over {len(rec)} bucket(s): "
+                  f"max |span_sum - bucket| = {worst:.4f}s"
+                  + (f" ({100 * worst / total:.2f}% of total)"
+                     if total else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

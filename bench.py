@@ -381,6 +381,133 @@ def bench_gemma_lora(B, S, dtype, accum=1, offload=False, steps=20,
     return r
 
 
+def bench_multitenant(dtype, steps, k=8, model="gpt2", B_per=2, S=128,
+                      size="small", ref_step_ms=None):
+    """Multi-tenant LoRA rows (round 18, DESIGN.md §23): k independent
+    adapter jobs through ONE fused train step — stacked [k, r, d] bank,
+    ids-routed `_multi_lora` forward, per-slot Adam/LR/clip
+    (train/trainer.make_multi_train_step). Each tenant contributes B_per
+    rows per step, so the k sweep holds PER-TENANT work constant and
+    step_time-vs-k is the fusion claim (LoRAFusion: the memory-bound
+    LoRA step has compute headroom for k jobs — near-flat step time).
+    Aggregate tokens/s/chip counts every tenant's rows. ref_step_ms:
+    the family's k=1 step time, for the step_time_vs_k1 column."""
+    from mobilefinetuner_tpu.lora.lora import stack_adapters
+    from mobilefinetuner_tpu.ops.loss import lm_cross_entropy_rows
+    from mobilefinetuner_tpu.optim.adam import init_multi_state
+    from mobilefinetuner_tpu.train.trainer import make_multi_train_step
+    if model == "gemma":
+        config = Gemma3TextConfig.gemma3_270m() if size != "tiny" else \
+            Gemma3TextConfig.tiny(vocab_size=211)
+        params = gemma3.init_params(config, jax.random.PRNGKey(0))
+        fwd = gemma3.forward
+        spec = LoRASpec(rank=8, alpha=32.0, targets="full", init="peft")
+        init_fn, n_layer, n_head, head_dim = (
+            init_lora_gemma3, config.num_hidden_layers,
+            config.num_attention_heads, config.head_dim)
+    else:
+        base = {"small": GPT2Config.gpt2_small,
+                "tiny": GPT2Config.tiny}[size]()
+        config = base
+        params = gpt2.init_params(config, jax.random.PRNGKey(0))
+        fwd = gpt2.forward
+        spec = LoRASpec(rank=8, alpha=16.0)
+        init_fn, n_layer, n_head, head_dim = (
+            init_lora_gpt2, config.n_layer, config.n_head,
+            config.head_dim)
+    bank = stack_adapters([init_fn(config, spec, jax.random.PRNGKey(i))
+                           for i in range(k)])
+    mask = trainable_mask(bank)
+    tc = TrainConfig(total_steps=1000, lr=2e-4, schedule="constant",
+                     warmup_ratio=0.0)
+
+    def loss_rows(tr, p, mb):
+        from mobilefinetuner_tpu.lora.lora import assign_adapters
+        routed = assign_adapters(tr, mb["adapter_ids"])
+        logits = fwd(config, p, mb["input_ids"],
+                     attention_mask=mb["attention_mask"], lora=routed,
+                     compute_dtype=dtype)
+        return lm_cross_entropy_rows(logits, mb["labels"])
+
+    step_fn = make_multi_train_step(loss_rows, tc, k, mask=mask)
+    opt = init_multi_state(bank, tc.adam(), k, mask)
+    sched = {"step": jnp.zeros(k, jnp.int32),
+             "total": jnp.full(k, 1000.0, jnp.float32),
+             "lr": jnp.full(k, 2e-4, jnp.float32),
+             "warmup_ratio": jnp.zeros(k, jnp.float32),
+             "active": jnp.ones(k, bool)}
+    ids = jnp.asarray(np.repeat(np.arange(k, dtype=np.int32), B_per))
+    # the shared loss-mark/eval-probe protocol (measure()): train to
+    # LOSS_MARK_TOKENS on the seeded stream, read held-out loss on the
+    # shared EVAL_SEED batch — the loss column stays comparable across
+    # rows (and across `steps` settings), like every other row
+    tokens_per_step = k * B_per * S
+    mark = _loss_mark(tokens_per_step)
+    warm = max(0, WARMUP_STEPS - mark)
+    batches = synth_stream(config.vocab_size, k * B_per, S,
+                           mark + warm + steps)
+    eval_batch = synth_batch(config.vocab_size, k * B_per, S,
+                             seed=EVAL_SEED)
+    for b in batches + [eval_batch]:
+        b["adapter_ids"] = ids
+    from mobilefinetuner_tpu.core.xla_stats import compiled_peak_bytes
+    compiled = step_fn.lower(bank, params, opt, batches[0],
+                             sched).compile()
+    peak = compiled_peak_bytes(compiled)
+    tr, op = bank, opt
+
+    def advance(tr, op, batch, sched):
+        tr, op, m = compiled(tr, params, op, batch, sched)
+        return tr, op, m, dict(sched, step=sched["step"] + 1)
+
+    for s in range(mark):
+        tr, op, m, sched = advance(tr, op, batches[s], sched)
+    # held-out probe: the step's loss metric reads the CURRENT weights
+    # pre-update (its outputs must become the live state — donation);
+    # aggregate = token-weighted mean over the k slots
+    tr, op, m, sched = advance(tr, op, eval_batch, sched)
+    l_k = np.asarray(m["loss"], np.float64)
+    w_k = np.asarray(m["tokens"], np.float64)
+    loss = float((l_k * w_k).sum() / max(w_k.sum(), 1.0))
+    for s in range(warm):
+        tr, op, m, sched = advance(tr, op, batches[mark + s], sched)
+    if warm:
+        float(np.asarray(m["loss"])[0])
+    t0 = time.perf_counter()
+    for s in range(steps):
+        tr, op, m, sched = advance(tr, op, batches[mark + warm + s],
+                                   sched)
+    np.asarray(m["loss"])  # host sync closes the timed window
+    dt = time.perf_counter() - t0
+    n_frozen = sum(x.size for x in jax.tree.leaves(params))
+    # MFU numerator: each token routes through exactly ONE adapter, so
+    # the active-param term is one adapter's factors, not the k-slot
+    # bank (charging the whole bank would inflate MFU with k)
+    n_active = sum(int(x.size) for x in jax.tree.leaves(bank)) // k
+    return {"dt": dt, "loss": loss, "peak_bytes": peak,
+            "k": k, "tokens": tokens_per_step,
+            "flops": transformer_flops(n_active, n_frozen, k * B_per, S,
+                                       n_layer, n_head, head_dim,
+                                       full_ft=False),
+            "ref_step_ms": ref_step_ms,
+            "loss_tokens_seen": mark * tokens_per_step}
+
+
+def mt_finish(name, r, dtype, steps) -> dict:
+    """Row schema for the multitenant sweep: the base finish() columns
+    plus k, step_time_ms, and step_time_vs_k1 — the LoRAFusion target
+    is step_time_vs_k1 staying near 1.0 as k grows (near-flat step time
+    while aggregate tokens/s scales with k)."""
+    row = finish(name, r, dtype, steps)
+    step_ms = r["dt"] / steps * 1000.0
+    row["k"] = r["k"]
+    row["step_time_ms"] = round(step_ms, 2)
+    row["step_time_vs_k1"] = (round(step_ms / r["ref_step_ms"], 3)
+                              if r.get("ref_step_ms")
+                              else (1.0 if r["k"] == 1 else None))
+    return row
+
+
 def _pipeline_corpus(path: str, n_lines: int = 8000, seed: int = 0):
     """Synthetic WikiText-shaped corpus for the input-pipeline rows."""
     rng = np.random.default_rng(seed)
@@ -888,6 +1015,23 @@ def main():
                 run(f"gemma270m_lora_bf16_S{s_len}_lora{li}",
                     bench_gemma_lora, bf16, gsteps,
                     B=max(b_sz // 2, 2), S=s_len, lora_impl=li)
+        # multi-tenant LoRA rows (r18, DESIGN.md §23): k adapter jobs
+        # through ONE fused train step, per-tenant work held constant —
+        # step_time_vs_k1 near 1.0 while aggregate tokens/s scales with
+        # k is the LoRAFusion claim (the memory-bound LoRA step has
+        # compute headroom for k jobs). k=32 GPT-2s at B_per=2 keeps
+        # the peak under the fused-CE temps ceiling.
+        mt_ref = {}
+        for fam, mt_kw in (("gpt2s", dict(model="gpt2", B_per=2, S=S)),
+                           ("gemma270m", dict(model="gemma", B_per=2,
+                                              S=GS))):
+            for kk in (1, 8, 32):
+                row = run(f"{fam}_multitenant_k{kk}_bf16",
+                          bench_multitenant, bf16, gsteps, k=kk,
+                          finisher=mt_finish,
+                          ref_step_ms=mt_ref.get(fam), **mt_kw)
+                if kk == 1 and "step_time_ms" in row:
+                    mt_ref[fam] = row["step_time_ms"]
         # input-pipeline rows (r7): every other row feeds pre-built
         # device arrays, so host-side batch production (streaming-window
         # tokenization + accum assembly + placement) never shows up in

@@ -111,6 +111,11 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "host_step_ms": (dict, type(None)),  # {host: per-step ms} from the
                                     # last straggler-cadence gather; None
                                     # when --straggler_cadence is off
+        "tenants": (dict, type(None)),  # round-18 multi-tenant engine:
+                                    # {name: {slot, step, loss, tokens,
+                                    # wait_ms}} per resident adapter job
+                                    # (None / absent on solo training —
+                                    # optional on read)
     },
     # governor throttle decision (system/governor.py event_sink)
     "throttle": {
@@ -355,6 +360,23 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "step": _OPT_NUM,           # worker's last observed step
         "recovery_s": _OPT_NUM,     # down-observed -> relaunched wall s
     },
+    # one multi-tenant job lifecycle transition (multitenant/engine.py,
+    # DESIGN.md §23): admit (job -> slot), save (periodic step-tagged
+    # checkpoint), finish (budget reached; final adapter saved at
+    # `path`), cancel. `step` is the TENANT-LOCAL step counter; every
+    # event also carries the optional `tenant` attribution field (see
+    # validate_event) so cross-event filtering by tenant needs no
+    # per-event special casing.
+    "tenant": {
+        "name": (str,),
+        "slot": (int,),             # bank slot; -1 = not resident
+        "phase": (str,),            # admit | save | finish | cancel
+        "step": (int,),             # tenant-local steps completed
+        "job_steps": _OPT_NUM,      # the job's step budget
+        "tokens": _OPT_NUM,         # cumulative trained tokens
+        "loss": _OPT_NUM,
+        "path": _OPT_STR,           # saved artifact (save/finish)
+    },
     # one per run on orderly exit; exit != "ok" names the exception type
     # (or "preempted" for a drained run — reason carries it too, for
     # consumers that filter on a dedicated field).
@@ -376,7 +398,7 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
 # ABSENCE so pre-fleet (round-8) streams still validate and render —
 # when present they are type-checked as usual.
 OPTIONAL_FIELDS: Dict[str, frozenset] = {
-    "step_stats": frozenset({"host_step_ms", "skipped"}),
+    "step_stats": frozenset({"host_step_ms", "skipped", "tenants"}),
     "serve_stats": frozenset({"hbm_mb", "pool_mb"}),
     "run_end": frozenset({"goodput", "reason"}),
     "checkpoint": frozenset({"snapshot_ms", "write_ms", "bytes", "mb_s",
@@ -433,6 +455,13 @@ def validate_event(rec: Any) -> Optional[str]:
     if "t_mono" in rec and (isinstance(rec["t_mono"], bool)
                             or not isinstance(rec["t_mono"], (int, float))):
         return f"{ev}: bad t_mono {rec.get('t_mono')!r}"
+    # tenant is the round-18 multi-tenant attribution field: ANY event
+    # may carry it (the engine stamps its per-tenant lifecycle and save
+    # events), and when present it must be a tenant name string —
+    # optional on read, so every pre-multitenant stream validates
+    # unchanged.
+    if "tenant" in rec and not isinstance(rec["tenant"], (str, type(None))):
+        return f"{ev}: bad tenant {rec.get('tenant')!r}"
     for field, types in EVENT_SCHEMA[ev].items():
         if field not in rec:
             if field in OPTIONAL_FIELDS.get(ev, ()):

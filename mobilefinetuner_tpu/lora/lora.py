@@ -199,6 +199,32 @@ def stack_adapters(loras) -> dict:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *loras)
 
 
+def unstack_adapter(stacked: dict, index: int) -> dict:
+    """Inverse of one stack_adapters slot: slice adapter `index` out of a
+    stacked [k, ...] bank back to the solo tree layout (A [L, in, r],
+    B [L, r, out], scalar scale). The multi-tenant engine's save path
+    uses this so a bank-trained adapter round-trips through peft_io
+    BYTE-IDENTICAL to a solo-trained one (tests/test_multitenant.py pins
+    the file bytes) — the serve/eval/PEFT consumers never learn the
+    adapter was trained in a bank. Routing `ids` leaves (assign_adapters)
+    are dropped: they are batch data, not adapter state."""
+    first = next(iter(stacked["blocks"].values()))
+    # .shape on the leaf directly: this runs on the async writer thread
+    # over HOST snapshots, and a jnp.asarray just to read a dimension
+    # would copy the whole stacked bank to the device
+    n = int(first["A"].shape[0])
+    if not (0 <= index < n):
+        raise ValueError(
+            f"adapter index {index} out of range for a stacked bank of "
+            f"{n} adapter(s) (valid: 0..{n - 1})")
+    out = dict(stacked)
+    out["blocks"] = {
+        name: {leaf: v[index] for leaf, v in entry.items()
+               if leaf != "ids"}
+        for name, entry in stacked["blocks"].items()}
+    return out
+
+
 def assign_adapters(stacked: dict, adapter_ids) -> dict:
     """Route batch rows to adapters: insert the per-row index array into
     every site entry of a stack_adapters tree. SERVING/EVAL only: the

@@ -1,0 +1,9 @@
+from mobilefinetuner_tpu.multitenant.engine import (EngineConfig,
+                                                    MultiTenantEngine,
+                                                    TenantMux)
+from mobilefinetuner_tpu.multitenant.jobspec import (JobSpec,
+                                                     load_jobs_file,
+                                                     parse_jobs)
+
+__all__ = ["EngineConfig", "MultiTenantEngine", "TenantMux", "JobSpec",
+           "load_jobs_file", "parse_jobs"]

@@ -77,6 +77,22 @@ def lm_cross_entropy_sum(
         return nll.sum(), valid.sum()
 
 
+def lm_cross_entropy_rows(
+        logits: jnp.ndarray, labels: jnp.ndarray,
+        ignore_index: int = IGNORE_INDEX) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-ROW (sum_nll [B], valid_token_count [B]) — the multi-tenant
+    train step's form (train/trainer.make_multi_train_step): each batch
+    row belongs to one adapter job, so the step segment-sums these row
+    vectors by adapter id and normalizes each tenant's gradient by its
+    OWN token count (summing first and normalizing jointly would couple
+    every tenant's update to the others' token counts). Summing the two
+    vectors recovers lm_cross_entropy_sum exactly."""
+    with jax.named_scope("loss"):
+        logits_s, labels_s = _shift(logits, labels)
+        nll, valid = _token_nll(logits_s, labels_s, ignore_index)
+        return nll.sum(axis=-1), valid.sum(axis=-1)
+
+
 def lm_cross_entropy_with_count(
         logits: jnp.ndarray, labels: jnp.ndarray,
         ignore_index: int = IGNORE_INDEX) -> Tuple[jnp.ndarray, jnp.ndarray]:

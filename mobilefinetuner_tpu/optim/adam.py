@@ -133,6 +133,141 @@ def adam_update(grads, state: dict, params, config: AdamConfig,
     return new_p, new_state
 
 
+# ------------------------- multi-adapter (stacked) Adam ---------------------
+# The multi-tenant train engine (mobilefinetuner_tpu/multitenant/) stacks k
+# independent LoRA jobs' trainables along a leading adapter axis
+# (lora.stack_adapters layout). Optimizer state stacks the same way —
+# m/v [k, ...] with a PER-SLOT step counter [k] — so k jobs' Adam updates
+# run as one fused elementwise pass, and per-slot bias correction / LR /
+# apply-masking are all DATA (tenant join/leave never retraces). Every
+# per-slot computation below is the scalar adam_update formula broadcast
+# over the leading axis; the k-adapter-vs-solo parity oracle
+# (tests/test_multitenant.py) pins the identity to <= 1e-5.
+
+
+def init_multi_state(stacked_params, config: AdamConfig, k: int,
+                     mask: Optional[Any] = None) -> dict:
+    """Adam state for a stacked [k, ...] trainable bank: m/v mirror the
+    stacked leaves (zero-size placeholders on masked leaves, like
+    init_state) and `step` is a PER-SLOT [k] int32 counter — a freshly
+    admitted job starts its bias correction at 0 regardless of how long
+    its slot's neighbors have been training."""
+    base = init_state(stacked_params, config, mask)
+    base["step"] = jnp.zeros((k,), jnp.int32)
+    return base
+
+
+def _bsel(v, x):
+    """Broadcast a per-slot [k] vector over a stacked [k, ...] leaf."""
+    return v.reshape(v.shape[:1] + (1,) * (x.ndim - 1))
+
+
+def multi_adam_update(grads, state: dict, params, config: AdamConfig,
+                      lr_k: jnp.ndarray, apply_k: jnp.ndarray,
+                      mask: Optional[Any] = None,
+                      with_norms: bool = False):
+    """One stacked Adam step over a [k, ...] adapter bank.
+
+    lr_k: per-slot learning rates [k] (traced — per-tenant schedules are
+    data). apply_k: per-slot bool [k]; False slots pass params AND state
+    through untouched (inactive slots between jobs, and skipped slots
+    under the non-finite guard — a masked slot's m must not decay and
+    its step counter must not advance, or a refilled slot would inherit
+    a corrupted bias correction). Bias correction uses each slot's OWN
+    step counter. Returns (new_params, new_state) or, with
+    with_norms=True, (..., (update_norm [k], param_norm [k])) — per-slot
+    norms of the WOULD-BE update (reported even for masked slots, like
+    the solo path reports the skipped update's ratio).
+    """
+    app = jnp.asarray(apply_k).astype(bool)
+    step2 = state["step"] + 1
+    b1, b2 = config.beta1, config.beta2
+    bc1 = 1.0 - b1 ** step2.astype(jnp.float32)   # [k]
+    bc2 = 1.0 - b2 ** step2.astype(jnp.float32)
+
+    def leaf_update(p, g, m, v, vh, do):
+        if not do:
+            return p, m, v, vh, None, None
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        if config.coupled_weight_decay and config.weight_decay:
+            g = g + config.weight_decay * pf
+        m2 = b1 * m + (1 - b1) * g
+        v2 = b2 * v + (1 - b2) * g * g
+        m_hat = m2 / _bsel(bc1, m2)
+        if config.amsgrad:
+            vh2 = jnp.maximum(vh, v2)
+            denom = jnp.sqrt(vh2 / _bsel(bc2, vh2)) + config.eps
+        else:
+            vh2 = vh
+            denom = jnp.sqrt(v2 / _bsel(bc2, v2)) + config.eps
+        upd = m_hat / denom
+        if not config.coupled_weight_decay and config.weight_decay:
+            upd = upd + config.weight_decay * pf
+        delta = _bsel(lr_k, upd) * upd
+        axes = tuple(range(1, delta.ndim))
+        usq = jnp.sum(delta * delta, axis=axes) if with_norms else None
+        psq = jnp.sum(pf * pf, axis=axes) if with_norms else None
+        sel = _bsel(app, p)
+        newp = jnp.where(sel, (pf - delta).astype(p.dtype), p)
+        m2 = jnp.where(sel, m2, m)
+        v2 = jnp.where(sel, v2, v)
+        if config.amsgrad:
+            vh2 = jnp.where(sel, vh2, vh)
+        return newp, m2, v2, vh2, usq, psq
+
+    leaves_p, treedef = jax.tree.flatten(params)
+    leaves_g = treedef.flatten_up_to(grads)
+    leaves_m = treedef.flatten_up_to(state["m"])
+    leaves_v = treedef.flatten_up_to(state["v"])
+    leaves_vh = (treedef.flatten_up_to(state["v_hat"])
+                 if config.amsgrad else [None] * len(leaves_p))
+    leaves_do = (treedef.flatten_up_to(mask) if mask is not None
+                 else [True] * len(leaves_p))
+    out = [leaf_update(p, g, m, v, vh if vh is not None else 0.0, do)
+           for p, g, m, v, vh, do in zip(leaves_p, leaves_g, leaves_m,
+                                         leaves_v, leaves_vh, leaves_do)]
+    new_p = treedef.unflatten([o[0] for o in out])
+    new_state = {"step": jnp.where(app, step2, state["step"]),
+                 "m": treedef.unflatten([o[1] for o in out]),
+                 "v": treedef.unflatten([o[2] for o in out])}
+    if config.amsgrad:
+        new_state["v_hat"] = treedef.unflatten([o[3] for o in out])
+    if with_norms:
+        usq = [o[4] for o in out if o[4] is not None]
+        psq = [o[5] for o in out if o[5] is not None]
+        k = int(state["step"].shape[0])
+        upd_norm = (jnp.sqrt(sum(usq)) if usq
+                    else jnp.zeros((k,), jnp.float32))
+        w_norm = (jnp.sqrt(sum(psq)) if psq
+                  else jnp.zeros((k,), jnp.float32))
+        return new_p, new_state, (upd_norm, w_norm)
+    return new_p, new_state
+
+
+def slot_norms(grads) -> jnp.ndarray:
+    """Per-slot L2 norms [k] over a stacked [k, ...] grad tree — each
+    slot's norm over ITS OWN adapter only, matching global_norm over the
+    corresponding solo tree (the per-tenant clip must see exactly the
+    norm the solo run would)."""
+    sq = None
+    for g in jax.tree.leaves(grads):
+        g = g.astype(jnp.float32)
+        s = jnp.sum(jnp.square(g), axis=tuple(range(1, g.ndim)))
+        sq = s if sq is None else sq + s
+    return jnp.sqrt(sq)
+
+
+def clip_by_slot_norm(grads, max_norm: float):
+    """Per-slot clip-by-global-norm over a stacked [k, ...] grad tree:
+    returns (clipped_grads, pre_clip_norms [k]). Slot j's scale factor
+    is exactly clip_by_global_norm's for its solo tree."""
+    norms = slot_norms(grads)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norms, 1e-12))
+    return jax.tree.map(
+        lambda g: (g * _bsel(scale, g)).astype(g.dtype), grads), norms
+
+
 def global_norm(grads) -> jnp.ndarray:
     """Global L2 norm over a grad pytree (clip_and_get_grad_norm analog,
     gpt2_lora_finetune/main.cpp:490-516)."""

@@ -21,6 +21,30 @@ def lr_schedule(step, total_steps: int, base_lr: float,
     """
     step = jnp.asarray(step, jnp.float32)
     total = jnp.asarray(max(total_steps, 1), jnp.float32)
+    return _schedule_value(step, total, base_lr, warmup_ratio, kind,
+                           min_lr_ratio)
+
+
+def multi_lr_schedule(step_k, total_k, base_lr_k,
+                      warmup_ratio_k, kind: str = "cosine",
+                      min_lr_ratio: float = 0.1):
+    """Vectorized schedule for the multi-tenant engine: per-slot [k]
+    arrays of (tenant-local step, step budget, peak LR, warmup ratio)
+    — all TRACED data, so tenants with different budgets/LRs share one
+    compiled step — through the SAME formula as lr_schedule (the
+    k-adapter-vs-solo parity oracle depends on the identity). `kind`
+    and `min_lr_ratio` stay static/engine-wide: a per-slot schedule
+    SHAPE would be a traced branch, which is exactly what the
+    zero-retrace contract forbids."""
+    step = jnp.asarray(step_k, jnp.float32)
+    total = jnp.maximum(jnp.asarray(total_k, jnp.float32), 1.0)
+    base = jnp.asarray(base_lr_k, jnp.float32)
+    wr = jnp.asarray(warmup_ratio_k, jnp.float32)
+    return _schedule_value(step, total, base, wr, kind, min_lr_ratio)
+
+
+def _schedule_value(step, total, base_lr, warmup_ratio, kind,
+                    min_lr_ratio):
     warmup = jnp.maximum(jnp.floor(total * warmup_ratio), 0.0)
     warm_lr = base_lr * (step + 1.0) / jnp.maximum(warmup, 1.0)
     progress = jnp.clip((step - warmup) / jnp.maximum(total - warmup, 1.0),

@@ -199,6 +199,120 @@ def make_train_step(loss_fn: Callable[[Any, Any, dict], tuple],
                    in_shardings=in_shardings, out_shardings=out_shardings)
 
 
+def make_multi_train_step(loss_rows_fn: Callable[[Any, Any, dict], tuple],
+                          train_cfg: TrainConfig, k: int,
+                          mask: Optional[Any] = None,
+                          donate: bool = True):
+    """The multi-tenant optimizer step: k independent LoRA jobs through
+    ONE compiled program (DESIGN.md §23, mobilefinetuner_tpu/multitenant/).
+
+    loss_rows_fn(stacked_trainable, frozen, micro_batch) -> (row_nll_sums
+    [R], row_token_counts [R]): per-ROW loss over a micro-batch whose
+    every row carries its adapter id in micro_batch["adapter_ids"] [R]
+    (the ids-routed `_multi_lora` forward — models/lora_apply.py — makes
+    per-adapter grads fall out of the per-row gather's backward: slot j's
+    gradient is the scatter-add of exactly its own rows' contributions).
+
+    Per-tenant exactness (the k-vs-solo parity oracle): the scan
+    accumulates UNNORMALIZED per-slot loss/token sums plus the grads of
+    the total row-sum, then normalizes slot j's gradient by slot j's OWN
+    token count, clips by slot j's own pre-clip norm, schedules slot j's
+    own LR from its own step counter, and applies a per-slot Adam update
+    with per-slot bias correction (optim/adam.multi_adam_update) — every
+    per-slot quantity is the solo step's formula with the batch axis
+    re-labelled, so adapter j's trajectory matches a solo run on the
+    same data/seed to float-reassociation noise (<= 1e-5, pinned by
+    tests/test_multitenant.py).
+
+    step_fn(trainable, frozen, opt_state, batch, sched) ->
+    (trainable, opt_state, metrics): `sched` carries the per-slot [k]
+    DATA arrays {step, total, lr, warmup_ratio, active} — tenant
+    join/leave/refill, per-job budgets, and per-job LR schedules never
+    retrace. Inactive slots (active=False) contribute dummy rows whose
+    grads are computed and discarded: params, Adam m/v, AND the slot's
+    Adam step counter pass through untouched, so a refilled slot starts
+    from a genuinely fresh optimizer state. metrics are per-slot [k]
+    vectors (loss, grad_norm, lr, tokens, nonfinite_count, skipped,
+    param_norm, update_ratio) riding the caller's buffered-metrics path
+    (one device_get per flush, the zero-sync telemetry invariant).
+    """
+    from mobilefinetuner_tpu.optim.adam import (clip_by_slot_norm,
+                                                multi_adam_update,
+                                                slot_norms)
+    from mobilefinetuner_tpu.optim.schedule import multi_lr_schedule
+    accum = train_cfg.grad_accum_steps
+    adam_cfg = train_cfg.adam()
+
+    def step_fn(trainable, frozen, opt_state, batch, sched):
+        micro = reshape_for_accum(dict(batch), accum)
+
+        def sum_fn(tr, mb):
+            s_rows, w_rows = loss_rows_fn(tr, frozen, mb)
+            return s_rows.sum(), (s_rows, w_rows)
+
+        vg = jax.value_and_grad(sum_fn, has_aux=True)
+
+        def seg(rows, ids):
+            return jnp.zeros((k,), jnp.float32).at[ids].add(
+                rows.astype(jnp.float32))
+
+        def body(carry, mb):
+            g_acc, loss_k, w_k = carry
+            (_, (s_rows, w_rows)), g = vg(trainable, mb)
+            ids = mb["adapter_ids"]
+            g_acc = jax.tree.map(jnp.add, g_acc, g)
+            return (g_acc, loss_k + seg(s_rows, ids),
+                    w_k + seg(w_rows, ids)), None
+
+        g0 = jax.tree.map(lambda t: jnp.zeros(t.shape, jnp.float32),
+                          trainable)
+        z = jnp.zeros((k,), jnp.float32)
+        (g_sum, loss_sum_k, w_k), _ = jax.lax.scan(body, (g0, z, z), micro)
+        inv = 1.0 / jnp.maximum(w_k, 1.0)                       # [k]
+        bsel = lambda v, x: v.reshape((k,) + (1,) * (x.ndim - 1))
+        grads = jax.tree.map(lambda g: g * bsel(inv, g), g_sum)
+        loss_k = loss_sum_k * inv
+        # per-slot non-finite census BEFORE clipping (a NaN norm would
+        # smear one bad slot's poison over its whole tree — and per-slot
+        # isolation is the point: tenant j's NaN must not gate tenant i)
+        nonfinite_k = None
+        for g in jax.tree.leaves(grads):
+            s = jnp.sum(~jnp.isfinite(g), axis=tuple(range(1, g.ndim)))
+            nonfinite_k = s if nonfinite_k is None else nonfinite_k + s
+        if train_cfg.clip_grad_norm and train_cfg.clip_grad_norm > 0:
+            grads, norm_k = clip_by_slot_norm(grads,
+                                              train_cfg.clip_grad_norm)
+        else:
+            norm_k = slot_norms(grads)
+        lr_k = multi_lr_schedule(sched["step"], sched["total"],
+                                 sched["lr"], sched["warmup_ratio"],
+                                 train_cfg.schedule,
+                                 train_cfg.min_lr_ratio)
+        active = jnp.asarray(sched["active"]).astype(bool)        # [k]
+        apply_k = active
+        if train_cfg.skip_nonfinite:
+            bad = (nonfinite_k > 0) | ~jnp.isfinite(norm_k)
+            apply_k = active & ~bad
+            skipped = (active & bad).astype(jnp.int32)
+        else:
+            skipped = jnp.zeros((k,), jnp.int32)
+        with jax.named_scope("optimizer"):
+            trainable2, opt_state2, (upd_k, wn_k) = multi_adam_update(
+                grads, opt_state, trainable, adam_cfg, lr_k, apply_k,
+                mask, with_norms=True)
+        metrics = {"loss": loss_k, "grad_norm": norm_k, "lr": lr_k,
+                   "tokens": w_k,
+                   "param_norm": wn_k,
+                   "update_ratio": upd_k / jnp.maximum(wn_k, 1e-20),
+                   "nonfinite_count": nonfinite_k.astype(jnp.int32),
+                   "skipped": skipped,
+                   "active": active.astype(jnp.int32)}
+        return trainable2, opt_state2, metrics
+
+    donate_argnums = (0, 2) if donate else ()
+    return jax.jit(step_fn, donate_argnums=donate_argnums)
+
+
 def make_eval_step(nll_fn: Callable[[Any, Any, dict], tuple]):
     """Jitted eval step: nll_fn(trainable, frozen, batch) ->
     (sum_nll, token_count). Token-weighted accumulation is the caller's job

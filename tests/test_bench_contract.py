@@ -158,6 +158,64 @@ def test_bench_lora_impl_rows_tiny_cpu(monkeypatch):
     assert "lora_impl" not in b.finish("x", fake, "float32", 1)
 
 
+def test_bench_multitenant_rows_tiny_cpu(monkeypatch):
+    """bench.py's r18 multitenant rows (k adapter jobs through ONE
+    fused step, DESIGN.md §23): the REAL bench_multitenant in tiny CPU
+    mode at k=1 and k=2 — mt_finish carries the k / step_time_ms /
+    step_time_vs_k1 columns the step-time-vs-k claim is read from, and
+    aggregate tokens count every tenant's rows. The loss column rides
+    the shared loss-mark/eval-probe protocol like every other row
+    (loss_tokens_seen says how far the probe trained)."""
+    import bench as b
+    import jax.numpy as jnp
+    monkeypatch.setattr(b, "LOSS_MARK_TOKENS", 256)  # tiny CPU marks
+    r1 = b.bench_multitenant(jnp.float32, steps=2, k=1, model="gpt2",
+                             size="tiny", B_per=2, S=32)
+    assert r1["loss_tokens_seen"] >= 256
+    assert r1["k"] == 1 and r1["tokens"] == 1 * 2 * 32
+    row1 = b.mt_finish("gpt2s_tiny_multitenant_k1", r1, "float32", 2)
+    assert row1["k"] == 1
+    assert row1["step_time_ms"] > 0
+    assert row1["step_time_vs_k1"] == 1.0          # the reference row
+    assert row1["tokens_per_sec_per_chip"] > 0
+    r2 = b.bench_multitenant(jnp.float32, steps=2, k=2, model="gpt2",
+                             size="tiny", B_per=2, S=32,
+                             ref_step_ms=row1["step_time_ms"])
+    assert r2["k"] == 2 and r2["tokens"] == 2 * 2 * 32
+    row2 = b.mt_finish("gpt2s_tiny_multitenant_k2", r2, "float32", 2)
+    assert row2["k"] == 2
+    assert row2["step_time_ms"] > 0
+    assert row2["step_time_vs_k1"] > 0             # ratio vs the k=1 row
+    assert isinstance(row2["loss"], float)
+    assert "peak_hbm_mb" in row2 and "mfu" in row2
+
+
+def test_bench_compare_reads_suite_artifact(tmp_path):
+    """Satellite (r18): tools/bench_compare.py recognizes bench.py's
+    BENCH_SUITE {"suite": [...]} artifact shape — the multitenant
+    step_time-vs-k rows ride it — including the --threshold regression
+    gate over the new step_time_ms (lower-better) metric."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import bench_compare as bc
+    row = {"config": "gpt2s_multitenant_k8_bf16", "k": 8,
+           "tokens_per_sec_per_chip": 1000.0, "step_time_ms": 10.0,
+           "step_time_vs_k1": 1.05}
+    old = str(tmp_path / "old.json")
+    new = str(tmp_path / "new.json")
+    with open(old, "w") as f:
+        json.dump({"suite": [row], "peak_flops_assumed": {}}, f)
+    with open(new, "w") as f:
+        json.dump({"suite": [dict(row, step_time_ms=20.0)]}, f)
+    rows = bc.load_rows(old)
+    assert "gpt2s_multitenant_k8_bf16" in rows
+    assert rows["gpt2s_multitenant_k8_bf16"]["step_time_ms"] == 10.0
+    # step_time_ms is direction-aware (lower better): 2x = regression
+    assert bc.main([old, new, "--threshold", "5"]) == 2
+    assert bc.main([old, old, "--threshold", "5"]) == 0
+
+
 def test_serve_bench_row_contract(tmp_path):
     """tools/serve_bench.py rows: the BENCH_SERVE schema the round
     scoring reads — offered vs sustained req/s, TTFT/TPOT percentiles,

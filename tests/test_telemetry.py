@@ -46,7 +46,12 @@ REPRESENTATIVE = {
                        tok_s=1000.0, mfu=None, param_norm=12.0,
                        update_ratio=1e-3, nonfinite_count=0, skipped=0,
                        hbm_mb=100.0, queue_depth=2,
-                       host_step_ms={"0": 10.0, "1": 31.0}),
+                       host_step_ms={"0": 10.0, "1": 31.0},
+                       # round-18 multi-tenant engine: per-tenant
+                       # sections (optional on read — solo streams omit)
+                       tenants={"alice": {"slot": 0, "step": 12,
+                                          "loss": 3.1, "tokens": 4096,
+                                          "wait_ms": 0.2}}),
     "throttle": dict(step=5, sleep_ms=100.0, battery=80.0, temp=30.0,
                      source="telemetry"),
     "anomaly": dict(step=7, kind="loss_spike", loss=9.9, ema=3.0,
@@ -98,6 +103,12 @@ REPRESENTATIVE = {
     "profile_capture": dict(step=12, trigger="slow_step",
                             path="/tmp/run.jsonl.profiles/cap0",
                             steps=2, budget_left=1),
+    # round-18 multi-tenant training engine (DESIGN.md §23): one job
+    # lifecycle transition; the `tenant` payload field doubles as the
+    # cross-event attribution key the validator type-checks anywhere
+    "tenant": dict(name="alice", slot=0, phase="finish", step=200,
+                   job_steps=200, tokens=819200, loss=2.87,
+                   path="/tmp/out/alice.safetensors", tenant="alice"),
     # round-13 elastic fleet (DESIGN.md §18): the drain marker and the
     # fleet controller's decision timeline
     "preempt": dict(step=7, signal="SIGTERM"),
@@ -139,6 +150,11 @@ def test_validator_rejects_bad_events():
     assert validate_event({**ok, "loss": True}) is not None
     # extra fields are allowed (schema is a floor)
     assert validate_event({**ok, "extra": {"x": 1}}) is None
+    # the round-18 tenant attribution field: any event may carry it,
+    # but when present it must be a tenant name string (or null)
+    assert validate_event({**ok, "tenant": "alice"}) is None
+    assert validate_event({**ok, "tenant": None}) is None
+    assert validate_event({**ok, "tenant": 7}) is not None
     # the request phase set is CLOSED (round 14): an unknown phase is a
     # schema violation, not an extra-field allowance
     req = dict(event="request", seq=0, t=1.0, **REPRESENTATIVE["request"])

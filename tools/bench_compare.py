@@ -2,7 +2,8 @@
 
 Every round's bench capture lands as rows keyed by a `config` string
 (bench.py JSONL, BENCH_SERVE's {"rows": [...]}, BENCH_CKPT, the
-driver's {"tail": "<jsonl>"} wrapper — all four shapes load here).
+driver's {"tail": "<jsonl>"} wrapper, and BENCH_SUITE's
+{"suite": [...]} — all five shapes load here).
 This tool matches rows by that key across two artifacts, prints the
 per-metric % delta for every shared numeric metric, and — with
 `--threshold P` — exits NONZERO when any direction-aware metric
@@ -79,6 +80,12 @@ def load_rows(path: str, key: str = "config") -> dict:
             rows = data
         elif isinstance(data, dict) and isinstance(data.get("rows"), list):
             rows = data["rows"]
+        elif isinstance(data, dict) and isinstance(data.get("suite"),
+                                                   list):
+            # bench.py's BENCH_SUITE.json artifact (round 18: the
+            # multitenant step_time-vs-k rows ride it) — rows keyed by
+            # config like every other shape
+            rows = data["suite"]
         elif isinstance(data, dict) and isinstance(data.get("tail"), str):
             # the driver's bench capture: rc/cmd wrapper whose tail is
             # the benchmark's JSONL stdout

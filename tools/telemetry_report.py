@@ -141,6 +141,7 @@ def summarize(events, n_invalid=0) -> dict:
         "memory": memory_summary(scope),
         "observability": observability_summary(scope),
         "requests": request_summary(scope),
+        "tenants": tenant_summary(scope),
         "serve": serve_stats_summary(scope),
         "stragglers": straggler_entries(scope),
         "hangs": hang_entries(scope),
@@ -362,6 +363,74 @@ def observability_lines(o) -> list:
         lines.append(f"  PROFILE CAPTURED @ step {c['step']} "
                      f"({c['trigger']}): {c['path']} "
                      f"(budget left {c['budget_left']})")
+    return lines
+
+
+def tenant_summary(events) -> dict:
+    """Per-tenant roll-up for the multi-tenant training engine
+    (multitenant/engine.py, DESIGN.md §23): one row per adapter job from
+    its `tenant` lifecycle events plus the LAST step_stats `tenants`
+    section — steps reached vs budget, final loss, cumulative tokens,
+    host-wait attribution, lifecycle outcome, and the saved artifact.
+    None when the stream carries no multi-tenant traffic."""
+    tev = [e for e in events if e.get("event") == "tenant"]
+    stats = [e for e in events if e.get("event") == "step_stats"
+             and e.get("tenants")]
+    if not tev and not stats:
+        return None
+    rows: dict = {}
+    for e in tev:
+        r = rows.setdefault(e["name"], {"name": e["name"]})
+        r["status"] = e["phase"]
+        r["slot"] = e["slot"]
+        r["step"] = e["step"]
+        r["job_steps"] = e.get("job_steps")
+        if e.get("loss") is not None:
+            r["loss"] = e["loss"]
+        if e.get("tokens") is not None:
+            r["tokens"] = e["tokens"]
+        if e.get("phase") in ("save", "finish") and e.get("path"):
+            r["path"] = e["path"]
+    if stats:
+        for name, t in stats[-1]["tenants"].items():
+            r = rows.setdefault(name, {"name": name})
+            r.setdefault("status", "active")
+            for k in ("slot", "step", "loss", "tokens", "wait_ms"):
+                if t.get(k) is not None:
+                    r[k] = t[k]
+    order = {"finish": 0, "cancel": 1}
+    return {
+        "jobs": len(rows),
+        "finished": sum(1 for r in rows.values()
+                        if r.get("status") == "finish"),
+        "cancelled": sum(1 for r in rows.values()
+                         if r.get("status") == "cancel"),
+        "rows": sorted(rows.values(),
+                       key=lambda r: (order.get(r.get("status"), 2),
+                                      r["name"])),
+    }
+
+
+def tenant_lines(t) -> list:
+    if not t:
+        return []
+    lines = [f"  tenants: {t['jobs']} job(s), {t['finished']} finished"
+             + (f", {t['cancelled']} cancelled" if t["cancelled"]
+                else "")]
+    for r in t["rows"]:
+        budget = (f"/{r['job_steps']}" if r.get("job_steps") is not None
+                  else "")
+        bits = [f"    {r['name']}: {r.get('status', '?')} @ step "
+                f"{r.get('step', '?')}{budget}"]
+        if r.get("loss") is not None:
+            bits.append(f"loss {_fmt(r['loss'], 4)}")
+        if r.get("tokens") is not None:
+            bits.append(f"{r['tokens']} tok")
+        if r.get("wait_ms"):
+            bits.append(f"wait {_fmt(r['wait_ms'], 1)} ms")
+        if r.get("path"):
+            bits.append(f"-> {r['path']}")
+        lines.append(", ".join(bits))
     return lines
 
 
@@ -680,6 +749,8 @@ def print_summary(s: dict):
     for line in observability_lines(s.get("observability")):
         print(line)
     for line in request_lines(s.get("requests")):
+        print(line)
+    for line in tenant_lines(s.get("tenants")):
         print(line)
     for line in serve_stats_lines(s.get("serve")):
         print(line)

@@ -703,6 +703,7 @@ def evaluate(eval_step, trainable, frozen, dataset: WikiText2Dataset,
     if n == 0:
         tokens, mean = 0, 0.0
     else:
+        # graftlint: disable=sync-hazard(one transfer after the eval loop, the r07 on-device accumulation contract)
         total, count = jax.device_get((total, count))
         tokens = int(count)
         mean = float(total) / max(tokens, 1)
@@ -1060,6 +1061,7 @@ class FaultInjector:
         mb = self.n
         self.ballast = jax.device_put(
             np.zeros(mb * 2 ** 20 // 4, np.float32))
+        # graftlint: disable=sync-hazard(fault injection: the ballast must be resident before the next compile)
         self.ballast.block_until_ready()
         log.warning(f"--inject hbm_pressure: holding {mb} MB of device "
                     f"ballast")
@@ -1380,6 +1382,7 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                 # line (the flush serializes against any emit mid-write
                 # on the step loop's thread)
                 flush_fn=tel.flush_tail,
+                # graftlint: disable=sync-hazard(the watchdog's device probe IS a deliberate sync, off the step loop's thread)
                 probe_fn=lambda: jax.device_put(
                     jnp.zeros(())).block_until_ready(),
                 on_hang=lambda p: (
@@ -1787,6 +1790,7 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
             if (oom_mode == "degrade" and not multiproc
                     and jax.default_backend() != "cpu"
                     and injector.kind == "hbm_pressure"):
+                # graftlint: disable=sync-hazard(OOM-retry insurance snapshot, armed only under a live admission-risk signal)
                 oom_snap = jax.device_get((trainable, opt_state))
 
         stream = make_stream(start_step, start_step)
@@ -1818,7 +1822,8 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                     prof_active = True
                 elif step >= prof_end and prof_active:
                     if metrics:
-                        jax.device_get(metrics["loss"])  # drain queued work
+                        # graftlint: disable=sync-hazard(profiler stop drains queued work so the trace window holds it)
+                        jax.device_get(metrics["loss"])
                     jax.profiler.stop_trace()
                     prof_active = False
                     log.info(f"profiler trace -> {profile_dir}")
@@ -1860,6 +1865,7 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
             nonlocal t_interval, slept_ms, waited_ms
             if not buffered:
                 return
+            # graftlint: disable=sync-hazard(the zero-sync contract: ONE device_get per metrics flush, DESIGN.md section 13)
             fetched = jax.device_get([m for _, _, _, m in buffered])
             dt_ms = ((time.perf_counter() - t_interval) * 1000 - slept_ms) \
                 / len(buffered)
@@ -1950,6 +1956,7 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
             opt_f = lambda k: (float(m[k]) if k in m else None)
             tel.emit(
                 "step_stats", step=s + 1, loss=float(m["loss"]),
+                # graftlint: disable=sync-hazard(ema is the host-side spike detector's Python scalar, not a device array)
                 ema=float(ema.value), lr=float(m["lr"]),
                 grad_norm=float(m["grad_norm"]), step_time_ms=dt_ms,
                 host_wait_ms=wait_ms, slept_ms=slept_ms,
@@ -2113,6 +2120,7 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
                     # device first so the async-dispatched step work is
                     # actually inside the captured window
                     auto_prof.tick(step, sync=lambda m=metrics:
+                                   # graftlint: disable=sync-hazard(the flight recorder's stop syncs so the dispatched step lands inside the capture)
                                    jax.device_get(m["loss"]))
                 log_boundary = bool(args.log_interval) \
                     and (step + 1) % args.log_interval == 0

@@ -39,6 +39,24 @@ from typing import Callable, Dict, List, Optional, Tuple
 OPENMETRICS_CONTENT_TYPE = \
     "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
+# lock-discipline declaration (core/static_checks.py, DESIGN.md §24):
+# observe() runs under the Telemetry emit lock on whatever thread
+# emitted; render()/health() on HTTP handler threads — the registry's
+# own _lock serializes them, and graftlint checks every access.
+GRAFT_SHARED_STATE = {
+    "MetricsRegistry": {
+        "lock": "_lock",
+        "guarded": ["_counters", "_gauges", "_hists", "_last_rec_t",
+                    "_last_step", "_last_exit", "observed"],
+        # fold helpers assert-by-convention the caller holds _lock;
+        # graftlint flags any call site outside a with-lock block
+        "locked_helpers": ["_count", "_count_to", "_gauge", "_hist"],
+        "channels": [],
+        "note": "Histogram instances are reachable only via _hists, so "
+                "their fields inherit the registry lock",
+    },
+}
+
 # default histogram bucket edges (ms): wide enough for a 20 ms LoRA
 # step and a 2 s governor-throttled one, for TTFT under load and for
 # checkpoint writes — one ladder, log-spaced

@@ -44,6 +44,22 @@ from typing import Any, Callable, Iterable, Iterator, Optional
 
 _DONE = object()
 
+# lock-discipline declaration (core/static_checks.py, DESIGN.md §24):
+# this module deliberately has NO lock — producer<->consumer state rides
+# self-synchronizing primitives, and everything else is single-thread.
+GRAFT_SHARED_STATE = {
+    "Prefetcher": {
+        "lock": None,
+        "guarded": [],
+        "channels": ["_q", "_stop"],  # bounded Queue + stop Event
+        "note": "_buf/_exhausted/_error/_closed are consumer-thread-"
+                "only; _rss_limit/_rss_logged producer-only after "
+                "__init__ (construction happens-before thread start); "
+                "rss_sheds is a monotonic int gauge (benign race by "
+                "design, documented observable)",
+    },
+}
+
 
 class _Failure:
     """Producer-side exception, carried through the queue to the consumer."""

@@ -52,6 +52,21 @@ from typing import Callable, Iterable, Optional
 
 import numpy as np
 
+# lock-discipline declaration (core/static_checks.py, DESIGN.md §24):
+# the cross-thread contract graftlint enforces mechanically — every
+# guarded field may be touched only under the declared lock.
+GRAFT_SHARED_STATE = {
+    "AsyncCheckpointer": {
+        "lock": "_lock",
+        "guarded": ["_pending", "_inflight", "_error", "_stop"],
+        "locked_helpers": [],
+        "channels": ["_work"],  # Condition BUILT ON _lock
+        "note": "dropped is written under _lock on the step-loop side; "
+                "written is writer-thread-only; _thread is started "
+                "under _lock and joined only by the step-loop thread",
+    },
+}
+
 
 # ----------------------------- snapshot -------------------------------------
 
@@ -310,8 +325,9 @@ class AsyncCheckpointer:
                 if self._pending is None and self._stop:
                     return
                 self._inflight, self._pending = self._pending, None
+                item = self._inflight
             try:
-                self._write(self._inflight)
+                self._write(item)
             finally:
                 with self._lock:
                     self._inflight = None

@@ -227,7 +227,8 @@ def _multi_lora(y, x, entry, layer_idx, dropout, rng, impl):
                         preferred_element_type=jnp.float32)
         t2 = jnp.einsum("kb...r,kro->kb...o", t1.astype(x.dtype), B,
                         preferred_element_type=jnp.float32)
-        delta = jnp.einsum("kb...o,bk->b...o", t2, route)
+        delta = jnp.einsum("kb...o,bk->b...o", t2, route,
+                           preferred_element_type=jnp.float32)
     else:
         A_rows = A[ids]                              # [B, in, r]
         B_rows = B[ids]                              # [B, r, out]

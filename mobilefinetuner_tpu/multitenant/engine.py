@@ -66,6 +66,26 @@ from mobilefinetuner_tpu.train.trainer import (TrainConfig,
 
 log = get_logger()
 
+# lock-discipline declaration (core/static_checks.py, DESIGN.md §24):
+# threading lives INSIDE the per-tenant Prefetchers (each has its own
+# producer thread + bounded queue, declared in data/prefetch.py); the
+# mux and the engine itself run entirely on the training loop's thread.
+GRAFT_SHARED_STATE = {
+    "TenantMux": {
+        "lock": None,
+        "guarded": [],
+        "channels": [],
+        "note": "_pf/wait_ms are consumer-thread-only; cross-thread "
+                "handoff is each Prefetcher's bounded queue",
+    },
+    "MultiTenantEngine": {
+        "lock": None,
+        "guarded": [],
+        "channels": [],
+        "note": "single-threaded step loop over the TenantMux",
+    },
+}
+
 
 @dataclasses.dataclass
 class EngineConfig:
@@ -556,6 +576,7 @@ class MultiTenantEngine:
         section, and the mux's per-tenant wait attribution rides along."""
         if not self._buffered:
             return
+        # graftlint: disable=sync-hazard(the zero-sync contract: ONE device_get per metrics flush, DESIGN.md section 23)
         fetched = jax.device_get([m for _, _, m in self._buffered])
         dt_ms = ((time.perf_counter() - self._t_interval) * 1000.0
                  / len(self._buffered))

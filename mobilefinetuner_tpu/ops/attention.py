@@ -93,7 +93,8 @@ def dot_product_attention(
     # (reference: core/ops.cpp:2670 applied to probs)
     from mobilefinetuner_tpu.ops.dropout import inverted_dropout
     probs = inverted_dropout(probs, attn_dropout, attn_dropout_rng)
-    out = jnp.einsum("bhgqk,bhkd->bhgqd", probs.astype(v.dtype), v)
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32).astype(v.dtype)
     return out.reshape(B, Hq, S, D)
 
 

@@ -846,6 +846,7 @@ def flash_attention(q, k, v, *,
         pad = jnp.ones((B, S), jnp.float32)
     else:
         pad = padding_mask.astype(jnp.float32)
+    # graftlint: disable=sync-hazard(attn_dropout is a concrete Python config scalar at trace time, never a tracer)
     p_drop = float(attn_dropout) if attn_dropout_rng is not None else 0.0
     if p_drop > 0.0:
         seed = jax.lax.bitcast_convert_type(

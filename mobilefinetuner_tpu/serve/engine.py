@@ -70,6 +70,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
@@ -90,6 +91,26 @@ from mobilefinetuner_tpu.serve.paged_kv import (TRASH_BLOCK, BlockAllocator,
                                                 OutOfBlocks, blocks_for,
                                                 init_pools,
                                                 write_prompt_blocks)
+
+# lock-discipline declaration (core/static_checks.py, DESIGN.md §24):
+# the engine is single-threaded BY DESIGN — every mutation happens on
+# the serve loop's thread. health() is read from metrics_http handler
+# threads, but it only snapshots scalar counters/gauges (torn reads are
+# benign: no invariant spans two fields), and the HangWatchdog pet
+# rides telemetry's own lock. Any future cross-thread MUTABLE state
+# must be declared guarded here, with a real lock.
+GRAFT_SHARED_STATE = {
+    "ServeEngine": {
+        "lock": "_health_lock",
+        "guarded": ["_step_ms"],
+        "channels": [],
+        "note": "single-threaded step loop; health() runs on "
+                "metrics_http handler threads (r17) — its deque "
+                "iteration shares _health_lock with the loop's append; "
+                "every other health() read is a scalar-only snapshot "
+                "by contract",
+    },
+}
 
 
 @dataclasses.dataclass
@@ -316,6 +337,7 @@ class ServeEngine:
         # (tools/serve_bench.py --inject installs it)
         self.step_hook: Optional[Callable[[int], None]] = None
         self._step_ms: collections.deque = collections.deque(maxlen=256)
+        self._health_lock = threading.Lock()
         self.counts: collections.Counter = collections.Counter()
         # True exactly while a pool-donating dispatch (_write) is in
         # flight: a failure in that window may have consumed the
@@ -801,7 +823,8 @@ class ServeEngine:
                 self.params, bank_tree, self.pool_k, self.pool_v,
                 jnp.asarray(self._tok), jnp.asarray(self._pos),
                 jnp.asarray(self._tbl), jnp.asarray(self._aid))
-            nxt = np.asarray(nxt)        # host sync: this step's tokens
+            # graftlint: disable=sync-hazard(the serve loop's ONE host sync per decode step: this step's tokens drive host-side scheduling)
+            nxt = np.asarray(nxt)
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:
@@ -811,7 +834,8 @@ class ServeEngine:
             return done
         self.pool_k, self.pool_v = pool_k, pool_v
         self.decode_steps += 1
-        self._step_ms.append((time.perf_counter() - t_step) * 1000.0)
+        with self._health_lock:
+            self._step_ms.append((time.perf_counter() - t_step) * 1000.0)
         if self.watchdog is not None:
             self.watchdog.pet(self.decode_steps,
                               time.perf_counter() - t_step)
@@ -875,7 +899,8 @@ class ServeEngine:
         becomes rejects: queue depth, slot occupancy, page-pool
         headroom, rolling p95 step latency, and the cumulative
         terminal-state counters."""
-        ms = sorted(self._step_ms)
+        with self._health_lock:
+            ms = sorted(self._step_ms)
         p95 = (round(ms[min(int(0.95 * len(ms)), len(ms) - 1)], 3)
                if ms else None)
         from mobilefinetuner_tpu.core.xla_stats import live_hbm_mb

@@ -560,62 +560,36 @@ def test_report_resumed_stream_with_killed_second_run_is_truncated(
 # --------------------------- static emit-site schema guard ------------------
 
 def test_every_emitted_event_name_is_in_schema():
-    """Satellite: scan the package + tools source for emit()/event= call
-    sites — every literal event name must exist in EVENT_SCHEMA (schema
-    drift dies at review time, not in production), and every schema
-    event must be emitted somewhere (no dead taxonomy)."""
-    roots = [os.path.join(REPO, "mobilefinetuner_tpu"),
-             os.path.join(REPO, "tools")]
-    emit_re = re.compile(r"""\.emit\(\s*['"]([a-z_]+)['"]""")
-    kw_re = re.compile(r"""\bevent\s*=\s*['"]([a-z_]+)['"]""")
-    found = {}
-    for root in roots:
-        for path in glob.glob(os.path.join(root, "**", "*.py"),
-                              recursive=True):
-            src = open(path).read()
-            for m in list(emit_re.finditer(src)) \
-                    + list(kw_re.finditer(src)):
-                found.setdefault(m.group(1), set()).add(
-                    os.path.relpath(path, REPO))
-    unknown = {n: sorted(ps) for n, ps in found.items()
-               if n not in EVENT_SCHEMA}
-    assert not unknown, f"emitted names missing from EVENT_SCHEMA: {unknown}"
-    never_emitted = set(EVENT_SCHEMA) - set(found)
-    # throttle/anomaly/hang ride **payload dicts at their call sites —
-    # their literal names appear in cli/common.py's sink lambdas; if
-    # this set ever grows, either wire the event or drop it
-    assert not never_emitted, \
-        f"schema events no source ever emits: {sorted(never_emitted)}"
+    """Satellite (migrated r19): the hand-rolled source-regex scan is
+    now graftlint's `emit-schema` rule (core/static_checks.py) — AST
+    emit-site collection vs EVENT_SCHEMA in BOTH directions (no unknown
+    event ships, no dead taxonomy survives). This wrapper pins the rule
+    green over package + tools; tools/graft_lint.py runs the same rule
+    from the CLI/tier-1 gate."""
+    from mobilefinetuner_tpu.core.static_checks import (collect_emit_sites,
+                                                        Project, run_lint)
+    res = run_lint([os.path.join(REPO, "mobilefinetuner_tpu"),
+                    os.path.join(REPO, "tools")], rules=["emit-schema"])
+    bad = res.findings + res.suppressed  # this rule is never suppressed
+    assert not bad, [f.render() for f in bad]
+    # the rule's collector must still SEE the emit sites (an empty scan
+    # would pass both directions vacuously if EVENT_SCHEMA were empty)
+    found = collect_emit_sites(
+        Project([os.path.join(REPO, "mobilefinetuner_tpu")]).all_modules())
+    assert set(found) >= {"run_start", "run_end", "step_stats", "request"}
+    assert set(found) <= set(EVENT_SCHEMA)
 
 
 def test_request_phases_and_reasons_pinned_both_directions():
-    """Round-14 satellite: the serve layer's request lifecycle phases
-    and its policy reject/timeout reasons are CLOSED sets
-    (core/telemetry.py REQUEST_PHASES / REQUEST_REASONS — the
-    validator enforces the phases). Scan the serve emit sites for
-    `phase="..."` / `reason="..."` literals and pin BOTH directions:
-    every literal in source is declared (a new phase/reason cannot ship
-    without landing in the schema + report), and every declared one has
-    an emit site (no dead taxonomy). The error phase's reason is an
-    exception type name — an open set this scan deliberately ignores
-    (only lowercase_snake literals match)."""
-    from mobilefinetuner_tpu.core.telemetry import (REQUEST_PHASES,
-                                                    REQUEST_REASONS)
-    sources = [os.path.join(REPO, "mobilefinetuner_tpu", "serve",
-                            "engine.py"),
-               os.path.join(REPO, "tools", "serve_bench.py")]
-    phase_re = re.compile(r"""phase=['"]([a-z_]+)['"]""")
-    reason_re = re.compile(r"""reason=['"]([a-z_]+)['"]""")
-    phases, reasons = set(), set()
-    for path in sources:
-        src = open(path).read()
-        phases |= {m.group(1) for m in phase_re.finditer(src)}
-        reasons |= {m.group(1) for m in reason_re.finditer(src)}
-    assert phases == set(REQUEST_PHASES), (
-        f"phase literals vs REQUEST_PHASES: "
-        f"undeclared={sorted(phases - set(REQUEST_PHASES))}, "
-        f"never emitted={sorted(set(REQUEST_PHASES) - phases)}")
-    assert reasons == set(REQUEST_REASONS), (
-        f"reason literals vs REQUEST_REASONS: "
-        f"undeclared={sorted(reasons - set(REQUEST_REASONS))}, "
-        f"never emitted={sorted(set(REQUEST_REASONS) - reasons)}")
+    """Round-14 satellite (migrated r19): the closed-set scan of the
+    serve layer's `phase=`/`reason=` literals vs REQUEST_PHASES /
+    REQUEST_REASONS is now graftlint's `serve-taxonomy` rule
+    (core/static_checks.py) — same both-direction semantics (the error
+    phase's exception-type reasons stay exempt: only lowercase_snake
+    literals match). This wrapper pins the rule green."""
+    from mobilefinetuner_tpu.core.static_checks import run_lint
+    res = run_lint([os.path.join(REPO, "mobilefinetuner_tpu"),
+                    os.path.join(REPO, "tools")],
+                   rules=["serve-taxonomy"])
+    bad = res.findings + res.suppressed  # this rule is never suppressed
+    assert not bad, [f.render() for f in bad]

@@ -292,13 +292,14 @@ def test_naive_fp32_accum_r8_s2048():
         return maybe_lora(jnp.zeros(x.shape, jnp.bfloat16), x, entry16,
                           impl="naive")
 
-    jaxpr = jax.make_jaxpr(f)(jnp.asarray(x32, jnp.bfloat16))
-    dots = [e for e in jaxpr.jaxpr.eqns if e.primitive.name ==
-            "dot_general"]
-    assert len(dots) >= 2, jaxpr
-    for e in dots:
-        pet = e.params.get("preferred_element_type")
-        assert pet is not None and np.dtype(pet) == np.float32, e
+    # migrated r19: the hand-rolled jaxpr grep is now the shared
+    # structural-pin API (core/static_checks.assert_dots_accumulate_f32,
+    # sub-jaxprs included) — the same helper graftlint's runtime half
+    # leans on
+    from mobilefinetuner_tpu.core.static_checks import (
+        assert_dots_accumulate_f32)
+    assert_dots_accumulate_f32(f, jnp.asarray(x32, jnp.bfloat16),
+                               min_dots=2)
     # numeric sanity vs the exact f32 oracle
     got = np.asarray(f(jnp.asarray(x32, jnp.bfloat16)), np.float32)
     want = 2.0 * (x32 @ A32) @ B32

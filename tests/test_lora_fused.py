@@ -87,21 +87,24 @@ def test_maybe_lora_fused_engages_the_kernel_at_aligned_shapes():
     x = jnp.zeros((2, 8, 128))
     y = jnp.zeros((2, 8, 128))
 
-    def prims(impl):
-        # the kernel sits inside the custom_vjp sub-jaxpr: search the
-        # whole rendered program, not just the top-level eqns
-        return str(jax.make_jaxpr(
-            lambda yy, xx: maybe_lora(yy, xx, entry, impl=impl))(y, x))
+    # migrated r19: the rendered-string grep is now the shared
+    # structural-pin API (core/static_checks.jaxpr_contains walks
+    # sub-jaxprs, so the kernel inside the custom_vjp call jaxpr counts)
+    from mobilefinetuner_tpu.core.static_checks import jaxpr_contains
 
-    assert "pallas_call" in prims("fused")
-    assert "pallas_call" not in prims("naive")
+    def engages(impl):
+        return jaxpr_contains(
+            lambda yy, xx: maybe_lora(yy, xx, entry, impl=impl),
+            "pallas_call", y, x)
+
+    assert engages("fused")
+    assert not engages("naive")
     # ineligible site (d_out not lane-aligned): fused falls back to XLA
     entry_bad = {"A": jnp.zeros((128, 4)), "B": jnp.zeros((4, 100)),
                  "scale": jnp.float32(1.0)}
-    jaxpr = jax.make_jaxpr(
-        lambda yy, xx: maybe_lora(yy, xx, entry_bad, impl="fused"))(
-            jnp.zeros((2, 8, 100)), x)
-    assert "pallas_call" not in str(jaxpr)
+    assert not jaxpr_contains(
+        lambda yy, xx: maybe_lora(yy, xx, entry_bad, impl="fused"),
+        "pallas_call", jnp.zeros((2, 8, 100)), x)
 
 
 # ------------------------------ fused-CE lora --------------------------------

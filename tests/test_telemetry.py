@@ -332,14 +332,12 @@ def test_live_hbm_mb_is_none_when_no_device_reports():
 
 def _assert_scopes(txt, scopes):
     """Each named scope must appear as a path component of some HLO
-    op_name. Autodiff wraps scopes in transform markers — the forward
-    pass carries `jvp(embed)/...`, the backward `transpose(jvp(mlp))/...`
-    — so match the scope delimited by / or parentheses."""
-    names = set(re.findall(r'op_name="([^"]*)"', txt))
-    for s in scopes:
-        pat = re.compile(rf"(^|[/(]){s}([/)]|$)")
-        assert any(pat.search(n) for n in names), \
-            f"scope {s!r} missing from compiled HLO metadata"
+    op_name (autodiff wraps scopes in jvp(...)/transpose(...) markers).
+    Migrated r19: the matcher is core/static_checks.assert_hlo_scopes —
+    the same helper tools/check_compiled_contracts.py pins the compiled
+    train/decode/multitenant programs with."""
+    from mobilefinetuner_tpu.core.static_checks import assert_hlo_scopes
+    assert_hlo_scopes(txt, scopes)
 
 
 def test_gpt2_train_step_hlo_scopes_and_health_metrics():

@@ -1,0 +1,87 @@
+"""graftlint CLI: run the repo-invariant static-analysis rules
+(mobilefinetuner_tpu/core/static_checks.py, DESIGN.md §24) over source
+trees and report findings.
+
+The rules encode the invariants eighteen rounds of hardening bought:
+no host syncs reachable from the step loop, donated buffers never read
+after dispatch, no untraced Python branches in jitted code, f32
+accumulation pinned on adapter math, emit-site/EVENT_SCHEMA agreement,
+and lock discipline in the threaded host subsystems. Intentional
+exceptions are visible, reasoned suppressions:
+
+    # graftlint: disable=sync-hazard(flush boundary: one get per flush)
+
+Usage:
+  python tools/graft_lint.py mobilefinetuner_tpu/
+  python tools/graft_lint.py mobilefinetuner_tpu/ tools/ --format json
+  python tools/graft_lint.py --rules emit-schema,lock-discipline pkg/
+  python tools/graft_lint.py --list-rules
+
+Exit codes (bench_compare convention): 0 = clean, 2 = unsuppressed
+findings, 1 = usage/engine error (bad path, syntax error, unknown rule).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from mobilefinetuner_tpu.core.static_checks import (  # noqa: E402
+    RULES, LintError, run_lint)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="repo-invariant static analysis (graftlint)")
+    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="finding output format")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings (text mode)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the shipped rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(RULES):
+            print(f"{name}: {RULES[name].doc}")
+        return 0
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        print("error: no paths given (or use --list-rules)",
+              file=sys.stderr)
+        return 1
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    try:
+        res = run_lint(args.paths, rules=rules)
+    except LintError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    if args.format == "json":
+        print(json.dumps(res.to_dict(), indent=1))
+    else:
+        for f in res.findings:
+            print(f.render())
+        if args.show_suppressed:
+            for f in res.suppressed:
+                print(f.render())
+        print(f"graftlint: {res.files} file(s), "
+              f"{len(res.rules)} rule(s), "
+              f"{len(res.findings)} finding(s), "
+              f"{len(res.suppressed)} suppressed")
+    return 2 if res.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -249,6 +249,9 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         # static KV-pool footprint the admission preflight charged
         "hbm_mb": _OPT_NUM,
         "pool_mb": _OPT_NUM,
+        # round-20 mesh shape [dp, tp] (optional on read: pre-sharding
+        # streams); [1, 1] is the single-chip engine
+        "mesh": (list,),
     },
     # one memory-admission verdict (core/memory_guard.py, DESIGN.md
     # §21): immediately post-compile (phase=preflight), on a caught
@@ -399,7 +402,7 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
 # when present they are type-checked as usual.
 OPTIONAL_FIELDS: Dict[str, frozenset] = {
     "step_stats": frozenset({"host_step_ms", "skipped", "tenants"}),
-    "serve_stats": frozenset({"hbm_mb", "pool_mb"}),
+    "serve_stats": frozenset({"hbm_mb", "pool_mb", "mesh"}),
     "run_end": frozenset({"goodput", "reason"}),
     "checkpoint": frozenset({"snapshot_ms", "write_ms", "bytes", "mb_s",
                              "async"}),

@@ -575,15 +575,22 @@ def gemma3_generate(config: Gemma3TextConfig, params, input_ids,
 
 def gpt2_prefill(config: GPT2Config, params, input_ids, attention_mask,
                  compute_dtype=jnp.float32, lora=None,
-                 lora_impl: str = "auto"):
+                 lora_impl: str = "auto", shardings=None):
     """Prefill for serving: [B, P] right-padded prompts -> (next-token
     logits [B, V] f32 at each row's last real position, (k, v) per-layer
-    caches [L, B, H, P, D])."""
+    caches [L, B, H, P, D]). shardings: a serve/sharding.ServeSharding
+    under the (dp, tp) serve mesh — the prefill matmuls TP-partition by
+    propagation from the column/row-sharded weight placement; the only
+    explicit pin is the collected caches' KV-head axis, so the engine's
+    prompt-page scatter receives pool-aligned K/V."""
     params = jax.tree.map(jnp.asarray, params)
     x, (pk, pv) = gpt2.hidden_states(
         config, params, input_ids, attention_mask, lora=lora,
         compute_dtype=compute_dtype, collect_kv=True,
         lora_impl=lora_impl)
+    if shardings is not None:
+        pk = shardings.prefill_cache(pk)
+        pv = shardings.prefill_cache(pv)
     n_real = attention_mask.sum(-1).astype(jnp.int32)
     last = x[jnp.arange(x.shape[0]), n_real - 1]          # [B, E]
     logits = last @ params["wte"].astype(compute_dtype).T
@@ -594,13 +601,16 @@ def gpt2_prefill(config: GPT2Config, params, input_ids, attention_mask,
 
 def gemma3_prefill(config: Gemma3TextConfig, params, input_ids,
                    attention_mask, compute_dtype=jnp.float32, lora=None,
-                   lora_impl: str = "auto"):
+                   lora_impl: str = "auto", shardings=None):
     """Gemma-3 serving prefill (see gpt2_prefill)."""
     params = jax.tree.map(jnp.asarray, params)
     x, (pk, pv) = gemma3.hidden_states(
         config, params, input_ids, attention_mask, lora=lora,
         compute_dtype=compute_dtype, collect_kv=True,
         lora_impl=lora_impl)
+    if shardings is not None:
+        pk = shardings.prefill_cache(pk)
+        pv = shardings.prefill_cache(pv)
     n_real = attention_mask.sum(-1).astype(jnp.int32)
     last = x[jnp.arange(x.shape[0]), n_real - 1]
     logits = last @ params["embed"].astype(compute_dtype).T
@@ -613,7 +623,7 @@ def gpt2_decode_step_paged(config: GPT2Config, params, pool_k, pool_v,
                            tok, pos, tbl, lora=None,
                            compute_dtype=jnp.float32,
                            attn_impl: str = "auto",
-                           lora_impl: str = "auto"):
+                           lora_impl: str = "auto", shardings=None):
     """One continuous-batching decode step over a block-paged KV pool.
 
     pool_k/pool_v [NB, L, H, bT, D]; tok [S] the token each slot feeds;
@@ -625,9 +635,16 @@ def gpt2_decode_step_paged(config: GPT2Config, params, pool_k, pool_v,
     attn_impl: "xla" = gather-based paged_attention (every backend),
     "pallas" = the scalar-prefetch paged kernel, "auto" = pallas on TPU
     when eligible. Both are parity-pinned to each other and to the
-    contiguous generate() oracle."""
+    contiguous generate() oracle.
+
+    shardings: a serve/sharding.ServeSharding — the layer math is
+    unchanged; the head/hidden axes get with_sharding_constraint pins
+    (GSPMD inserts the collectives; check_compiled_contracts pins the
+    census), the Pallas gate charges per-shard head counts, and the
+    kernel path routes through sharded_paged_attend's shard_map."""
     from mobilefinetuner_tpu.ops.decode_attention import (
-        paged_attention, paged_decode_attention, paged_eligible)
+        paged_attention, paged_decode_attention, paged_eligible,
+        sharded_paged_attend)
     S, M = tbl.shape
     NB, L, H, bT, D = pool_k.shape
     E = config.n_embd
@@ -637,13 +654,21 @@ def gpt2_decode_step_paged(config: GPT2Config, params, pool_k, pool_v,
     cast = lambda t: (t.astype(compute_dtype)
                       if jnp.issubdtype(t.dtype, jnp.floating) else t)
     wb = jax.tree.map(cast, params["blocks"])
+    shd = shardings
     use_pallas = attn_impl == "pallas" or (
         attn_impl == "auto" and jax.default_backend() == "tpu"
-        and paged_eligible(H, 1, bT, D, pool_k.dtype.itemsize))
-    attend = paged_decode_attention if use_pallas else paged_attention
+        and paged_eligible(H, 1, bT, D, pool_k.dtype.itemsize,
+                           tp=1 if shd is None else shd.tp))
+    if shd is not None:
+        attend = sharded_paged_attend(shd) if use_pallas \
+            else paged_attention
+    else:
+        attend = paged_decode_attention if use_pallas else paged_attention
 
     x = params["wte"][tok].astype(compute_dtype) \
         + params["wpe"][pos].astype(compute_dtype)            # [S, E]
+    if shd is not None:
+        x = shd.slots(x)
     cols = jnp.arange(M * bT, dtype=jnp.int32)
     ok = cols[None, :] <= pos[:, None]                        # [S, M*bT]
     blk = tbl[jnp.arange(S), pos // bT]                       # [S]
@@ -668,17 +693,23 @@ def gpt2_decode_step_paged(config: GPT2Config, params, pool_k, pool_v,
         q, k, v = jnp.split(qkv, 3, axis=-1)
         hd = lambda z: z.reshape(S, H, D)
         q, k, v = hd(q), hd(k), hd(v)
+        if shd is not None:
+            q, k, v = shd.kv_rows(q), shd.kv_rows(k), shd.kv_rows(v)
         # scatter the fed token's K/V into its slot's current page; idle
         # slots land in the reserved trash block (never attended)
         pk = pk.at[blk, i, :, off, :].set(k.astype(pk.dtype))
         pv = pv.at[blk, i, :, off, :].set(v.astype(pv.dtype))
         ctx = attend(q[:, :, None, :], pk, pv, tbl, i, ok, D ** -0.5)
+        if shd is not None:
+            ctx = shd.heads4(ctx)
         ctx = ctx.reshape(S, E).astype(compute_dtype)
         proj = ctx @ bp["attn"]["proj_w"] + bp["attn"]["proj_b"]
         proj = apply_lora(proj, ctx, "attn_proj", i)
         x = x + proj
         h2 = gpt2.layer_norm(x, bp["ln_2"]["g"], bp["ln_2"]["b"], eps)
         fc = h2 @ bp["mlp"]["fc_w"] + bp["mlp"]["fc_b"]
+        if shd is not None:
+            fc = shd.hidden(fc)
         fc = gpt2.gelu_new(apply_lora(fc, h2, "mlp_fc_in", i))
         out = fc @ bp["mlp"]["proj_w"] + bp["mlp"]["proj_b"]
         out = apply_lora(out, fc, "mlp_fc_out", i)
@@ -697,13 +728,19 @@ def gemma3_decode_step_paged(config: Gemma3TextConfig, params, pool_k,
                              pool_v, tok, pos, tbl, lora=None,
                              compute_dtype=jnp.float32,
                              attn_impl: str = "auto",
-                             lora_impl: str = "auto"):
+                             lora_impl: str = "auto", shardings=None):
     """Gemma-3 paged decode step (see gpt2_decode_step_paged): GQA pool
     [NB, L, Hkv, bT, D], per-layer global/local RoPE, sliding-window
     validity over absolute positions (serve sequences are unpadded, so
-    the column index IS the position)."""
+    the column index IS the position).
+
+    Under `shardings` the GQA head placement follows shard_heads: the
+    pool's KV axis shards when Hkv % tp == 0, otherwise the query-group
+    axis does (pools replicated) — either way the gate charges per-shard
+    head counts and constraints pin the 4D [S, KV, G, D] layout."""
     from mobilefinetuner_tpu.ops.decode_attention import (
-        paged_attention, paged_decode_attention, paged_eligible)
+        paged_attention, paged_decode_attention, paged_eligible,
+        sharded_paged_attend)
     c = config
     S, M = tbl.shape
     NB, L, KV, bT, D = pool_k.shape
@@ -718,12 +755,20 @@ def gemma3_decode_step_paged(config: Gemma3TextConfig, params, pool_k,
     wb = jax.tree.map(cast, params["blocks"])
     is_global = jnp.asarray([c.is_global_layer(i) for i in range(L)])
     normalizer = jnp.asarray(c.hidden_size ** 0.5, compute_dtype)
+    shd = shardings
     use_pallas = attn_impl == "pallas" or (
         attn_impl == "auto" and jax.default_backend() == "tpu"
-        and paged_eligible(KV, G, bT, D, pool_k.dtype.itemsize))
-    attend = paged_decode_attention if use_pallas else paged_attention
+        and paged_eligible(KV, G, bT, D, pool_k.dtype.itemsize,
+                           tp=1 if shd is None else shd.tp))
+    if shd is not None:
+        attend = sharded_paged_attend(shd) if use_pallas \
+            else paged_attention
+    else:
+        attend = paged_decode_attention if use_pallas else paged_attention
 
     x = params["embed"][tok].astype(compute_dtype) * normalizer
+    if shd is not None:
+        x = shd.slots(x)
     cos_g, sin_g = rope_cos_sin(pos[:, None], D, c.rope_theta)
     cos_l, sin_l = rope_cos_sin(pos[:, None], D, c.rope_local_base_freq)
     cols = jnp.arange(M * bT, dtype=jnp.int32)
@@ -750,10 +795,17 @@ def gemma3_decode_step_paged(config: Gemma3TextConfig, params, pool_k,
         sin = jnp.where(glob, sin_g, sin_l)
         q = apply_rope(q[:, :, None, :], cos, sin)[:, :, 0]
         k = apply_rope(k[:, :, None, :], cos, sin)[:, :, 0]
+        if shd is not None:
+            k, v = shd.kv_rows(k), shd.kv_rows(v)
         pk = pk.at[blk, i, :, off, :].set(k.astype(pk.dtype))
         pv = pv.at[blk, i, :, off, :].set(v.astype(pv.dtype))
         ok = jnp.where(glob, valid, valid & win_ok)           # [S, M*bT]
-        ctx = attend(q.reshape(S, KV, G, D), pk, pv, tbl, i, ok, scale)
+        q4 = q.reshape(S, KV, G, D)
+        if shd is not None:
+            q4 = shd.heads4(q4)
+        ctx = attend(q4, pk, pv, tbl, i, ok, scale)
+        if shd is not None:
+            ctx = shd.heads4(ctx)
         ctx = ctx.reshape(S, nq * D).astype(compute_dtype)
         attn_out = apply_lora(ctx @ a["o_w"], ctx, "o_proj", i)
         attn_out = gemma3.rms_norm(attn_out, bp["post_attn_ln"], eps)
@@ -762,6 +814,8 @@ def gemma3_decode_step_paged(config: Gemma3TextConfig, params, pool_k,
         act = gemma3.gelu_tanh(
             apply_lora(h2 @ bp["mlp"]["gate_w"], h2, "gate_proj", i)) \
             * apply_lora(h2 @ bp["mlp"]["up_w"], h2, "up_proj", i)
+        if shd is not None:
+            act = shd.hidden(act)
         down = apply_lora(act @ bp["mlp"]["down_w"], act, "down_proj", i)
         down = gemma3.rms_norm(down, bp["post_ffn_ln"], eps)
         return (x + down, pk, pv), None

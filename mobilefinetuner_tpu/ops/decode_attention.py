@@ -78,7 +78,30 @@ def xla_reference(q, k_cache, v_cache, ok, scale):
                       preferred_element_type=jnp.float32)
 
 
-def pick_kvb(KV: int, T: int, D: int, itemsize: int, G: int = 1):
+def shard_heads(KV: int, G: int, tp: int = 1):
+    """Per-shard (KV, G) head counts under tp-way tensor parallelism —
+    the ONE place the serve mesh's head-axis choice lives (the VMEM
+    gates below and serve/sharding.ServeSharding both consult it, so
+    the eligibility math can never disagree with the placement):
+
+      KV % tp == 0  the pool's KV-head axis shards — each shard's
+                    kernel sees KV // tp heads of its own page slice;
+      G % tp == 0   (KV indivisible, GQA) the query-group axis shards —
+                    each shard attends the WHOLE (replicated) pool with
+                    G // tp query groups per KV head;
+      neither       heads replicate: every shard pays the global counts.
+    """
+    tp = int(tp or 1)
+    if tp > 1:
+        if KV % tp == 0:
+            return KV // tp, G
+        if G % tp == 0:
+            return KV, G // tp
+    return KV, G
+
+
+def pick_kvb(KV: int, T: int, D: int, itemsize: int, G: int = 1,
+             tp: int = 1):
     """Largest divisor of KV whose double-buffered K+V whole-T blocks fit
     the VMEM budget, or None (caller falls back to XLA). Resident per grid
     step: 2 (K, V) x 2 (double buffer) x [KVB, T, D] storage-dtype
@@ -86,7 +109,11 @@ def pick_kvb(KV: int, T: int, D: int, itemsize: int, G: int = 1):
     per-head [G, T] f32 score/prob rows; plus one T·D·4 slack term for
     the compiler's elementwise temps. The G-dependent terms keep large-G
     GQA shapes from passing the gate and overflowing VMEM at runtime
-    (before them, only the K/V blocks were charged)."""
+    (before them, only the K/V blocks were charged). tp > 1 charges the
+    PER-SHARD head counts (shard_heads): under the serve mesh each
+    shard's kernel streams only its own slice, so charging global heads
+    would falsely gate the Pallas path off as tp grows."""
+    KV, G = shard_heads(KV, G, tp)
     for kvb in range(KV, 0, -1):
         if KV % kvb:
             continue
@@ -100,10 +127,11 @@ def pick_kvb(KV: int, T: int, D: int, itemsize: int, G: int = 1):
 
 
 def decode_eligible(KV: int, T: int, D: int, itemsize: int,
-                    G: int = 1) -> bool:
+                    G: int = 1, tp: int = 1) -> bool:
     """T must be sublane-aligned (whole-T blocks are statically indexed,
-    but the [T, D] tile still wants 8-row alignment); VMEM must fit."""
-    return T % 8 == 0 and pick_kvb(KV, T, D, itemsize, G) is not None
+    but the [T, D] tile still wants 8-row alignment); VMEM must fit
+    (per-shard head counts when tp > 1 — see pick_kvb)."""
+    return T % 8 == 0 and pick_kvb(KV, T, D, itemsize, G, tp) is not None
 
 
 def _decode_kernel(q_ref, k_ref, v_ref, ok_ref, o_ref, *, scale, kvb):
@@ -177,9 +205,15 @@ def paged_attention(q, pool_k, pool_v, tbl, layer, ok, scale):
 
 
 def paged_eligible(KV: int, G: int, bT: int, D: int,
-                   itemsize: int) -> bool:
+                   itemsize: int, tp: int = 1) -> bool:
     """One page pair (K+V, double-buffered) + the per-slot q/ctx blocks
-    and [G, bT] score rows must fit VMEM; bT must be sublane-aligned."""
+    and [G, bT] score rows must fit VMEM; bT must be sublane-aligned.
+    tp > 1 charges PER-SHARD head counts (shard_heads): the sharded
+    serve path runs the kernel under shard_map on each shard's pool
+    slice, so the VMEM bill is the local one — global counts would be
+    both too strict (KV-sharded pools) and, were the budget ever raised
+    per-shard, unsafely lax the other way."""
+    KV, G = shard_heads(KV, G, tp)
     need = (4 * KV * bT * D * itemsize          # K+V page, double-buffered
             + KV * G * D * (itemsize + 4)       # q block + f32 ctx block
             + 3 * KV * G * max(D, bT) * 4)      # o/m/l accumulators + p
@@ -277,6 +311,59 @@ def paged_decode_attention(q, pool_k, pool_v, tbl, layer, ok, scale):
         out_shape=jax.ShapeDtypeStruct((S, KV, G, D), jnp.float32),
         interpret=interpret_mode(),
     )(tbl.astype(jnp.int32), lyr, q, pool_k, pool_v, ok2)
+
+
+def sharded_paged_attend(shardings):
+    """paged_decode_attention under a serve (dp, tp) mesh, via shard_map.
+
+    pallas_call is a custom call GSPMD cannot partition, so the sharded
+    serve path wraps the UNCHANGED kernel in core/compat.shard_map and
+    hands each shard its own operands:
+
+      pool_k/pool_v  [NB, L, KV/tp, bT, D] per-shard head slice when
+                     the KV axis shards (each shard DMAs only its own
+                     pages), the whole pool otherwise (replicated);
+      q / ctx        [S/dp, KV', G', D] — whichever head axis the
+                     engine shards (shard_heads), slots split over dp;
+      tbl / ok       replicated across tp (every shard walks the same
+                     block tables), split over dp with their slots;
+      layer          replicated scalar.
+
+    Inside the body the kernel re-checks paged_eligible on its LOCAL
+    shapes (tp defaults to 1 there — the division already happened),
+    so the VMEM gate and the partitioning can never disagree.
+
+    `shardings` is a serve/sharding.ServeSharding (duck-typed: mesh /
+    dp / kv_shards / g_shards). Returns an attend(q, pool_k, pool_v,
+    tbl, layer, ok, scale) drop-in for the paged_attention signature.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from mobilefinetuner_tpu.core.compat import shard_map
+
+    sh = shardings
+    dp = "dp" if sh.dp > 1 else None
+    kv_ax = "tp" if sh.kv_shards > 1 else None
+    g_ax = "tp" if sh.g_shards > 1 else None
+    q_spec = P(dp, kv_ax, g_ax, None)
+    pool_spec = P(None, None, kv_ax, None, None)
+
+    def attend(q, pool_k, pool_v, tbl, layer, ok, scale):
+        def local(q_, pk_, pv_, tbl_, lyr_, ok_):
+            return paged_decode_attention(q_, pk_, pv_, tbl_, lyr_, ok_,
+                                          scale)
+
+        # check_vma=False: the replicated-output proof doesn't see
+        # through the kernel's custom call; the body is deterministic
+        # per shard, so unmentioned axes are replicated by construction
+        fn = shard_map(local, mesh=sh.mesh,
+                       in_specs=(q_spec, pool_spec, pool_spec,
+                                 P(dp, None), P(), P(dp, None)),
+                       out_specs=q_spec, check_vma=False)
+        return fn(q, pool_k, pool_v, tbl,
+                  jnp.asarray(layer, jnp.int32), ok)
+
+    return attend
 
 
 def decode_attention(q, k_cache, v_cache, ok, scale):

@@ -1,6 +1,7 @@
 """Production decode service: continuous batching over static slots, a
 block-paged KV cache shared by every resident request, and multi-adapter
-hot-swap off one frozen base (DESIGN.md §16)."""
+hot-swap off one frozen base (DESIGN.md §16; sharded across a (dp, tp)
+mesh since round 20, DESIGN.md §25)."""
 
 from mobilefinetuner_tpu.serve.adapters import AdapterBank
 from mobilefinetuner_tpu.serve.engine import (Request, ServeConfig,
@@ -8,10 +9,13 @@ from mobilefinetuner_tpu.serve.engine import (Request, ServeConfig,
 from mobilefinetuner_tpu.serve.paged_kv import (TRASH_BLOCK, BlockAllocator,
                                                 OutOfBlocks, blocks_for,
                                                 init_pools,
+                                                pool_partition_spec,
                                                 write_prompt_blocks)
+from mobilefinetuner_tpu.serve.sharding import ServeSharding, make_serve_mesh
 
 __all__ = [
     "AdapterBank", "BlockAllocator", "OutOfBlocks", "Request",
-    "ServeConfig", "ServeEngine", "TRASH_BLOCK", "blocks_for",
-    "init_pools", "write_prompt_blocks",
+    "ServeConfig", "ServeEngine", "ServeSharding", "TRASH_BLOCK",
+    "blocks_for", "init_pools", "make_serve_mesh", "pool_partition_spec",
+    "write_prompt_blocks",
 ]

@@ -54,8 +54,26 @@ class AdapterBank:
             return jax.tree.map(
                 lambda b, n: b.at[i].set(n.astype(b.dtype)), bank, new)
 
+        self._swap_py = _swap
         self._swap = jax.jit(_swap)
         self._zero_one = jax.tree.map(jnp.zeros_like, template)
+        self._put_incoming = None
+
+    def place(self, shardings, put_incoming=None) -> None:
+        """Pin the bank's leaves to `shardings` (a matching tree of
+        NamedShardings — serve/sharding.ServeSharding.bank_shardings
+        builds the block-diagonal layout: B sharded on d_out at
+        column-parallel targets, A on d_in at row-parallel ones). The
+        swap updater is re-jitted with out_shardings pinned so every
+        `at[slot].set` lands back on the SAME placement — hot-swap stays
+        one compiled program at any mesh shape. `put_incoming` (usually
+        ServeSharding.put_repl) commits incoming host trees to the mesh
+        so load/evict never mix committed and uncommitted arguments."""
+        self.tree = jax.device_put(self.tree, shardings)
+        self._swap = jax.jit(self._swap_py, out_shardings=shardings)
+        if put_incoming is not None:
+            self._put_incoming = put_incoming
+            self._zero_one = put_incoming(self._zero_one)
 
     # ------------------------------------------------------------ lookup ----
     @property
@@ -112,6 +130,8 @@ class AdapterBank:
         when the bank is full — eviction policy belongs to the caller
         (the engine knows which residents are referenced)."""
         self._validate(tree)
+        if self._put_incoming is not None:
+            tree = self._put_incoming(tree)
         if name in self.resident:
             i = self.resident[name]
         elif None in self.names:

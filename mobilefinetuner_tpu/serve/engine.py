@@ -150,6 +150,15 @@ class ServeConfig:
                               # the refusal deterministically with it
     hbm_headroom: float = 0.1  # admission margin (same meaning as the
                               # train path's --hbm_headroom)
+    # --- mesh sharding (round 20, serve/sharding.py) ----------------
+    # the serving step runs under a (dp, tp) device mesh when
+    # mesh_dp * mesh_tp > 1: tp shards heads + MLP hidden (and the KV
+    # pool's head axis when divisible), dp shards the slot axis;
+    # (1, 1) — the default — is the unsharded single-chip engine,
+    # bit-for-bit the pre-r20 program. Static: the mesh shape is part
+    # of the compiled programs' identity.
+    mesh_dp: int = 1
+    mesh_tp: int = 1
 
     def validate(self) -> None:
         from mobilefinetuner_tpu.models.lora_apply import \
@@ -171,6 +180,15 @@ class ServeConfig:
             raise ValueError(
                 f"on_step_error must be 'fail_active' or 'raise', got "
                 f"{self.on_step_error!r}")
+        if self.mesh_dp < 1 or self.mesh_tp < 1:
+            raise ValueError(
+                f"mesh_dp and mesh_tp must be >= 1, got "
+                f"({self.mesh_dp}, {self.mesh_tp})")
+        if self.mesh_dp > 1 and self.num_slots % self.mesh_dp:
+            raise ValueError(
+                f"num_slots ({self.num_slots}) must be a multiple of "
+                f"mesh_dp ({self.mesh_dp}): the slot axis is the dp "
+                f"batch axis")
         # the pool must hold at least one worst-case request, or FCFS
         # admission can never fire and drain() spins forever
         worst = blocks_for(self.max_prompt + self.max_new_tokens - 1,
@@ -270,6 +288,15 @@ class ServeEngine:
         self.bank = bank
         self.eos_id, self.pad_id = eos_id, pad_id
         self.dtype = jnp.dtype(cfg.dtype)
+        # (dp, tp) mesh placement (round 20, serve/sharding.py):
+        # ServeSharding owns every NamedSharding decision — weights
+        # column/row-parallel, KV pool per-shard head slices, bank
+        # block-diagonal. None = the unsharded single-chip engine.
+        self.sharding = None
+        if cfg.mesh_dp * cfg.mesh_tp > 1:
+            from mobilefinetuner_tpu.serve.sharding import ServeSharding
+            self.sharding = ServeSharding.build(
+                family, config, cfg.mesh_dp, cfg.mesh_tp)
 
         S = cfg.num_slots
         self.M = blocks_for(cfg.max_prompt + cfg.max_new_tokens - 1,
@@ -311,11 +338,22 @@ class ServeEngine:
                 f"({per_block_mb:.2f} MB/page), which serves at most "
                 f"num_slots={max_slots} worst-case requests of "
                 f"{self.M} pages each", check=self.mem_check)
-        self.params = jax.tree.map(jnp.asarray, params)
+        sh = self.sharding
+        if sh is not None:
+            self.params = jax.device_put(params,
+                                         sh.param_shardings(params))
+            # every host-born array a compiled program sees must be
+            # COMMITTED to the mesh, or jit refuses to mix placements
+            # graftlint: disable=sync-hazard(host-born numpy coerced on its way INTO device_put; no device buffer is read)
+            self._dev = lambda a: jax.device_put(np.asarray(a), sh.repl)
+            if bank is not None:
+                bank.place(sh.bank_shardings(bank.tree), sh.put_repl)
+        else:
+            self.params = jax.tree.map(jnp.asarray, params)
+            self._dev = jnp.asarray
         self.alloc = BlockAllocator(cfg.num_blocks)
         self._pool_dims = (L, KV, D)   # for the containment pool reset
-        self.pool_k, self.pool_v = init_pools(
-            cfg.num_blocks, L, KV, cfg.block_T, D, self.dtype)
+        self.pool_k, self.pool_v = self._init_pools()
         self._tok = np.zeros(S, np.int32)
         self._pos = np.zeros(S, np.int32)
         self._tbl = np.full((S, self.M), TRASH_BLOCK, np.int32)
@@ -354,12 +392,15 @@ class ServeEngine:
         prefill_raw, step_raw = self._prefill_fn, self._step_fn
         conf = config
 
+        shd = self.sharding
+
         def prefill_py(params, bank_tree, ids, mask, aid):
             self.trace_counts["prefill"] += 1
             lora = self._route(bank_tree, aid)
             logits, (pk, pv) = prefill_raw(conf, params, ids, mask,
                                            compute_dtype=dt, lora=lora,
-                                           lora_impl=l_impl)
+                                           lora_impl=l_impl,
+                                           shardings=shd)
             tok0 = jnp.argmax(logits[0], -1).astype(jnp.int32)
             return tok0, pk[:, 0], pv[:, 0]
 
@@ -369,7 +410,7 @@ class ServeEngine:
             logits, pk, pv = step_raw(conf, params, pool_k, pool_v, tok,
                                       pos, tbl, lora=lora,
                                       compute_dtype=dt, attn_impl=impl,
-                                      lora_impl=l_impl)
+                                      lora_impl=l_impl, shardings=shd)
             return jnp.argmax(logits, -1).astype(jnp.int32), pk, pv
 
         def write_py(pool_k, pool_v, k, v, block_ids):
@@ -377,13 +418,25 @@ class ServeEngine:
             return write_prompt_blocks(pool_k, pool_v, k, v, block_ids)
 
         # donating the pools lets XLA scatter in place (the cache never
-        # has two copies); CPU ignores donation, so skip the warning
+        # has two copies); CPU ignores donation, so skip the warning.
+        # Under a mesh the outputs' shardings are PINNED to the inputs'
+        # (pool in == pool out): donation must hand back buffers on the
+        # same placement, and warmup must not depend on what GSPMD
+        # would infer for an output nobody constrained.
         donate = jax.default_backend() != "cpu"
-        self._prefill = jax.jit(prefill_py)
-        self._step = jax.jit(step_py,
-                             donate_argnums=(2, 3) if donate else ())
-        self._write = jax.jit(write_py,
-                              donate_argnums=(0, 1) if donate else ())
+        pool_sh = None if shd is None else shd.pool_sharding()
+        cache_sh = None if shd is None else shd.cache_sharding()
+        self._prefill = jax.jit(
+            prefill_py,
+            out_shardings=None if shd is None
+            else (shd.repl, cache_sh, cache_sh))
+        self._step = jax.jit(
+            step_py, donate_argnums=(2, 3) if donate else (),
+            out_shardings=None if shd is None
+            else (shd.repl, pool_sh, pool_sh))
+        self._write = jax.jit(
+            write_py, donate_argnums=(0, 1) if donate else (),
+            out_shardings=None if shd is None else (pool_sh, pool_sh))
 
         # the lora_impl resolution is a pure function of the engine's
         # static shapes — resolve the decode-step site once and stamp it
@@ -418,7 +471,8 @@ class ServeEngine:
             "adapter_slots": bank.capacity if bank else 0,
             "max_queue": cfg.max_queue, "shed_policy": cfg.shed_policy,
             "on_step_error": cfg.on_step_error,
-            "stats_every": cfg.stats_every}))
+            "stats_every": cfg.stats_every,
+            "mesh_dp": cfg.mesh_dp, "mesh_tp": cfg.mesh_tp}))
         # the admission verdict that let this engine build (the refusal
         # path raised before the stream existed): est vs cap is the
         # "how many more blocks/slots could this chip hold" number the
@@ -426,6 +480,18 @@ class ServeEngine:
         self.telemetry.emit("mem_check", **self.mem_check.event())
 
     # ------------------------------------------------------------ helpers ---
+    def _init_pools(self):
+        """Fresh zeroed pools on their home placement (build + the
+        containment reset share this so a rebuilt pool can never come
+        back on the wrong devices)."""
+        L, KV, D = self._pool_dims
+        pk, pv = init_pools(self.cfg.num_blocks, L, KV,
+                            self.cfg.block_T, D, self.dtype)
+        if self.sharding is not None:
+            psh = self.sharding.pool_sharding()
+            pk, pv = jax.device_put(pk, psh), jax.device_put(pv, psh)
+        return pk, pv
+
     @staticmethod
     def _route(bank_tree, aid):
         """Bank slots -> per-row lora tree (the ids-gather routing)."""
@@ -643,9 +709,10 @@ class ServeEngine:
         ids[0, :P], mask[0, :P] = req.prompt, 1
         bank_tree = self.bank.tree if self.bank else None
         t_prefill = time.perf_counter()
-        tok0, k, v = self._prefill(self.params, bank_tree,
-                                   jnp.asarray(ids), jnp.asarray(mask),
-                                   jnp.asarray([req.aid], jnp.int32))
+        tok0, k, v = self._prefill(
+            self.params, bank_tree, self._dev(ids), self._dev(mask),
+            # graftlint: disable=sync-hazard(host int wrapped for the device; nothing is pulled back)
+            self._dev(np.asarray([req.aid], np.int32)))
         # scatter the prompt pages; table rows past the prompt stay trash
         block_ids = np.full(cfg.max_prompt // cfg.block_T, TRASH_BLOCK,
                             np.int32)
@@ -655,7 +722,7 @@ class ServeEngine:
         # admission containment knows one-victim recovery is not enough
         self._pools_at_risk = True
         self.pool_k, self.pool_v = self._write(
-            self.pool_k, self.pool_v, k, v, jnp.asarray(block_ids))
+            self.pool_k, self.pool_v, k, v, self._dev(block_ids))
         self._pools_at_risk = False
         tok0 = int(tok0)                 # host sync: the first token
         now = time.perf_counter()
@@ -737,9 +804,7 @@ class ServeEngine:
         # again by construction; the pools are rebuilt because a step
         # that died after dispatch may have invalidated the donated
         # buffers (and their contents described only the dead requests)
-        L, KV, D = self._pool_dims
-        self.pool_k, self.pool_v = init_pools(
-            self.cfg.num_blocks, L, KV, self.cfg.block_T, D, self.dtype)
+        self.pool_k, self.pool_v = self._init_pools()
         self._pools_at_risk = False
         return failed
 
@@ -821,8 +886,8 @@ class ServeEngine:
                 self.step_hook(self.decode_steps)
             nxt, pool_k, pool_v = self._step(
                 self.params, bank_tree, self.pool_k, self.pool_v,
-                jnp.asarray(self._tok), jnp.asarray(self._pos),
-                jnp.asarray(self._tbl), jnp.asarray(self._aid))
+                self._dev(self._tok), self._dev(self._pos),
+                self._dev(self._tbl), self._dev(self._aid))
             # graftlint: disable=sync-hazard(the serve loop's ONE host sync per decode step: this step's tokens drive host-side scheduling)
             nxt = np.asarray(nxt)
         except (KeyboardInterrupt, SystemExit):
@@ -920,6 +985,7 @@ class ServeEngine:
             # becomes an allocator failure
             "hbm_mb": round(hbm, 2) if hbm is not None else None,
             "pool_mb": round(self.pool_mb, 2),
+            "mesh": [self.cfg.mesh_dp, self.cfg.mesh_tp],
             "counts": {s: int(self.counts.get(s, 0))
                        for s in Request.TERMINAL},
         }
@@ -933,7 +999,7 @@ class ServeEngine:
             queue_depth=h["queue_depth"], active=h["active"],
             occupancy=h["occupancy"], free_blocks=h["free_blocks"],
             p95_step_ms=h["p95_step_ms"], hbm_mb=h["hbm_mb"],
-            pool_mb=h["pool_mb"], **h["counts"])
+            pool_mb=h["pool_mb"], mesh=h["mesh"], **h["counts"])
 
     # ------------------------------------------------------------ teardown --
     def close(self, exit: str = "ok", reason: Optional[str] = None) -> None:

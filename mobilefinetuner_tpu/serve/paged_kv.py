@@ -51,6 +51,19 @@ def init_pools(num_blocks: int, L: int, KV: int, block_T: int, D: int,
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
+def pool_partition_spec(kv_sharded: bool):
+    """PartitionSpec for the pools under the serve ("dp", "tp") mesh
+    (serve/sharding.py). The KV-head axis is the ONLY shardable one:
+    page identity (NB) must stay whole so one host-side block table
+    serves every shard, L is scanned over, and [bT, D] is the page tile
+    the Pallas kernel DMAs. kv_sharded gives each tp shard a per-shard
+    head slice [NB, L, KV/tp, bT, D] of every page; otherwise (GQA
+    head counts indivisible by tp) the pools replicate and the query
+    groups shard instead (ops/decode_attention.shard_heads)."""
+    from jax.sharding import PartitionSpec as P
+    return P(None, None, "tp", None, None) if kv_sharded else P()
+
+
 def write_prompt_blocks(pool_k, pool_v, k, v, block_ids):
     """Scatter one prefilled request's K/V into its allocated pages.
 

@@ -239,6 +239,59 @@ def test_serve_bench_row_contract(tmp_path):
                                   "decode_step"}
 
 
+def test_serve_bench_mesh_rows_tiny_cpu(tmp_path):
+    """serve_bench --mesh rows (round 20): the per-mesh schema the
+    tp-scaling claim is read from — mesh column, per-chip throughput,
+    the mesh shape in the config name — still compile-stable and
+    bench_compare-loadable."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import bench_compare as bc
+    import serve_bench as sb
+    rows = sb.run_rows("tiny-gpt2", [100.0], n_requests=4, adapters=2,
+                       num_slots=2, block_T=8, num_blocks=32,
+                       max_prompt=16, max_new=4, dtype="float32",
+                       seed=0, prompt_lo=2, mesh_dp=1, mesh_tp=2)
+    (row,) = rows
+    assert row["mesh"] == [1, 2]
+    assert "_mesh1x2" in row["config"]
+    assert row["gen_tok_s"] > 0
+    assert row["tok_s_per_chip"] == round(row["gen_tok_s"] / 2, 1)
+    assert row["new_traces_after_warmup"] == 0
+    suite = str(tmp_path / "suite.json")
+    with open(suite, "w") as f:
+        json.dump({"suite": rows}, f)
+    assert row["config"] in bc.load_rows(suite)
+
+
+@pytest.mark.slow
+def test_bench_decode_mesh_rows_tiny_cpu():
+    """bench_decode --mesh rows (round 20): one row per attention path
+    (xla gather vs pallas kernel) per mesh, so the sharded auto-gate's
+    decision is a benched number — pallas_eligible pins the per-shard
+    verdict, both rows carry TPOT/TTFT/per-chip columns."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import jax.numpy as jnp
+    import bench_decode as bd
+    rows = bd.bench_paged_mesh(False, S=2, dtype=jnp.float32, pipeline=1,
+                               mesh=(1, 2), tiny=True, adapters=2,
+                               n_pair=(2, 4))
+    assert [r["attn_impl"] for r in rows] == ["xla", "pallas"]
+    for r in rows:
+        assert r["mesh"] == [1, 2] and r["adapters"] == 2
+        assert "_mesh1x2_" in r["config"]
+        assert isinstance(r["pallas_eligible"], bool)
+        for key in ("ttft_ms", "tok_s_asymptotic", "tok_s_per_chip",
+                    "wall_ms_lo", "wall_ms_hi"):
+            assert isinstance(r[key], (int, float)) and r[key] > 0, key
+        assert isinstance(r["tpot_ms"], (int, float))
+        assert r["tok_s_per_chip"] == pytest.approx(
+            r["tok_s_asymptotic"] / 2, abs=0.06)  # column rounds to .1
+
+
 def test_bench_checkpoint_rows_contract(tmp_path):
     """tools/bench_checkpoint.py (round 10): each row self-certifies the
     async-save claim it rides on — sync oracle stall vs async blocking

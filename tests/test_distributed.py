@@ -149,11 +149,24 @@ def _launch_smoke(nprocs: int, ndev: int, timeout: int = 420):
     assert len(glosses) == 1, f"Gemma losses disagree: {glosses}"
 
 
+# this jaxlib's CPU client refuses cross-process computations outright
+# (XlaRuntimeError: "Multiprocess computations aren't implemented on the
+# CPU backend"), so the coordinated-process smokes below cannot pass
+# under JAX_PLATFORMS=cpu — they'd burn ~30 s of tier-1 budget spawning
+# and compiling before hitting that wall. Skip them on CPU; they run on
+# any real backend (and as the pod-dryrun artifact).
+_CPU_NO_MULTIPROCESS = pytest.mark.skipif(
+    jax.default_backend() == "cpu",
+    reason="cross-process computations unimplemented on the CPU backend")
+
+
+@_CPU_NO_MULTIPROCESS
 def test_two_process_training_step_agrees():
     """REAL multi-process validation at (2 procs × 4 dev)."""
     _launch_smoke(nprocs=2, ndev=4)
 
 
+@_CPU_NO_MULTIPROCESS
 def test_four_process_hybrid_mesh_agrees():
     """Four coordinated processes × 2 devices: the DCN-aware hybrid mesh
     packs fsdp inside each process's slice and the data axis crosses all

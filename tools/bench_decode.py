@@ -143,6 +143,140 @@ def bench_model(gemma: bool, B: int, P: int, dtype, pipeline: int,
     return row
 
 
+def bench_paged_mesh(gemma: bool, S: int, dtype, pipeline: int,
+                     mesh, tiny: bool = False, adapters: int = 0,
+                     n_pair=(16, 64)):
+    """TPOT/TTFT of the PAGED serving step under a (dp, tp) mesh — one
+    row per attention path (xla gather vs pallas kernel), so the
+    auto-gate's decision under sharding is a benched number, not a
+    guess: `pallas_eligible` records the verdict paged_eligible reaches
+    with PER-SHARD head counts, and the two rows' tpot_ms settle
+    whether it was right on this backend. Contract-tested in tiny CPU
+    mode (tests/test_bench_contract.py)."""
+    import dataclasses
+    from mobilefinetuner_tpu.models import gemma3, gpt2
+    from mobilefinetuner_tpu.models.generate import (
+        gemma3_decode_step_paged, gpt2_decode_step_paged, gpt2_prefill,
+        gemma3_prefill)
+    from mobilefinetuner_tpu.ops.decode_attention import paged_eligible
+    from mobilefinetuner_tpu.serve import init_pools
+    from mobilefinetuner_tpu.serve.sharding import ServeSharding
+
+    dp, tp = mesh
+    if gemma:
+        from mobilefinetuner_tpu.core.config import Gemma3TextConfig
+        config = (Gemma3TextConfig.tiny() if tiny
+                  else Gemma3TextConfig.gemma3_270m())
+        mod, name = gemma3, "gemma270m"
+        step_raw, prefill_raw = gemma3_decode_step_paged, gemma3_prefill
+        L, KV, D = (config.num_hidden_layers,
+                    config.num_key_value_heads, config.head_dim)
+        nq = config.num_attention_heads
+    else:
+        from mobilefinetuner_tpu.core.config import GPT2Config
+        config = GPT2Config.tiny() if tiny else GPT2Config.gpt2_small()
+        if tiny and tp > config.n_head:
+            # tiny GPT-2 has 2 heads; give the mesh enough to split
+            config = dataclasses.replace(config, n_head=4)
+        mod, name = gpt2, "gpt2s"
+        step_raw, prefill_raw = gpt2_decode_step_paged, gpt2_prefill
+        L, KV, D = config.n_layer, config.n_head, config.head_dim
+        nq = config.n_head
+    if tiny:
+        name += "_tiny"
+    family = "gemma" if gemma else "gpt2"
+    params = mod.init_params(config, jax.random.PRNGKey(0))
+
+    lora = None
+    if adapters:
+        from mobilefinetuner_tpu.lora.lora import (assign_adapters,
+                                                   stack_adapters)
+        from serve_bench import rand_adapters
+        trees = rand_adapters(family, config, adapters)
+        lora = assign_adapters(stack_adapters(trees),
+                               [i % adapters for i in range(S)])
+
+    n_lo, n_hi = n_pair
+    bT = 8 if tiny else 16
+    P = bT                                   # one prefilled page/slot
+    M = -(-(P + n_hi + 1) // bT)             # pages per slot, worst case
+    NB = S * M + 1
+    sh = None
+    if dp * tp > 1:
+        sh = ServeSharding.build(family, config, dp, tp)
+        params = jax.device_put(params, sh.param_shardings(params))
+        dev = lambda a: jax.device_put(np.asarray(a), sh.repl)
+        if lora is not None:
+            lora = sh.put_repl(lora)
+    else:
+        dev = jnp.asarray
+    pool_k, pool_v = init_pools(NB, L, KV, bT, D, jnp.dtype(dtype))
+    if sh is not None:
+        psh = sh.pool_sharding()
+        pool_k = jax.device_put(pool_k, psh)
+        pool_v = jax.device_put(pool_v, psh)
+    rng = np.random.default_rng(0)
+    tok = dev(rng.integers(0, config.vocab_size, S).astype(np.int32))
+    pos = dev(np.full(S, P, np.int32))
+    tbl = dev((1 + np.arange(S * M, dtype=np.int32)).reshape(S, M))
+    elig = paged_eligible(KV, nq // KV, bT, D,
+                          jnp.dtype(dtype).itemsize, tp=tp)
+
+    def make_make_f(impl):
+        def make_f(n):
+            def run(params, lora, pk, pv, tok, pos, tbl):
+                def body(carry, _):
+                    tok, pos, pk, pv = carry
+                    logits, pk, pv = step_raw(
+                        config, params, pk, pv, tok, pos, tbl,
+                        lora=lora, compute_dtype=dtype, attn_impl=impl,
+                        shardings=sh)
+                    nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                    return (nxt, pos + 1, pk, pv), None
+                (tok, *_), _ = jax.lax.scan(
+                    body, (tok, pos, pk, pv), None, length=n)
+                return tok
+            f = jax.jit(run)
+            return lambda: f(params, lora, pool_k, pool_v, tok, pos, tbl)
+        return make_f
+
+    # TTFT: one sharded prefill + first token, e2e
+    ids = dev(rng.integers(1, config.vocab_size, (1, P)).astype(np.int32))
+    mask = dev(np.ones((1, P), np.int32))
+    pf = jax.jit(lambda p, i, m: prefill_raw(
+        config, p, i, m, compute_dtype=dtype, shardings=sh)[0])
+    np.asarray(pf(params, ids, mask))               # compile
+    ttft_ms = timed_window(
+        lambda: np.asarray(pf(params, ids, mask)), pipeline) * 1000
+
+    rows = []
+    for impl in ("xla", "pallas"):
+        ms, walls = marginal_ms(make_make_f(impl), n_lo, n_hi,
+                                pipeline=pipeline)
+        row = {
+            "config": f"{name}_paged_S{S}_mesh{dp}x{tp}_{impl}"
+                      + (f"_k{adapters}" if adapters else ""),
+            "B": S, "P": P, "adapters": adapters,
+            "attn_impl": impl, "mesh": [dp, tp],
+            "pallas_eligible": bool(elig),
+            "dtype": str(jnp.dtype(dtype)),
+            "tpot_ms": round(ms, 4),
+            "ttft_ms": round(ttft_ms, 3),
+            "tok_s_asymptotic": (round(S / ms * 1000, 1)
+                                 if ms > 0 else None),
+            "tok_s_per_chip": (round(S / ms * 1000 / (dp * tp), 1)
+                               if ms > 0 else None),
+            "wall_ms_lo": round(walls[n_lo] * 1e3, 3),
+            "wall_ms_hi": round(walls[n_hi] * 1e3, 3),
+        }
+        rows.append(row)
+        print(f"{row['config']}: TPOT {ms:.3f} ms/token-step, "
+              f"TTFT {ttft_ms:.1f} ms, per-chip "
+              f"{row['tok_s_per_chip'] or 'n/a'} tok/s "
+              f"(pallas eligible per-shard: {elig})")
+    return rows
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--gemma", action="store_true")
@@ -162,17 +296,44 @@ def main():
                          "Pallas epilogue at eligible sites)")
     ap.add_argument("--tiny", action="store_true",
                     help="tiny config (CPU contract mode)")
+    ap.add_argument("--mesh", default="",
+                    help="bench the PAGED serving decode step under a "
+                         "(dp, tp) mesh instead of generate(): 'dp,tp' "
+                         "(e.g. '1,4'); emits one row per attention "
+                         "path (xla gather vs pallas kernel) with "
+                         "mesh + tok_s_per_chip — the sharded "
+                         "gather-vs-kernel decision, benched. '1,1' "
+                         "benches the same step unsharded")
     ap.add_argument("--json", action="store_true", dest="json_out",
                     help="emit one JSON row per batch size")
     ap.add_argument("--kernel", action="store_true",
                     help="also run the pallas decode_attention microbench")
     args = ap.parse_args()
+    if args.mesh:
+        import os
+        try:
+            dp, tp = (int(v) for v in args.mesh.split(","))
+        except ValueError:
+            raise SystemExit(f"--mesh must be 'dp,tp', got {args.mesh!r}")
+        if dp * tp > 1 and os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            from mobilefinetuner_tpu.parallel.host_devices import \
+                force_host_devices
+            force_host_devices(max(8, dp * tp))
     dtype = jnp.dtype(args.dtype)
     # tiny configs have n_positions=64: shrink P and the N pair so
     # P + n_hi fits (same values the contract test pins)
     P = args.P or (8 if args.tiny else 128)
     n_pair = (2, 4) if args.tiny else (16, 64)
     for b in args.B:
+        if args.mesh:
+            rows = bench_paged_mesh(args.gemma, b, dtype, args.pipeline,
+                                    (dp, tp), tiny=args.tiny,
+                                    adapters=args.adapters,
+                                    n_pair=n_pair)
+            if args.json_out:
+                for row in rows:
+                    print(json.dumps(row))
+            continue
         row = bench_model(args.gemma, b, P, dtype, args.pipeline,
                           adapters=args.adapters, tiny=args.tiny,
                           n_pair=n_pair, lora_impl=args.lora_impl)

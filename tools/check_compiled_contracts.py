@@ -190,6 +190,72 @@ def prog_decode_gpt2_paged():
     return text, retraces, ()
 
 
+def prog_decode_gpt2_paged_tp():
+    """The SHARDED serve step (r20, serve/sharding.py): GPT-2 paged
+    decode with a 2-tenant block-diagonal bank lowered at a (1, 4)
+    ("dp", "tp") mesh — the GSPMD safety net the tensor-parallel serve
+    plane stands on. The pinned census IS the perf contract: the
+    partitioner may only pay activation-sized all-reduces (row-parallel
+    matmul sums + head re-gathers); a regression that starts moving
+    weight- or pool-sized tensors shows up as new collective entries
+    here, not as a pod bill. Donation and zero-retrace are pinned
+    exactly like the single-chip program's."""
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mobilefinetuner_tpu.core.config import GPT2Config
+    from mobilefinetuner_tpu.lora.lora import (LoRASpec, assign_adapters,
+                                               init_lora_gpt2,
+                                               stack_adapters)
+    from mobilefinetuner_tpu.models import gpt2
+    from mobilefinetuner_tpu.models.generate import gpt2_decode_step_paged
+    from mobilefinetuner_tpu.serve.paged_kv import init_pools
+    from mobilefinetuner_tpu.serve.sharding import ServeSharding
+    # tiny() has 2 heads; tp=4 needs a head-aligned split
+    cfg = dataclasses.replace(GPT2Config.tiny(), n_head=4)
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    L, H = cfg.n_layer, cfg.n_head
+    D = cfg.n_embd // cfg.n_head
+    bT, NB = 8, 8
+    sh = ServeSharding.build("gpt2", cfg, 1, 4)
+    params = jax.device_put(params, sh.param_shardings(params))
+    bank = stack_adapters([
+        init_lora_gpt2(cfg, LoRASpec(rank=2, alpha=4.0),
+                       jax.random.PRNGKey(i + 1)) for i in range(2)])
+    bank = jax.device_put(bank, sh.bank_shardings(bank))
+    pool_k, pool_v = init_pools(NB, L, H, bT, D)
+    psh = sh.pool_sharding()
+    pool_k = jax.device_put(pool_k, psh)
+    pool_v = jax.device_put(pool_v, psh)
+    traces = {"n": 0}
+
+    def step_py(p, bk, pk, pv, tok, pos, tbl, aid):
+        traces["n"] += 1
+        lora = assign_adapters(bk, aid)
+        logits, pk2, pv2 = gpt2_decode_step_paged(
+            cfg, p, pk, pv, tok, pos, tbl, lora=lora,
+            compute_dtype=jnp.float32, attn_impl="xla", shardings=sh)
+        return jnp.argmax(logits, -1).astype(jnp.int32), pk2, pv2
+
+    step = jax.jit(step_py, donate_argnums=(2, 3),
+                   out_shardings=(sh.repl, psh, psh))
+    dev = lambda a: jax.device_put(np.asarray(a), sh.repl)
+    tbl = dev(np.array([[1, 2], [3, 4]], np.int32))
+    aid = dev(np.array([0, 1], np.int32))
+    for i in range(3):
+        tok = dev(np.array([11 + i, 23 + i], np.int32))
+        pos = dev(np.array([i + 1, i + 2], np.int32))
+        _, pool_k, pool_v = step(params, bank, pool_k, pool_v, tok, pos,
+                                 tbl, aid)
+    retraces = traces["n"]
+    tok = dev(np.array([1, 2], np.int32))
+    pos = dev(np.array([4, 5], np.int32))
+    text = step.lower(params, bank, pool_k, pool_v, tok, pos, tbl,
+                      aid).compile().as_text()
+    return text, retraces, ()
+
+
 def prog_multitenant_gpt2():
     """The k-tenant fused optimizer step (ids-routed bank, per-slot
     Adam) — the r18 engine's executable, donated, zero retraces across
@@ -252,6 +318,7 @@ PROGRAMS = {
     "train_gpt2_lora": prog_train_gpt2_lora,
     "train_gpt2_fsdp": prog_train_gpt2_fsdp,
     "decode_gpt2_paged": prog_decode_gpt2_paged,
+    "decode_gpt2_paged_tp": prog_decode_gpt2_paged_tp,
     "multitenant_gpt2": prog_multitenant_gpt2,
 }
 

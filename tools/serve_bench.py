@@ -162,7 +162,8 @@ def build_engine(model: str, num_slots: int, block_T: int,
                  stats_every: int = 0, watchdog=None,
                  hbm_cap_mb: int = 0, hbm_headroom: float = 0.1,
                  trace_spans: bool = False, metrics_port: int = 0,
-                 metrics_addr: str = "127.0.0.1"):
+                 metrics_addr: str = "127.0.0.1",
+                 mesh_dp: int = 1, mesh_tp: int = 1):
     """model: gpt2s | gemma270m | tiny-gpt2 | tiny-gemma. The tiny
     modes are the CPU contract/smoke path (tests/test_serve.py).
 
@@ -202,7 +203,8 @@ def build_engine(model: str, num_slots: int, block_T: int,
                       on_step_error=on_step_error,
                       stats_every=stats_every,
                       hbm_cap_mb=hbm_cap_mb, hbm_headroom=hbm_headroom,
-                      trace_spans=trace_spans)
+                      trace_spans=trace_spans,
+                      mesh_dp=mesh_dp, mesh_tp=mesh_tp)
     tel = Telemetry(telemetry_out)
     registry = None
     if metrics_port > 0:
@@ -290,14 +292,20 @@ def row_from(config_name: str, engine, done, elapsed: float,
     gen_tokens = sum(len(r.tokens) for r in done)
     pct = lambda v: {"p50": percentile(v, 50), "p95": percentile(v, 95),
                      "p99": percentile(v, 99)}
+    chips = engine.cfg.mesh_dp * engine.cfg.mesh_tp
+    gen_tok_s = round(gen_tokens / elapsed, 1) if elapsed > 0 else None
     return {
         "config": config_name,
         "offered_rps": rate,
         "requests": len(fin),
         "elapsed_s": round(elapsed, 3),
         "req_s": round(len(fin) / elapsed, 3) if elapsed > 0 else None,
-        "gen_tok_s": (round(gen_tokens / elapsed, 1)
-                      if elapsed > 0 else None),
+        "gen_tok_s": gen_tok_s,
+        # mesh shape + per-chip throughput: the "is tp paying for
+        # itself" number bench_compare tracks across mesh rows
+        "mesh": [engine.cfg.mesh_dp, engine.cfg.mesh_tp],
+        "tok_s_per_chip": (round(gen_tok_s / chips, 1)
+                           if gen_tok_s is not None else None),
         "ttft_ms": pct(ttfts),
         "tpot_ms": pct(tpots),
         # round 14: where the non-finishers went (the SLO denominator a
@@ -326,7 +334,8 @@ def run_rows(model: str, rates, n_requests: int, adapters: int,
              watchdog_mode: int = 0, watchdog_min_s: float = 60.0,
              hbm_cap_mb: int = 0, hbm_headroom: float = 0.1,
              trace_spans: bool = False, metrics_port: int = 0,
-             metrics_addr: str = "127.0.0.1") -> list:
+             metrics_addr: str = "127.0.0.1",
+             mesh_dp: int = 1, mesh_tp: int = 1) -> list:
     """One engine, one warmup request, then one row per offered rate.
     `drain` arms the SIGTERM PreemptionGuard; `inject` fires its fault
     during the FIRST rate's run (the spec names an absolute decode
@@ -348,7 +357,8 @@ def run_rows(model: str, rates, n_requests: int, adapters: int,
                               hbm_headroom=hbm_headroom,
                               trace_spans=trace_spans,
                               metrics_port=metrics_port,
-                              metrics_addr=metrics_addr)
+                              metrics_addr=metrics_addr,
+                              mesh_dp=mesh_dp, mesh_tp=mesh_tp)
     if wd is not None:
         wd.on_hang = lambda p: eng.telemetry.emit("hang", **p)
         wd.stacks_file = (eng.telemetry.path + ".stacks"
@@ -385,6 +395,8 @@ def run_rows(model: str, rates, n_requests: int, adapters: int,
                                      prompt_lo, prompt_hi, max_new,
                                      deadline_ms=deadline_ms)
             name = f"{model}_serve_k{max(adapters, 1)}_r{rate:g}"
+            if mesh_dp * mesh_tp > 1:
+                name += f"_mesh{mesh_dp}x{mesh_tp}"
             row = row_from(name, eng, done, elapsed, rate, adapters)
             row["health"]["counts"] = {
                 k: int(eng.counts.get(k, 0)) - counts0.get(k, 0)
@@ -449,6 +461,14 @@ def main(argv=None) -> int:
     ap.add_argument("--max_new", type=int, default=32)
     ap.add_argument("--prompt_lo", type=int, default=8)
     ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--mesh", default="1,1",
+                    help="serve the engine over a (dp, tp) device mesh "
+                         "(serve/sharding.py): 'dp,tp', e.g. '1,4' = "
+                         "4-way tensor parallel. Rows gain mesh + "
+                         "tok_s_per_chip and a _mesh{dp}x{tp} config "
+                         "suffix. On CPU (JAX_PLATFORMS=cpu) the "
+                         "8-virtual-device platform is forced "
+                         "automatically")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--telemetry_out", default="")
     ap.add_argument("--out", default="",
@@ -517,6 +537,17 @@ def main(argv=None) -> int:
     model = "gemma270m" if args.gemma else args.model
     if args.inject == "adapter_load_fail" and not args.adapters:
         raise SystemExit("--inject adapter_load_fail needs --adapters k")
+    try:
+        mesh_dp, mesh_tp = (int(v) for v in args.mesh.split(","))
+    except ValueError:
+        raise SystemExit(f"--mesh must be 'dp,tp', got {args.mesh!r}")
+    if mesh_dp * mesh_tp > 1 \
+            and os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # the CPU contract path: virtual devices must exist before the
+        # first backend init (tests/conftest.py does the same)
+        from mobilefinetuner_tpu.parallel.host_devices import \
+            force_host_devices
+        force_host_devices(max(8, mesh_dp * mesh_tp))
     rows = run_rows(model, args.rate, args.requests, args.adapters,
                     num_slots=args.num_slots, block_T=args.block_T,
                     num_blocks=args.num_blocks,
@@ -536,7 +567,8 @@ def main(argv=None) -> int:
                     hbm_headroom=args.hbm_headroom,
                     trace_spans=bool(args.trace_spans),
                     metrics_port=args.metrics_port,
-                    metrics_addr=args.metrics_addr)
+                    metrics_addr=args.metrics_addr,
+                    mesh_dp=mesh_dp, mesh_tp=mesh_tp)
     if args.out:
         art = {"device": jax.devices()[0].device_kind,
                "jax": jax.__version__, "rows": []}

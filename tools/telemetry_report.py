@@ -533,6 +533,8 @@ def serve_stats_summary(events) -> dict:
             sum(e["occupancy"] for e in ss) / len(ss), 4),
         "free_blocks_min": min(e["free_blocks"] for e in ss),
         "p95_step_ms_last": last["p95_step_ms"],
+        # round-20 mesh shape [dp, tp]; absent on pre-sharding streams
+        "mesh": last.get("mesh"),
         "counts": {k: last.get(k, 0) for k in
                    ("finished", "cancelled", "rejected", "timeout",
                     "error")},
@@ -542,11 +544,14 @@ def serve_stats_summary(events) -> dict:
 def serve_stats_lines(s) -> list:
     if not s:
         return []
+    mesh = ""
+    if s.get("mesh"):
+        mesh = f", mesh {s['mesh'][0]}x{s['mesh'][1]}"
     return [f"  serve health: {s['snapshots']} snapshot(s); queue max "
             f"{s['queue_depth_max']} (last {s['queue_depth_last']}), "
             f"occupancy mean {100 * s['occupancy_mean']:.0f}%, free "
             f"pages min {s['free_blocks_min']}, p95 step "
-            f"{_fmt(s['p95_step_ms_last'], 1)} ms"]
+            f"{_fmt(s['p95_step_ms_last'], 1)} ms{mesh}"]
 
 
 def controller_entries(events) -> list:

@@ -252,6 +252,13 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         # round-20 mesh shape [dp, tp] (optional on read: pre-sharding
         # streams); [1, 1] is the single-chip engine
         "mesh": (list,),
+        # round-21 shared-prefix fields (optional on read: pre-r21
+        # streams): fraction of looked-up prompt tokens served from
+        # cached pages (null until the first lookup, or with the cache
+        # off) and cumulative copy-on-write page copies (full-hit
+        # re-feeds splitting their divergence block)
+        "prefix_hit_rate": _OPT_NUM,
+        "cow_copies": _OPT_NUM,
     },
     # one memory-admission verdict (core/memory_guard.py, DESIGN.md
     # §21): immediately post-compile (phase=preflight), on a caught
@@ -402,7 +409,8 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
 # when present they are type-checked as usual.
 OPTIONAL_FIELDS: Dict[str, frozenset] = {
     "step_stats": frozenset({"host_step_ms", "skipped", "tenants"}),
-    "serve_stats": frozenset({"hbm_mb", "pool_mb", "mesh"}),
+    "serve_stats": frozenset({"hbm_mb", "pool_mb", "mesh",
+                              "prefix_hit_rate", "cow_copies"}),
     "run_end": frozenset({"goodput", "reason"}),
     "checkpoint": frozenset({"snapshot_ms", "write_ms", "bytes", "mb_s",
                              "async"}),
@@ -429,7 +437,11 @@ REQUEST_PHASES = ("enqueue", "admit", "first_token", "finish", "cancel",
 #               make room for a new one
 #   shutdown    drain in progress (SIGTERM): queued remainder rejected
 #   deadline    the request's own deadline_ms expired
-REQUEST_REASONS = frozenset({"queue_full", "shed", "shutdown", "deadline"})
+#   prompt_too_long  the prompt exceeds the engine's TRUE cap
+#               (max(max_prompt, max_prompt_chunked), round 21): even
+#               chunked admission cannot hold its pages + max_new
+REQUEST_REASONS = frozenset({"queue_full", "shed", "shutdown", "deadline",
+                             "prompt_too_long"})
 
 
 def validate_event(rec: Any) -> Optional[str]:

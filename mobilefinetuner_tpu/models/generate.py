@@ -89,6 +89,40 @@ def _sample(logits, key, cfg: SampleConfig):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def sample_per_row(logits, temperature, top_k, top_p, keys):
+    """Per-row sampling for the serve engine's slot batch: [S, V]
+    logits with PER-ROW temperature [S] f32 / top_k [S] i32 / top_p
+    [S] f32 and per-row PRNG keys [S, 2] uint32 (raw legacy layout,
+    already fold_in'd with the token's absolute position by the
+    caller). Generalizes _filter_logits' scalar top-k/top-p to vector
+    parameters so one compiled step serves mixed greedy/sampled slots:
+    temperature <= 0 rows take the bit-exact greedy argmax (idle slots
+    and greedy requests), top_k <= 0 / top_p >= 1 disable each filter
+    per row."""
+    S, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    # per-row top-k: kth-value threshold (the row-gathered analog of
+    # _filter_logits' scalar sort-index)
+    k_eff = jnp.where((top_k > 0) & (top_k < V), top_k, V)
+    kth = jnp.sort(scaled, axis=-1)[jnp.arange(S), V - k_eff][:, None]
+    scaled = jnp.where(scaled < kth, NEG_INF, scaled)
+    # per-row top-p: exclusive-cumulative-mass keep mask scattered back
+    # through the descending sort (HF order, as _filter_logits)
+    sort_idx = jnp.argsort(-scaled, axis=-1)
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < jnp.minimum(top_p, 1.0)[:, None]
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(S)[:, None], sort_idx].set(keep_sorted)
+    scaled = jnp.where(keep, scaled, NEG_INF)
+    sampled = jax.vmap(
+        lambda key, row: jax.random.categorical(key, row))(keys, scaled)
+    return jnp.where(temperature <= 0.0, greedy,
+                     sampled.astype(jnp.int32))
+
+
 def _advance(tok_raw, done, cfg: SampleConfig):
     """eos bookkeeping: emit pad for finished rows, mark rows that just
     emitted eos as finished AFTER emitting it."""
@@ -617,6 +651,269 @@ def gemma3_prefill(config: Gemma3TextConfig, params, input_ids,
     lora_b = None if lora is None else lora.get("blocks")
     logits = _head_lora(logits, last, lora_b, lora_impl)
     return logits.astype(jnp.float32), (pk, pv)
+
+
+def gpt2_prefill_chunk(config: GPT2Config, params, pool_k, pool_v, ids,
+                       start, n_tok, tbl, lora=None,
+                       compute_dtype=jnp.float32,
+                       lora_impl: str = "auto", shardings=None):
+    """One fixed-width prefill CHUNK against the block pool (round 21):
+    W prompt tokens starting at absolute position `start`, attending
+    the pages earlier chunks (or the prefix cache) already wrote.
+
+    ids [1, W] the chunk's tokens (pad-padded past n_tok); start 0-d
+    i32 (block_T-aligned chunk origin); n_tok 0-d i32 real tokens in
+    the chunk (1..W); tbl [1, M] the request's block table (garbage
+    regions -> trash block 0). Returns (logits [1, V] f32 at the
+    chunk's last real row, pool_k, pool_v) with the chunk's K/V
+    scattered in at (tbl[0, (start+w)//bT], (start+w)%bT) — padded
+    rows land in the trash page.
+
+    W is one of the engine's STATIC chunk buckets, and start/n_tok ride
+    as 0-d device scalars, so the whole bucket set costs one trace per
+    width — never one per prompt length. Row w's causal span is the
+    union of the already-written prefix (pool columns < start) and the
+    chunk's own rows j <= w, so attention splits into a read-only page
+    gather plus a dense within-chunk part under ONE joint softmax —
+    token-identical to one-shot prefill. The pools are NOT layer-scan
+    carries: the scan threads only the hidden state, stacks each
+    layer's chunk K/V as scan outputs, and a single post-scan scatter
+    lands all L layers' rows at once. That keeps the chunk program's
+    cost proportional to the chunk width, not the pool size (pool-
+    sized carries made every dispatch pay pool-copy traffic on
+    backends without donation). XLA partitions both attention parts
+    under `shardings` like any dense op (a chunk-shaped Pallas kernel
+    is future work, gated behind the same benched decision)."""
+    from mobilefinetuner_tpu.ops.decode_attention import NEG_INF
+    from mobilefinetuner_tpu.serve.paged_kv import TRASH_BLOCK
+    W = ids.shape[1]
+    M = tbl.shape[1]
+    NB, L, H, bT, D = pool_k.shape
+    E = config.n_embd
+    eps = config.layer_norm_epsilon
+    params = jax.tree.map(jnp.asarray, params)
+    lora_b = None if lora is None else lora.get("blocks")
+    cast = lambda t: (t.astype(compute_dtype)
+                      if jnp.issubdtype(t.dtype, jnp.floating) else t)
+    wb = jax.tree.map(cast, params["blocks"])
+    shd = shardings
+
+    rows = jnp.arange(W, dtype=jnp.int32)
+    pos = start + rows                                        # [W]
+    real = rows < n_tok
+    # padded rows clip their position lookup (their K/V goes to trash
+    # and their logits row is never read)
+    x = params["wte"][ids[0]].astype(compute_dtype) \
+        + params["wpe"][jnp.minimum(
+            pos, config.n_positions - 1)].astype(compute_dtype)
+    if shd is not None:
+        x = shd.slots(x)
+    cols = jnp.arange(M * bT, dtype=jnp.int32)
+    # every chunk row shares the prefix span (pool columns < start);
+    # columns >= start are this chunk's own rows, attended densely
+    pre_ok = cols < start                                     # [M*bT]
+    causal = rows[:, None] >= rows[None, :]                   # [W, W]
+    blk = jnp.where(real, tbl[0, pos // bT],
+                    jnp.int32(TRASH_BLOCK))                   # [W]
+    off = pos % bT
+    scale = D ** -0.5
+
+    def apply_lora(y, x_in, name, i):
+        entry = None if lora_b is None else lora_b.get(name)
+        return maybe_lora(y, x_in, entry, i, impl=lora_impl)
+
+    def layer(x, inp):
+        bp, i = inp
+        h = gpt2.layer_norm(x, bp["ln_1"]["g"], bp["ln_1"]["b"], eps)
+        qkv = h @ bp["attn"]["qkv_w"] + bp["attn"]["qkv_b"]
+        qkv = apply_lora(qkv, h, "attn_qkv", i)
+        if lora_b is not None:
+            from mobilefinetuner_tpu.lora.lora import GPT2_SPLIT_QKV_SLOTS
+            for name, slot in GPT2_SPLIT_QKV_SLOTS.items():
+                if name in lora_b:
+                    sl = (Ellipsis, slice(slot * E, (slot + 1) * E))
+                    qkv = qkv.at[sl].set(apply_lora(qkv[sl], h, name, i))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        hd = lambda z: z.reshape(W, H, D)
+        q, k, v = hd(q), hd(k), hd(v)
+        if shd is not None:
+            q, k, v = shd.kv_rows(q), shd.kv_rows(k), shd.kv_rows(v)
+        # pool-dtype roundtrip: within-chunk attention must read the
+        # same values the pages will hold, or chunked-vs-one-shot
+        # token parity drifts at low pool precision
+        kq = k.astype(pool_k.dtype)
+        vq = v.astype(pool_v.dtype)
+        # joint softmax over [prefix pages | chunk rows]: the pools
+        # are closed over READ-ONLY here (gather, never scatter), so
+        # they are not scan carries — dtype discipline mirrors
+        # ops.decode_attention.paged_attention
+        kc = pool_k[tbl[0], i]                    # [M, H, bT, D]
+        vc = pool_v[tbl[0], i]
+        s1 = jnp.einsum("whd,mhtd->whmt", q, kc,
+                        preferred_element_type=jnp.float32) * scale
+        s1 = jnp.where(pre_ok[None, None, :],
+                       s1.reshape(W, H, M * bT), NEG_INF)
+        s2 = jnp.einsum("whd,jhd->whj", q, kq,
+                        preferred_element_type=jnp.float32) * scale
+        s2 = jnp.where(causal[:, None, :], s2, NEG_INF)
+        p = jax.nn.softmax(jnp.concatenate([s1, s2], -1), axis=-1)
+        ctx = jnp.einsum("whmt,mhtd->whd",
+                         p[..., :M * bT].reshape(W, H, M, bT)
+                         .astype(vc.dtype), vc,
+                         preferred_element_type=jnp.float32) \
+            + jnp.einsum("whj,jhd->whd",
+                         p[..., M * bT:].astype(vq.dtype), vq,
+                         preferred_element_type=jnp.float32)
+        if shd is not None:
+            ctx = shd.heads4(ctx[:, :, None, :]).reshape(W, H, D)
+        ctx = ctx.reshape(W, E).astype(compute_dtype)
+        proj = ctx @ bp["attn"]["proj_w"] + bp["attn"]["proj_b"]
+        proj = apply_lora(proj, ctx, "attn_proj", i)
+        x = x + proj
+        h2 = gpt2.layer_norm(x, bp["ln_2"]["g"], bp["ln_2"]["b"], eps)
+        fc = h2 @ bp["mlp"]["fc_w"] + bp["mlp"]["fc_b"]
+        if shd is not None:
+            fc = shd.hidden(fc)
+        fc = gpt2.gelu_new(apply_lora(fc, h2, "mlp_fc_in", i))
+        out = fc @ bp["mlp"]["proj_w"] + bp["mlp"]["proj_b"]
+        out = apply_lora(out, fc, "mlp_fc_out", i)
+        return x + out, (kq, vq)
+
+    x, (ks, vs) = jax.lax.scan(
+        layer, x, (wb, jnp.arange(L, dtype=jnp.int32)))
+    # one scatter for all L layers' chunk rows (padded rows -> trash):
+    # [L, W, H, D] -> assignment shape [W, L, H, D]
+    pool_k = pool_k.at[blk, :, :, off, :].set(ks.transpose(1, 0, 2, 3))
+    pool_v = pool_v.at[blk, :, :, off, :].set(vs.transpose(1, 0, 2, 3))
+    x = gpt2.layer_norm(x, params["ln_f"]["g"].astype(compute_dtype),
+                        params["ln_f"]["b"].astype(compute_dtype), eps)
+    last = jax.lax.dynamic_index_in_dim(x, n_tok - 1, 0,
+                                        keepdims=True)        # [1, E]
+    logits = last @ params["wte"].astype(compute_dtype).T
+    logits = _head_lora(logits, last, lora_b, lora_impl)
+    return logits.astype(jnp.float32), pool_k, pool_v
+
+
+def gemma3_prefill_chunk(config: Gemma3TextConfig, params, pool_k,
+                         pool_v, ids, start, n_tok, tbl, lora=None,
+                         compute_dtype=jnp.float32,
+                         lora_impl: str = "auto", shardings=None):
+    """Gemma-3 prefill chunk (see gpt2_prefill_chunk): GQA pool, per-
+    layer global/local RoPE on the chunk's absolute positions, and the
+    sliding-window validity composed per layer — the same per-layer
+    `where(glob, causal, causal & window)` the paged decode step
+    applies, here split across the read-only prefix gather and the
+    dense within-chunk part of the joint softmax. As in the GPT-2
+    chunk, the pools ride closed-over (reads only) and one post-scan
+    scatter lands every layer's rows."""
+    from mobilefinetuner_tpu.ops.decode_attention import NEG_INF
+    from mobilefinetuner_tpu.serve.paged_kv import TRASH_BLOCK
+    c = config
+    W = ids.shape[1]
+    M = tbl.shape[1]
+    NB, L, KV, bT, D = pool_k.shape
+    nq = c.num_attention_heads
+    G = nq // KV
+    eps = c.rms_norm_eps
+    scale = c.query_pre_attn_scalar ** -0.5
+    params = jax.tree.map(jnp.asarray, params)
+    lora_b = None if lora is None else lora.get("blocks")
+    cast = lambda t: (t.astype(compute_dtype)
+                      if jnp.issubdtype(t.dtype, jnp.floating) else t)
+    wb = jax.tree.map(cast, params["blocks"])
+    is_global = jnp.asarray([c.is_global_layer(i) for i in range(L)])
+    normalizer = jnp.asarray(c.hidden_size ** 0.5, compute_dtype)
+    shd = shardings
+
+    rows = jnp.arange(W, dtype=jnp.int32)
+    pos = start + rows                                        # [W]
+    real = rows < n_tok
+    x = params["embed"][ids[0]].astype(compute_dtype) * normalizer
+    if shd is not None:
+        x = shd.slots(x)
+    cos_g, sin_g = rope_cos_sin(pos[:, None], D, c.rope_theta)
+    cos_l, sin_l = rope_cos_sin(pos[:, None], D, c.rope_local_base_freq)
+    cols = jnp.arange(M * bT, dtype=jnp.int32)
+    pre_valid = jnp.broadcast_to(cols[None, :] < start,
+                                 (W, M * bT))                 # prefix
+    win_ok = (pos[:, None] - cols[None, :]) < c.sliding_window
+    causal = rows[:, None] >= rows[None, :]                   # [W, W]
+    win_in = (rows[:, None] - rows[None, :]) < c.sliding_window
+    blk = jnp.where(real, tbl[0, pos // bT],
+                    jnp.int32(TRASH_BLOCK))
+    off = pos % bT
+
+    def apply_lora(y, x_in, name, i):
+        entry = None if lora_b is None else lora_b.get(name)
+        return maybe_lora(y, x_in, entry, i, impl=lora_impl)
+
+    def layer(x, inp):
+        bp, glob, i = inp
+        a = bp["attn"]
+        h = gemma3.rms_norm(x, bp["input_ln"], eps)
+        q = apply_lora(h @ a["q_w"], h, "q_proj", i).reshape(W, nq, D)
+        k = apply_lora(h @ a["k_w"], h, "k_proj", i).reshape(W, KV, D)
+        v = apply_lora(h @ a["v_w"], h, "v_proj", i).reshape(W, KV, D)
+        q = gemma3.rms_norm(q, a["q_norm"], eps)
+        k = gemma3.rms_norm(k, a["k_norm"], eps)
+        cos = jnp.where(glob, cos_g, cos_l)
+        sin = jnp.where(glob, sin_g, sin_l)
+        q = apply_rope(q[:, :, None, :], cos, sin)[:, :, 0]
+        k = apply_rope(k[:, :, None, :], cos, sin)[:, :, 0]
+        if shd is not None:
+            k, v = shd.kv_rows(k), shd.kv_rows(v)
+        kq = k.astype(pool_k.dtype)               # pool-dtype roundtrip
+        vq = v.astype(pool_v.dtype)
+        ok1 = jnp.where(glob, pre_valid, pre_valid & win_ok)
+        ok2 = jnp.where(glob, causal, causal & win_in)        # [W, W]
+        q4 = q.reshape(W, KV, G, D)
+        if shd is not None:
+            q4 = shd.heads4(q4)
+        kc = pool_k[tbl[0], i]                    # [M, KV, bT, D]
+        vc = pool_v[tbl[0], i]
+        s1 = jnp.einsum("wkgd,mktd->wkgmt", q4, kc,
+                        preferred_element_type=jnp.float32) * scale
+        s1 = jnp.where(ok1[:, None, None, :],
+                       s1.reshape(W, KV, G, M * bT), NEG_INF)
+        s2 = jnp.einsum("wkgd,jkd->wkgj", q4, kq,
+                        preferred_element_type=jnp.float32) * scale
+        s2 = jnp.where(ok2[:, None, None, :], s2, NEG_INF)
+        p = jax.nn.softmax(jnp.concatenate([s1, s2], -1), axis=-1)
+        ctx = jnp.einsum("wkgmt,mktd->wkgd",
+                         p[..., :M * bT].reshape(W, KV, G, M, bT)
+                         .astype(vc.dtype), vc,
+                         preferred_element_type=jnp.float32) \
+            + jnp.einsum("wkgj,jkd->wkgd",
+                         p[..., M * bT:].astype(vq.dtype), vq,
+                         preferred_element_type=jnp.float32)
+        if shd is not None:
+            ctx = shd.heads4(ctx)
+        ctx = ctx.reshape(W, nq * D).astype(compute_dtype)
+        attn_out = apply_lora(ctx @ a["o_w"], ctx, "o_proj", i)
+        attn_out = gemma3.rms_norm(attn_out, bp["post_attn_ln"], eps)
+        x = x + attn_out
+        h2 = gemma3.rms_norm(x, bp["pre_ffn_ln"], eps)
+        act = gemma3.gelu_tanh(
+            apply_lora(h2 @ bp["mlp"]["gate_w"], h2, "gate_proj", i)) \
+            * apply_lora(h2 @ bp["mlp"]["up_w"], h2, "up_proj", i)
+        if shd is not None:
+            act = shd.hidden(act)
+        down = apply_lora(act @ bp["mlp"]["down_w"], act, "down_proj", i)
+        down = gemma3.rms_norm(down, bp["post_ffn_ln"], eps)
+        return x + down, (kq, vq)
+
+    x, (ks, vs) = jax.lax.scan(
+        layer, x, (wb, is_global, jnp.arange(L, dtype=jnp.int32)))
+    # one scatter for all L layers' chunk rows (padded rows -> trash)
+    pool_k = pool_k.at[blk, :, :, off, :].set(ks.transpose(1, 0, 2, 3))
+    pool_v = pool_v.at[blk, :, :, off, :].set(vs.transpose(1, 0, 2, 3))
+    x = gemma3.rms_norm(x, params["final_norm"].astype(compute_dtype),
+                        eps)
+    last = jax.lax.dynamic_index_in_dim(x, n_tok - 1, 0,
+                                        keepdims=True)        # [1, E]
+    logits = last @ params["embed"].astype(compute_dtype).T
+    logits = _head_lora(logits, last, lora_b, lora_impl)
+    return logits.astype(jnp.float32), pool_k, pool_v
 
 
 def gpt2_decode_step_paged(config: GPT2Config, params, pool_k, pool_v,

@@ -11,11 +11,12 @@ from mobilefinetuner_tpu.serve.paged_kv import (TRASH_BLOCK, BlockAllocator,
                                                 init_pools,
                                                 pool_partition_spec,
                                                 write_prompt_blocks)
+from mobilefinetuner_tpu.serve.prefix_cache import PrefixCache, chain_keys
 from mobilefinetuner_tpu.serve.sharding import ServeSharding, make_serve_mesh
 
 __all__ = [
-    "AdapterBank", "BlockAllocator", "OutOfBlocks", "Request",
-    "ServeConfig", "ServeEngine", "ServeSharding", "TRASH_BLOCK",
-    "blocks_for", "init_pools", "make_serve_mesh", "pool_partition_spec",
-    "write_prompt_blocks",
+    "AdapterBank", "BlockAllocator", "OutOfBlocks", "PrefixCache",
+    "Request", "ServeConfig", "ServeEngine", "ServeSharding",
+    "TRASH_BLOCK", "blocks_for", "chain_keys", "init_pools",
+    "make_serve_mesh", "pool_partition_spec", "write_prompt_blocks",
 ]

@@ -17,11 +17,36 @@ one step trace) admissions, evictions, and adapter hot-swaps reuse the
 same two executables (tests/test_serve.py asserts <= 2 traces after
 warmup; `trace_counts` is the observable).
 
-Decoding is greedy: per-request outputs are token-identical to
-batch-at-a-time generate() with the same adapter (the paged-vs-
-contiguous oracle) — deterministic outputs are what make a serving
-rollout auditable. Sampling belongs in a later round (per-slot rng
-state rides the same slot arrays).
+Greedy decode (temperature 0) is the bit-exact oracle: per-request
+outputs are token-identical to batch-at-a-time generate() with the
+same adapter (the paged-vs-contiguous parity suite) — deterministic
+outputs are what make a serving rollout auditable. Round 21 adds
+per-slot SAMPLING as data: temperature/top-k/top-p and a seeded
+per-request PRNG key ride the slot arrays, the key is folded with the
+emitted token's ABSOLUTE position (so cache on/off and chunked/
+unchunked admission draw the identical stream for the same seed), and
+rows with temperature <= 0 still take the greedy argmax inside the
+same compiled step — one executable serves mixed greedy/sampled slots.
+
+Round 21 (DESIGN.md §26) scales the plane to shared traffic:
+
+  - shared-prefix KV reuse: full prompt blocks are chain-hashed
+    (content + KV-producing weight identity) into a PrefixCache;
+    requests with a common prefix map the SAME refcounted
+    physical pages, copy-on-write at the divergence block, freed on
+    last reference — pages whose contents are still cached PARK
+    (reclaimable LRU-first) instead of freeing, so a finished
+    request's prompt pages become the next request's prefix hit;
+  - chunked prefill admission: prompts beyond max_prompt (up to
+    max_prompt_chunked) — and cache-hit suffixes — prefill in static
+    bucket-width chunks under a per-step() token budget of ONE widest
+    bucket across the engine, so a long prompt costs the residents
+    bounded TPOT jitter, never a head-of-line stall (while concurrent
+    short suffixes share a step instead of serializing); chunk widths
+    come from a static bucket set, one trace per width, never one per
+    prompt length;
+  - submit() rejects (reason=prompt_too_long) only beyond the TRUE
+    cap max(max_prompt, max_prompt_chunked); everything else queues.
 
 Scheduling policy is FCFS with conservative page reservation: a request
 is admitted only when its worst case (prompt + max_new_tokens pages)
@@ -84,13 +109,17 @@ from mobilefinetuner_tpu.core.telemetry import (HangWatchdog, Telemetry,
 from mobilefinetuner_tpu.lora.lora import assign_adapters
 from mobilefinetuner_tpu.models.generate import (gemma3_decode_step_paged,
                                                  gemma3_prefill,
+                                                 gemma3_prefill_chunk,
                                                  gpt2_decode_step_paged,
-                                                 gpt2_prefill)
+                                                 gpt2_prefill,
+                                                 gpt2_prefill_chunk,
+                                                 sample_per_row)
 from mobilefinetuner_tpu.serve.adapters import AdapterBank
 from mobilefinetuner_tpu.serve.paged_kv import (TRASH_BLOCK, BlockAllocator,
                                                 OutOfBlocks, blocks_for,
                                                 init_pools,
                                                 write_prompt_blocks)
+from mobilefinetuner_tpu.serve.prefix_cache import PrefixCache, chain_keys
 
 # lock-discipline declaration (core/static_checks.py, DESIGN.md §24):
 # the engine is single-threaded BY DESIGN — every mutation happens on
@@ -159,6 +188,36 @@ class ServeConfig:
     # of the compiled programs' identity.
     mesh_dp: int = 1
     mesh_tp: int = 1
+    # --- traffic-scale serving (round 21, DESIGN.md §26) -------------
+    prefix_cache: bool = False  # shared-prefix KV reuse: chain-hash
+                              # full prompt blocks, refcount pages,
+                              # copy-on-write at the divergence block
+    max_prompt_chunked: int = 0  # the TRUE prompt cap under chunked
+                              # admission (block_T multiple >
+                              # max_prompt); 0 disables chunk-only
+                              # admission — prompts beyond max_prompt
+                              # then reject with reason=prompt_too_long
+    chunk_buckets: tuple = ()  # static chunk widths (block_T
+                              # multiples); () auto-derives doubling
+                              # widths capped at max_prompt — the
+                              # per-dispatch prefill budget the pool
+                              # was sized for — so a long prompt walks
+                              # SEVERAL chunks with decode steps
+                              # between them (bounded in-flight TPOT),
+                              # instead of one cap-wide stall. Each
+                              # width is ONE compiled executable —
+                              # widths bucket, prompt lengths never
+                              # retrace.
+    sampling: bool = False    # per-slot temperature/top-k/top-p +
+                              # seeded PRNG keys ride the slot arrays
+                              # as data; False keeps every program
+                              # bit-identical to the greedy-only engine
+
+    @property
+    def true_cap(self) -> int:
+        """The engine's REAL prompt ceiling: max_prompt one-shot, or
+        max_prompt_chunked when chunked admission extends it."""
+        return max(self.max_prompt, self.max_prompt_chunked)
 
     def validate(self) -> None:
         from mobilefinetuner_tpu.models.lora_apply import \
@@ -189,16 +248,32 @@ class ServeConfig:
                 f"num_slots ({self.num_slots}) must be a multiple of "
                 f"mesh_dp ({self.mesh_dp}): the slot axis is the dp "
                 f"batch axis")
+        if self.max_prompt_chunked:
+            if self.max_prompt_chunked % self.block_T:
+                raise ValueError(
+                    f"max_prompt_chunked ({self.max_prompt_chunked}) "
+                    f"must be a multiple of block_T ({self.block_T})")
+            if self.max_prompt_chunked <= self.max_prompt:
+                raise ValueError(
+                    f"max_prompt_chunked ({self.max_prompt_chunked}) "
+                    f"must exceed max_prompt ({self.max_prompt}) — "
+                    f"prompts within max_prompt prefill one-shot")
+        for w in self.chunk_buckets:
+            if w < 1 or w % self.block_T:
+                raise ValueError(
+                    f"chunk_buckets entries must be positive block_T "
+                    f"({self.block_T}) multiples, got {w}")
         # the pool must hold at least one worst-case request, or FCFS
         # admission can never fire and drain() spins forever
-        worst = blocks_for(self.max_prompt + self.max_new_tokens - 1,
+        worst = blocks_for(self.true_cap + self.max_new_tokens - 1,
                            self.block_T)
         if self.num_blocks - 1 < worst:
             raise ValueError(
                 f"num_blocks={self.num_blocks} cannot hold one "
-                f"worst-case request: max_prompt + max_new_tokens - 1 "
-                f"columns need {worst} pages plus the reserved trash "
-                f"page (have {self.num_blocks - 1} allocatable)")
+                f"worst-case request: true prompt cap ({self.true_cap})"
+                f" + max_new_tokens - 1 columns need {worst} pages "
+                f"plus the reserved trash page (have "
+                f"{self.num_blocks - 1} allocatable)")
 
 
 @dataclasses.dataclass
@@ -222,11 +297,24 @@ class Request:
     finish_t: float = 0.0
     deadline_t: float = 0.0            # absolute perf_counter deadline
                                        # (enqueue_t + deadline_ms); 0=none
+    # round-21 sampling state (rejected at submit() unless the engine
+    # was built with cfg.sampling): temperature 0 = the greedy oracle
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
     # engine-internal
     slot: int = -1
     aid: int = 0
     blocks: List[int] = dataclasses.field(default_factory=list)
     worst_blocks: int = 0
+    # round-21 chunked-admission / prefix-hit state
+    prefill_pos: int = 0               # prompt tokens already cached
+    prefilling: bool = False           # suffix chunks still pending
+    awaiting_first: bool = False       # full prefix hit re-feed: the
+                                       # next decode step emits token 1
+    cache_keys: List[bytes] = dataclasses.field(default_factory=list,
+                                                repr=False)
 
     TERMINAL = ("finished", "cancelled", "rejected", "timeout", "error")
 
@@ -270,18 +358,20 @@ class ServeEngine:
         cfg.validate()
         if family == "gpt2":
             L, KV, D = config.n_layer, config.n_head, config.head_dim
-            if cfg.max_prompt + cfg.max_new_tokens > config.n_positions:
+            if cfg.true_cap + cfg.max_new_tokens > config.n_positions:
                 raise ValueError(
-                    f"max_prompt + max_new_tokens = "
-                    f"{cfg.max_prompt + cfg.max_new_tokens} exceeds "
+                    f"prompt cap + max_new_tokens = "
+                    f"{cfg.true_cap + cfg.max_new_tokens} exceeds "
                     f"n_positions={config.n_positions}")
             self._prefill_fn, self._step_fn = gpt2_prefill, \
                 gpt2_decode_step_paged
+            self._chunk_fn = gpt2_prefill_chunk
         elif family == "gemma":
             L = config.num_hidden_layers
             KV, D = config.num_key_value_heads, config.head_dim
             self._prefill_fn, self._step_fn = gemma3_prefill, \
                 gemma3_decode_step_paged
+            self._chunk_fn = gemma3_prefill_chunk
         else:
             raise ValueError(f"unknown family {family!r}")
         self.family, self.config, self.cfg = family, config, cfg
@@ -299,7 +389,10 @@ class ServeEngine:
                 family, config, cfg.mesh_dp, cfg.mesh_tp)
 
         S = cfg.num_slots
-        self.M = blocks_for(cfg.max_prompt + cfg.max_new_tokens - 1,
+        # block tables are sized for the TRUE cap (== max_prompt when
+        # chunking is off, so the decode program's shape — and its
+        # pinned compiled contract — is unchanged on legacy configs)
+        self.M = blocks_for(cfg.true_cap + cfg.max_new_tokens - 1,
                             cfg.block_T)
         # ---- memory admission at BUILD (round 16, DESIGN.md §21):
         # params + adapter bank + both KV pools are the engine's static
@@ -352,12 +445,45 @@ class ServeEngine:
             self.params = jax.tree.map(jnp.asarray, params)
             self._dev = jnp.asarray
         self.alloc = BlockAllocator(cfg.num_blocks)
+        # shared-prefix reuse (round 21): the cache owns the key<->page
+        # maps, the allocator the refcounts/parking — None = every page
+        # private, the pre-r21 allocator arithmetic exactly
+        self.prefix: Optional[PrefixCache] = (
+            PrefixCache(self.alloc, cfg.block_T)
+            if cfg.prefix_cache else None)
+        self.cow_copies = 0
+        # adapter hot-swap generations: part of the KV identity hashed
+        # into prefix keys, so a reloaded tenant's stale cache entries
+        # become unreachable (they drain via LRU parking, never served)
+        self._adapter_gen: collections.Counter = collections.Counter()
+        # static chunk widths (sorted): smallest bucket covering the
+        # remaining suffix wins, else the largest rides repeated steps
+        self.chunk_buckets: tuple = tuple(sorted(set(cfg.chunk_buckets)))
+        if not self.chunk_buckets:
+            # widths cap at max_prompt (block-rounded), NOT true_cap:
+            # max_prompt is the one-dispatch prefill budget the
+            # operator sized, so longer prompts ride it in slices —
+            # per-step work stays bounded and decode interleaves
+            cap = blocks_for(cfg.max_prompt, cfg.block_T) * cfg.block_T
+            w, ws = cfg.block_T, []
+            while w < cap:
+                ws.append(w)
+                w *= 2
+            ws.append(cap)
+            self.chunk_buckets = tuple(sorted(set(ws)))
         self._pool_dims = (L, KV, D)   # for the containment pool reset
         self.pool_k, self.pool_v = self._init_pools()
         self._tok = np.zeros(S, np.int32)
         self._pos = np.zeros(S, np.int32)
         self._tbl = np.full((S, self.M), TRASH_BLOCK, np.int32)
         self._aid = np.zeros(S, np.int32)
+        # round-21 per-slot sampling state — DATA, not branches: rows
+        # with temperature <= 0 take the greedy argmax inside the same
+        # compiled step (idle slots and greedy requests alike)
+        self._temp = np.zeros(S, np.float32)
+        self._topk = np.zeros(S, np.int32)
+        self._topp = np.ones(S, np.float32)
+        self._key = np.zeros((S, 2), np.uint32)
         self._slots: List[Optional[Request]] = [None] * S
         self.queue: collections.deque = collections.deque()
         self.decode_steps = 0
@@ -390,32 +516,75 @@ class ServeEngine:
         dt, impl = self.dtype, cfg.attn_impl
         l_impl = cfg.lora_impl
         prefill_raw, step_raw = self._prefill_fn, self._step_fn
+        chunk_raw = self._chunk_fn
         conf = config
+        sampling = cfg.sampling
 
         shd = self.sharding
 
-        def prefill_py(params, bank_tree, ids, mask, aid):
+        def _select(logits, pos_next, temp, topk, topp, key2):
+            # key2 [R, 2] raw per-row keys, folded with the emitted
+            # token's ABSOLUTE position pos_next [R] — one convention
+            # across prefill/chunk/decode, so cache on/off and chunked/
+            # unchunked admission draw the identical stream per seed
+            folded = jax.vmap(jax.random.fold_in)(key2, pos_next)
+            return sample_per_row(logits, temp, topk, topp, folded)
+
+        def prefill_py(params, bank_tree, ids, mask, aid, *samp):
             self.trace_counts["prefill"] += 1
             lora = self._route(bank_tree, aid)
             logits, (pk, pv) = prefill_raw(conf, params, ids, mask,
                                            compute_dtype=dt, lora=lora,
                                            lora_impl=l_impl,
                                            shardings=shd)
-            tok0 = jnp.argmax(logits[0], -1).astype(jnp.int32)
+            if sampling:
+                n_real = mask.sum(-1).astype(jnp.int32)       # [1]
+                tok0 = _select(logits, n_real, *samp)[0]
+            else:
+                tok0 = jnp.argmax(logits[0], -1).astype(jnp.int32)
             return tok0, pk[:, 0], pv[:, 0]
 
-        def step_py(params, bank_tree, pool_k, pool_v, tok, pos, tbl, aid):
+        def step_py(params, bank_tree, pool_k, pool_v, tok, pos, tbl,
+                    aid, *samp):
             self.trace_counts["decode_step"] += 1
             lora = self._route(bank_tree, aid)
             logits, pk, pv = step_raw(conf, params, pool_k, pool_v, tok,
                                       pos, tbl, lora=lora,
                                       compute_dtype=dt, attn_impl=impl,
                                       lora_impl=l_impl, shardings=shd)
-            return jnp.argmax(logits, -1).astype(jnp.int32), pk, pv
+            if sampling:
+                nxt = _select(logits, pos + 1, *samp)
+            else:
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            return nxt, pk, pv
 
         def write_py(pool_k, pool_v, k, v, block_ids):
             self.trace_counts["write_prefill"] += 1
             return write_prompt_blocks(pool_k, pool_v, k, v, block_ids)
+
+        def chunk_py(params, bank_tree, pool_k, pool_v, ids, start,
+                     n_tok, tbl, aid, *samp):
+            self.trace_counts["prefill_chunk"] += 1
+            W = ids.shape[1]
+            # one request's rows all route the same adapter — broadcast
+            # the [1] aid to the row count so the per-row lora gather is
+            # shape-identical to the decode step's
+            lora = self._route(bank_tree, jnp.broadcast_to(aid, (W,)))
+            logits, pk, pv = chunk_raw(conf, params, pool_k, pool_v,
+                                       ids, start, n_tok, tbl, lora=lora,
+                                       compute_dtype=dt,
+                                       lora_impl=l_impl, shardings=shd)
+            if sampling:
+                tok = _select(logits, (start + n_tok)[None], *samp)[0]
+            else:
+                tok = jnp.argmax(logits[0], -1).astype(jnp.int32)
+            return tok, pk, pv
+
+        def cow_py(pool_k, pool_v, src, dst):
+            self.trace_counts["cow_copy"] += 1
+            pk = pool_k.at[dst].set(pool_k[src])
+            pv = pool_v.at[dst].set(pool_v[src])
+            return pk, pv
 
         # donating the pools lets XLA scatter in place (the cache never
         # has two copies); CPU ignores donation, so skip the warning.
@@ -436,6 +605,13 @@ class ServeEngine:
             else (shd.repl, pool_sh, pool_sh))
         self._write = jax.jit(
             write_py, donate_argnums=(0, 1) if donate else (),
+            out_shardings=None if shd is None else (pool_sh, pool_sh))
+        self._chunk = jax.jit(
+            chunk_py, donate_argnums=(2, 3) if donate else (),
+            out_shardings=None if shd is None
+            else (shd.repl, pool_sh, pool_sh))
+        self._cow = jax.jit(
+            cow_py, donate_argnums=(0, 1) if donate else (),
             out_shardings=None if shd is None else (pool_sh, pool_sh))
 
         # the lora_impl resolution is a pure function of the engine's
@@ -472,7 +648,11 @@ class ServeEngine:
             "max_queue": cfg.max_queue, "shed_policy": cfg.shed_policy,
             "on_step_error": cfg.on_step_error,
             "stats_every": cfg.stats_every,
-            "mesh_dp": cfg.mesh_dp, "mesh_tp": cfg.mesh_tp}))
+            "mesh_dp": cfg.mesh_dp, "mesh_tp": cfg.mesh_tp,
+            "prefix_cache": cfg.prefix_cache,
+            "max_prompt_chunked": cfg.max_prompt_chunked,
+            "chunk_buckets": list(self.chunk_buckets),
+            "sampling": cfg.sampling}))
         # the admission verdict that let this engine build (the refusal
         # path raised before the stream existed): est vs cap is the
         # "how many more blocks/slots could this chip hold" number the
@@ -576,7 +756,9 @@ class ServeEngine:
                 f"adapter {name!r} is routed by in-flight requests; "
                 f"drain them before replacing it")
         if isinstance(source, dict):
-            return self.bank.load(name, source)
+            slot = self.bank.load(name, source)
+            self._adapter_gen[name] += 1
+            return slot
         from mobilefinetuner_tpu.io.safetensors_io import \
             CheckpointIntegrityError
         try:
@@ -588,6 +770,10 @@ class ServeEngine:
         if verify:
             self.telemetry.emit("ckpt_verify", path=str(source), ok=True,
                                 reason=None, step=None, action="load")
+        # the swap changes the KV-producing weights under this name:
+        # bump its generation so prefix keys hashed against the old
+        # weights become unreachable (stale pages drain via LRU parking)
+        self._adapter_gen[name] += 1
         return slot
 
     def evict_adapter(self, name: str) -> int:
@@ -596,7 +782,17 @@ class ServeEngine:
         if self._adapter_in_use(name):
             raise RuntimeError(
                 f"adapter {name!r} is routed by in-flight requests")
-        return self.bank.evict(name)
+        slot = self.bank.evict(name)
+        self._adapter_gen[name] += 1
+        return slot
+
+    def _kv_identity(self, req: Request) -> str:
+        """The KV-producing weight identity hashed into prefix keys:
+        the frozen base, or adapter name + hot-swap generation — a
+        reloaded tenant can never hit another generation's pages."""
+        if req.adapter is None:
+            return "base"
+        return f"{req.adapter}:{self._adapter_gen[req.adapter]}"
 
     def _adapter_in_use(self, name: str) -> bool:
         # QUEUED requests count as in-use too: submit() resolved their
@@ -611,14 +807,21 @@ class ServeEngine:
     # ------------------------------------------------------------ intake ----
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 0,
                adapter: Optional[str] = None,
-               deadline_ms: Optional[float] = None) -> Request:
+               deadline_ms: Optional[float] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, seed: int = 0) -> Request:
         """Enqueue one request (admission happens inside step()).
         `deadline_ms` is the request's end-to-end budget from now: a
         queued request past it times out without prefilling, an active
         one is cancelled at the next step boundary with partial output.
-        Under a full bounded queue (`max_queue`) the returned request
-        may already be terminal (state="rejected") — check `.state`
-        rather than assuming it queued."""
+        temperature/top_k/top_p/seed (cfg.sampling engines only) ride
+        the request's slot as data; temperature 0 is the greedy oracle
+        and a given seed is deterministic. Under a full bounded queue
+        (`max_queue`) — or a prompt beyond the true cap
+        (reason="prompt_too_long"); prompts in (max_prompt, true_cap]
+        route to chunked admission instead, since round 21 — the
+        returned request may already be terminal (state="rejected"):
+        check `.state` rather than assuming it queued."""
         if self._closed:
             raise RuntimeError(
                 "submit() on a closed ServeEngine: close() already "
@@ -626,10 +829,17 @@ class ServeEngine:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
-        if len(prompt) > self.cfg.max_prompt:
+        if (temperature or top_k or top_p != 1.0 or seed) \
+                and not self.cfg.sampling:
             raise ValueError(
-                f"prompt length {len(prompt)} exceeds the engine's "
-                f"max_prompt={self.cfg.max_prompt}")
+                "sampling parameters need a sampling-enabled engine "
+                "(ServeConfig.sampling=True)")
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
         n_new = max_new_tokens or self.cfg.max_new_tokens
         if not 0 < n_new <= self.cfg.max_new_tokens:
             raise ValueError(
@@ -649,11 +859,21 @@ class ServeEngine:
             aid = self.bank.slot(adapter)
         req = Request(id=self._next_id, prompt=prompt,
                       max_new_tokens=n_new, adapter=adapter, aid=aid,
-                      enqueue_t=time.perf_counter())
+                      enqueue_t=time.perf_counter(),
+                      temperature=float(temperature), top_k=int(top_k),  # graftlint: disable=sync-hazard(host submit args normalized; no device buffer is read)
+                      top_p=float(top_p), seed=int(seed))  # graftlint: disable=sync-hazard(host submit args normalized; no device buffer is read)
         if deadline_ms is not None:
             req.deadline_t = req.enqueue_t + deadline_ms / 1000.0
         self._next_id += 1
         self._emit_request(req, phase="enqueue")
+        if len(prompt) > self.cfg.true_cap:
+            # beyond even chunked admission: a POLICY reject the caller
+            # reads off .state, not a programming error — the pre-r21
+            # ValueError is gone (prompts in (max_prompt, true_cap]
+            # are valid chunked admissions now)
+            self._terminal(req, "rejected", phase="reject",
+                           reason="prompt_too_long")
+            return req
         if self.draining:
             # drain in progress: admissions are closed for good
             self._terminal(req, "rejected", phase="reject",
@@ -689,12 +909,29 @@ class ServeEngine:
         self._terminal(req, "cancelled", phase="cancel")
 
     # ------------------------------------------------------------ the loop --
+    def _samp_args(self, req: Request) -> tuple:
+        """Per-request sampling params for the single-row programs
+        (prefill/chunk) — empty on greedy-only engines, so those
+        programs keep their pre-r21 signatures bit-for-bit."""
+        if not self.cfg.sampling:
+            return ()
+        # graftlint: disable=sync-hazard(host scalars wrapped for the device; nothing is pulled back)
+        return (self._dev(np.asarray([req.temperature], np.float32)),
+                self._dev(np.asarray([req.top_k], np.int32)),  # graftlint: disable=sync-hazard(host scalars wrapped for the device; nothing is pulled back)
+                self._dev(np.asarray([req.top_p], np.float32)),  # graftlint: disable=sync-hazard(host scalars wrapped for the device; nothing is pulled back)
+                self._dev(np.asarray(  # graftlint: disable=sync-hazard(host scalars wrapped for the device; nothing is pulled back)
+                    [[(req.seed >> 32) & 0xFFFFFFFF,
+                      req.seed & 0xFFFFFFFF]], np.uint32)))
+
     def _admit(self, req: Request, slot: int) -> None:
+        """Slot grant + path dispatch: one-shot prefill (the classic
+        path — full miss within max_prompt), full-hit re-feed (every
+        prompt block cached), or chunked suffix prefill (partial hit,
+        or a long prompt)."""
         cfg = self.cfg
         P = len(req.prompt)
         req.worst_blocks = blocks_for(P + req.max_new_tokens - 1,
                                       cfg.block_T)
-        req.blocks = self.alloc.alloc(blocks_for(P, cfg.block_T))
         req.slot, req.state = slot, "active"
         if self.bank is None:
             req.aid = 0
@@ -703,7 +940,32 @@ class ServeEngine:
         else:
             req.aid = self.bank.base_slot  # zero slot: serve the base
         self._slots[slot] = req
+        self._aid[slot] = req.aid
+        if cfg.sampling:
+            self._temp[slot] = req.temperature
+            self._topk[slot] = req.top_k
+            self._topp[slot] = req.top_p
+            self._key[slot] = ((req.seed >> 32) & 0xFFFFFFFF,
+                               req.seed & 0xFFFFFFFF)
+        cached: List[int] = []
+        if self.prefix is not None:
+            req.cache_keys = chain_keys(req.prompt, cfg.block_T,
+                                        self._kv_identity(req))
+            cached = self.prefix.lookup(req.cache_keys)
+            self.prefix.note_lookup(len(cached) * cfg.block_T, P)
+        C = len(cached) * cfg.block_T    # cached prefix, tokens
+        if C == P:                       # full hit (P a block multiple)
+            self._admit_full_hit(req, cached)
+        elif C == 0 and P <= cfg.max_prompt:
+            self._admit_prefill(req)
+        else:                            # suffix hit, or a long prompt
+            self._admit_chunked(req, cached, C)
 
+    def _admit_prefill(self, req: Request) -> None:
+        """The classic ONE-SHOT prefill (the pre-r21 path, unchanged):
+        full cache miss, prompt within max_prompt."""
+        cfg, slot, P = self.cfg, req.slot, len(req.prompt)
+        req.blocks = self.alloc.alloc(blocks_for(P, cfg.block_T))
         ids = np.full((1, cfg.max_prompt), self.pad_id, np.int32)
         mask = np.zeros((1, cfg.max_prompt), np.int32)
         ids[0, :P], mask[0, :P] = req.prompt, 1
@@ -712,7 +974,8 @@ class ServeEngine:
         tok0, k, v = self._prefill(
             self.params, bank_tree, self._dev(ids), self._dev(mask),
             # graftlint: disable=sync-hazard(host int wrapped for the device; nothing is pulled back)
-            self._dev(np.asarray([req.aid], np.int32)))
+            self._dev(np.asarray([req.aid], np.int32)),
+            *self._samp_args(req))
         # scatter the prompt pages; table rows past the prompt stay trash
         block_ids = np.full(cfg.max_prompt // cfg.block_T, TRASH_BLOCK,
                             np.int32)
@@ -742,22 +1005,153 @@ class ServeEngine:
         self._tok[slot], self._pos[slot] = tok0, P
         self._tbl[slot] = TRASH_BLOCK
         self._tbl[slot, :len(req.blocks)] = req.blocks
-        self._aid[slot] = req.aid
+        if self.prefix is not None:
+            # every FULL prompt block this prefill computed is now
+            # shareable (first writer wins on races); decode never
+            # rewrites prompt columns, so registered pages stay
+            # immutable (cache_keys has P // block_T entries: zip
+            # skips the partial tail block by construction)
+            for key, b in zip(req.cache_keys, req.blocks):
+                self.prefix.register(key, b)
         self._emit_request(req, phase="admit")
         self._emit_request(req, phase="first_token")
         if (self.eos_id is not None and tok0 == self.eos_id) \
                 or req.max_new_tokens == 1:
             self._finish(req)
 
+    def _admit_full_hit(self, req: Request, cached: List[int]) -> None:
+        """Every prompt block is cached: skip prefill entirely and
+        RE-FEED the last prompt token through the decode step — slot
+        pos = P-1, so the next decode writes that one column and emits
+        the request's first token at position P. The rewritten column
+        lands in the last shared page, so that page is COPIED first
+        (copy-on-write at the divergence block): shared page contents
+        are immutable by construction, whatever this request does."""
+        cfg, slot, P = self.cfg, req.slot, len(req.prompt)
+        # acquisition order matters: acquire (pin) every cached page
+        # BEFORE alloc() could LRU-evict a parked one out from under us
+        for b in cached:
+            self.alloc.acquire(b)
+        dst = self.alloc.alloc(1)[0]
+        src = cached[-1]
+        # drop our reference on the source BEFORE the copy dispatches:
+        # req.blocks then lists exactly the pages containment would
+        # release if the (pool-donating) copy dies. Parking preserves
+        # contents and nothing allocates before the copy reads it.
+        self.alloc.free([src], park=self.prefix.park)
+        req.blocks = cached[:-1] + [dst]
+        self._pools_at_risk = True
+        self.pool_k, self.pool_v = self._cow(
+            self.pool_k, self.pool_v,
+            # graftlint: disable=sync-hazard(host ints wrapped for the device; nothing is pulled back)
+            self._dev(np.asarray(src, np.int32)),
+            self._dev(np.asarray(dst, np.int32)))  # graftlint: disable=sync-hazard(host ints wrapped for the device; nothing is pulled back)
+        self._pools_at_risk = False
+        self.cow_copies += 1
+        now = time.perf_counter()
+        req.admit_t = now
+        req.awaiting_first = True
+        self._tok[slot] = req.prompt[-1]
+        self._pos[slot] = P - 1
+        self._tbl[slot] = TRASH_BLOCK
+        self._tbl[slot, :len(req.blocks)] = req.blocks
+        if self.tracer.enabled:
+            # no prefill span: the whole prompt came from cached pages
+            self.tracer.emit_span(
+                "queue", f"req:{req.id}", req.enqueue_t,
+                (now - req.enqueue_t) * 1000.0, id=req.id)
+        self._emit_request(req, phase="admit")
+
+    def _admit_chunked(self, req: Request, cached: List[int],
+                       C: int) -> None:
+        """Chunked admission: the uncached SUFFIX (from the first
+        uncached block — the whole prompt on a miss) prefills in static
+        bucket-width chunks, at most one per step(), interleaved with
+        decode. The slot holds idle data (pos=0, tbl=trash) until the
+        final chunk lands the first token, so the compiled step treats
+        a mid-prefill request exactly like an empty slot."""
+        cfg, slot, P = self.cfg, req.slot, len(req.prompt)
+        for b in cached:
+            self.alloc.acquire(b)        # pin before alloc() can evict
+        req.blocks = list(cached) + self.alloc.alloc(
+            blocks_for(P, cfg.block_T) - len(cached))
+        req.prefill_pos = C
+        req.prefilling = True
+        req.admit_t = time.perf_counter()
+        self._tok[slot] = self._pos[slot] = 0
+        self._tbl[slot] = TRASH_BLOCK
+        if self.tracer.enabled:
+            self.tracer.emit_span(
+                "queue", f"req:{req.id}", req.enqueue_t,
+                (req.admit_t - req.enqueue_t) * 1000.0, id=req.id)
+        self._emit_request(req, phase="admit")
+
+    def _prefill_chunk(self, req: Request) -> None:
+        """Dispatch ONE chunk of `req`'s pending prompt suffix: the
+        smallest static bucket covering the remainder (else the
+        largest, and the tail rides later steps). The final chunk's
+        last-row logits are the request's first token."""
+        cfg, slot, P = self.cfg, req.slot, len(req.prompt)
+        start = req.prefill_pos
+        remaining = P - start
+        W = next((w for w in self.chunk_buckets if w >= remaining),
+                 self.chunk_buckets[-1])
+        n_tok = min(remaining, W)
+        ids = np.full((1, W), self.pad_id, np.int32)
+        ids[0, :n_tok] = req.prompt[start:start + n_tok]
+        tbl = np.full((1, self.M), TRASH_BLOCK, np.int32)
+        tbl[0, :len(req.blocks)] = req.blocks
+        bank_tree = self.bank.tree if self.bank else None
+        t_chunk = time.perf_counter()
+        # the chunk donates the pools: a failure here is a full-
+        # containment window, same as the prompt-page write
+        self._pools_at_risk = True
+        tok, self.pool_k, self.pool_v = self._chunk(
+            self.params, bank_tree, self.pool_k, self.pool_v,
+            self._dev(ids),
+            # graftlint: disable=sync-hazard(host ints wrapped for the device; nothing is pulled back)
+            self._dev(np.asarray(start, np.int32)),
+            self._dev(np.asarray(n_tok, np.int32)), self._dev(tbl),  # graftlint: disable=sync-hazard(host ints wrapped for the device; nothing is pulled back)
+            self._dev(np.asarray([req.aid], np.int32)),  # graftlint: disable=sync-hazard(host ints wrapped for the device; nothing is pulled back)
+            *self._samp_args(req))
+        self._pools_at_risk = False
+        req.prefill_pos += n_tok
+        if self.tracer.enabled:
+            self.tracer.emit_span(
+                "prefill", f"req:{req.id}", t_chunk,
+                (time.perf_counter() - t_chunk) * 1000.0, id=req.id)
+        if req.prefill_pos < P:
+            return
+        # final chunk: its last real row IS the request's first token
+        tok0 = int(tok)                  # host sync
+        req.prefilling = False
+        req.first_token_t = time.perf_counter()
+        req.tokens.append(tok0)
+        self._tok[slot], self._pos[slot] = tok0, P
+        self._tbl[slot] = TRASH_BLOCK
+        self._tbl[slot, :len(req.blocks)] = req.blocks
+        if self.prefix is not None:
+            for key, b in zip(req.cache_keys, req.blocks):
+                self.prefix.register(key, b)
+        self._emit_request(req, phase="first_token")
+        if (self.eos_id is not None and tok0 == self.eos_id) \
+                or req.max_new_tokens == 1:
+            self._finish(req)
+
     def _release(self, req: Request) -> None:
-        self.alloc.free(req.blocks)
+        park = self.prefix.park if self.prefix is not None else None
+        self.alloc.free(req.blocks, park=park)
         req.blocks = []
+        req.prefilling = req.awaiting_first = False
         s = req.slot
         if s < 0:   # admission died before the slot was taken: nothing
             return  # slot-side to clean (containment path)
         self._slots[s] = None
         self._tok[s] = self._pos[s] = self._aid[s] = 0
         self._tbl[s] = TRASH_BLOCK
+        if self.cfg.sampling:
+            self._temp[s], self._topk[s], self._topp[s] = 0.0, 0, 1.0
+            self._key[s] = 0
 
     def _finish(self, req: Request) -> None:
         self._release(req)
@@ -805,6 +1199,12 @@ class ServeEngine:
         # that died after dispatch may have invalidated the donated
         # buffers (and their contents described only the dead requests)
         self.pool_k, self.pool_v = self._init_pools()
+        if self.prefix is not None:
+            # the rebuilt pools hold NONE of the cached contents: drop
+            # every mapping and parked page (the releases above just
+            # parked the dead requests' shared pages — flush un-parks
+            # them back to the plain free list)
+            self.prefix.flush()
         self._pools_at_risk = False
         return failed
 
@@ -863,7 +1263,44 @@ class ServeEngine:
             if req.state == "finished":  # eos/cap hit on the first token
                 done.append(req)
 
-        live = self.active
+        # chunked prefill (round 21): dispatch chunks FCFS (oldest
+        # request first) until this step's prefill-token BUDGET — one
+        # widest bucket — is spent. The budget is what bounds the
+        # residents' TPOT jitter; spending it on several small suffix
+        # chunks (concurrent prefix hits) costs the residents the same
+        # as one wide chunk, but keeps short suffixes from serializing
+        # at one chunk per decode-step turn (a first-token tax measured
+        # at ~1 decode step per queued hit on CPU gpt2s)
+        budget = self.chunk_buckets[-1] if self.chunk_buckets else 0
+        while budget > 0:
+            chunking = [r for r in self.active if r.prefilling]
+            if not chunking:
+                break
+            req = min(chunking, key=lambda r: r.id)
+            remaining = len(req.prompt) - req.prefill_pos
+            W = next((w for w in self.chunk_buckets if w >= remaining),
+                     self.chunk_buckets[-1])
+            if W > budget:
+                break                    # next chunk outlives the budget
+            try:
+                self._prefill_chunk(req)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                # the chunk donates the pools: every resident's cache
+                # is suspect — full containment, same as a dead step
+                done.extend(self._contain_step_error(e))
+                if cfg.on_step_error == "raise":
+                    raise
+                return done
+            budget -= W
+            if req.done:                 # eos/cap on the final chunk
+                done.append(req)
+
+        # mid-prefill requests hold idle slot data: the compiled step
+        # runs over every slot regardless, but only completed-prefill
+        # rows advance host-side
+        live = [r for r in self.active if not r.prefilling]
         if not live:
             return done
         # a slot crossing a page boundary this step takes its next page
@@ -884,10 +1321,18 @@ class ServeEngine:
         try:
             if self.step_hook is not None:
                 self.step_hook(self.decode_steps)
-            nxt, pool_k, pool_v = self._step(
+            step_args = [
                 self.params, bank_tree, self.pool_k, self.pool_v,
                 self._dev(self._tok), self._dev(self._pos),
-                self._dev(self._tbl), self._dev(self._aid))
+                self._dev(self._tbl), self._dev(self._aid)]
+            if cfg.sampling:
+                # sampling state rides AFTER the legacy args so the
+                # pool donation indices (2, 3) never move
+                step_args += [self._dev(self._temp),
+                              self._dev(self._topk),
+                              self._dev(self._topp),
+                              self._dev(self._key)]
+            nxt, pool_k, pool_v = self._step(*step_args)
             # graftlint: disable=sync-hazard(the serve loop's ONE host sync per decode step: this step's tokens drive host-side scheduling)
             nxt = np.asarray(nxt)
         except (KeyboardInterrupt, SystemExit):
@@ -909,6 +1354,12 @@ class ServeEngine:
             self._pos[s] += 1
             self._tok[s] = int(nxt[s])
             req.tokens.append(int(nxt[s]))
+            if req.awaiting_first:
+                # full-hit re-feed: THIS decode emitted the request's
+                # first token (the prompt never prefilled at all)
+                req.awaiting_first = False
+                req.first_token_t = time.perf_counter()
+                self._emit_request(req, phase="first_token")
             if (self.eos_id is not None and req.tokens[-1] == self.eos_id) \
                     or len(req.tokens) >= req.max_new_tokens:
                 self._finish(req)
@@ -986,6 +1437,14 @@ class ServeEngine:
             "hbm_mb": round(hbm, 2) if hbm is not None else None,
             "pool_mb": round(self.pool_mb, 2),
             "mesh": [self.cfg.mesh_dp, self.cfg.mesh_tp],
+            # round-21 shared-prefix vitals: token-weighted hit rate
+            # (null until the first lookup / with the cache off), COW
+            # page copies, and how many pages sit parked (free but
+            # holding cached contents)
+            "prefix_hit_rate": (self.prefix.hit_rate
+                                if self.prefix is not None else None),
+            "cow_copies": self.cow_copies,
+            "parked_blocks": self.alloc.parked_blocks,
             "counts": {s: int(self.counts.get(s, 0))
                        for s in Request.TERMINAL},
         }
@@ -999,7 +1458,9 @@ class ServeEngine:
             queue_depth=h["queue_depth"], active=h["active"],
             occupancy=h["occupancy"], free_blocks=h["free_blocks"],
             p95_step_ms=h["p95_step_ms"], hbm_mb=h["hbm_mb"],
-            pool_mb=h["pool_mb"], mesh=h["mesh"], **h["counts"])
+            pool_mb=h["pool_mb"], mesh=h["mesh"],
+            prefix_hit_rate=h["prefix_hit_rate"],
+            cow_copies=h["cow_copies"], **h["counts"])
 
     # ------------------------------------------------------------ teardown --
     def close(self, exit: str = "ok", reason: Optional[str] = None) -> None:

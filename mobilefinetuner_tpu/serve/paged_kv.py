@@ -19,11 +19,25 @@ per block_T generated tokens, never inside the compiled step. Block 0
 is reserved as the TRASH page: idle slots' writes and padded
 block-table rows land there, so the device program needs no branches —
 occupancy is expressed entirely through indices and masks.
+
+Round 21 (shared-prefix KV reuse, DESIGN.md §26) makes pages
+REFCOUNTED: requests whose prompts share a hashed full-block prefix
+map the same physical pages, so a page is released only on its LAST
+reference. A page whose refcount hits zero while its contents are
+still registered in the engine's PrefixCache is PARKED instead of
+freed: parked pages count as free (they are reclaimable at any
+moment, LRU-first) but keep their contents until the allocator
+actually needs them — that is what turns a finished request's prompt
+pages into the next request's prefix hit. The leak observable is
+unchanged: `in_use` counts only referenced pages, so "every request
+terminal => in_use == 0" holds whether or not a cache is parked on
+top.
 """
 
 from __future__ import annotations
 
-from typing import List
+import collections
+from typing import Callable, Dict, List, Optional
 
 import jax.numpy as jnp
 
@@ -82,13 +96,31 @@ def write_prompt_blocks(pool_k, pool_v, k, v, block_ids):
 
 
 class BlockAllocator:
-    """Free-list allocator over the pool's pages (block 0 reserved).
+    """Refcounted free-list allocator over the pool's pages (block 0
+    reserved).
 
     alloc/append/free are the request lifecycle: `alloc(n)` takes the
     prompt's pages at admission, `append()` one more page when decode
-    crosses a page boundary, `free(ids)` returns everything when the
-    request finishes (or is cancelled). LIFO reuse keeps recently-hot
-    pages recently-reused.
+    crosses a page boundary, `free(ids)` drops one REFERENCE per page
+    when the request finishes (or is cancelled). LIFO reuse keeps
+    recently-hot pages recently-reused.
+
+    Shared-prefix reuse (round 21) adds three verbs on top:
+
+      retain(b)     +1 ref on an in-use page (a second request mapped
+                    the same physical prefix page);
+      adopt(b)      revive a PARKED page (ref 0, contents cached) back
+                    to ref 1 — a prefix hit on a finished request's
+                    pages;
+      free(ids, park=fn)   at ref 0, `park(b)` decides the page's
+                    fate: a cache key means "park it" (contents stay,
+                    page counts as free, reclaimable LRU-first), None
+                    means plain free. Reclaiming a parked page calls
+                    `on_evict(b, key)` so the cache unregisters it.
+
+    Pages are never referenced and parked at once: `in_use` counts
+    exactly the referenced pages, so the terminal-accounting invariant
+    (everything terminal => in_use == 0) is cache-agnostic.
     """
 
     def __init__(self, num_blocks: int):
@@ -98,35 +130,124 @@ class BlockAllocator:
                 f"{TRASH_BLOCK}), got {num_blocks}")
         self.num_blocks = int(num_blocks)
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._ref: Dict[int, int] = {}
+        # parked pages: ref 0, contents registered in a PrefixCache —
+        # insertion order is the LRU order (oldest first; a page parks
+        # at the MRU end every time its last reference drops)
+        self._parked: "collections.OrderedDict[int, object]" = \
+            collections.OrderedDict()
+        # called as on_evict(block, key) when alloc() reclaims a parked
+        # page — the PrefixCache unregisters the mapping there
+        self.on_evict: Optional[Callable[[int, object], None]] = None
+        # lifetime count of pages handed out by alloc()/append() — the
+        # bench's KV-cost denominator: prefix hits acquire() instead of
+        # alloc(), so pages-per-request dropping below the cache-off
+        # figure is the reuse actually paying
+        self.pages_allocated = 0
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """Allocatable pages: truly free + parked (parked pages are
+        reclaimable at any moment, so admission math counts them)."""
+        return len(self._free) + len(self._parked)
 
     @property
     def in_use(self) -> int:
-        """Pages currently handed out (trash page excluded) — the
+        """Pages currently referenced (trash page excluded) — the
         leak-accounting observable: after every request has reached a
         terminal state this must be 0, whatever path (finish, cancel,
-        timeout, contained step error) released the pages."""
-        return self.num_blocks - 1 - len(self._free)
+        timeout, contained step error) released the pages. Parked pages
+        hold cache contents but NO references, so they count as free."""
+        return len(self._ref)
+
+    @property
+    def parked_blocks(self) -> int:
+        return len(self._parked)
+
+    @property
+    def refcounts(self) -> Dict[int, int]:
+        """Snapshot {block: refcount} of every referenced page (the
+        round-21 accounting observable: empty once everything is
+        terminal — each shared page's count returned to zero)."""
+        return dict(self._ref)
 
     def alloc(self, n: int) -> List[int]:
-        if n > len(self._free):
+        if n > self.free_blocks:
             raise OutOfBlocks(
-                f"asked for {n} pages, {len(self._free)} free "
+                f"asked for {n} pages, {self.free_blocks} free "
                 f"(pool has {self.num_blocks - 1} allocatable)")
-        out = [self._free.pop() for _ in range(n)]
+        out = []
+        for _ in range(n):
+            if self._free:
+                b = self._free.pop()
+            else:
+                # reclaim the least-recently-parked cached page; the
+                # cache forgets it before the new owner ever writes
+                b, key = self._parked.popitem(last=False)
+                if self.on_evict is not None:
+                    self.on_evict(b, key)
+            self._ref[b] = 1
+            out.append(b)
+        self.pages_allocated += n
         return out
 
     def append(self) -> int:
         return self.alloc(1)[0]
 
-    def free(self, ids) -> None:
+    def retain(self, b: int) -> None:
+        """One more reference on an in-use page (prefix sharing)."""
+        b = int(b)
+        if b not in self._ref:
+            raise ValueError(f"retain of un-referenced block {b}")
+        self._ref[b] += 1
+
+    def acquire(self, b: int) -> None:
+        """Take one reference on a CACHED page whichever state it is in:
+        retain() if some resident already references it, adopt() if it
+        sits parked — the engine's one prefix-hit acquisition verb.
+        Acquired pages are eviction-proof, so acquire every cached page
+        BEFORE alloc()ing fresh ones."""
+        b = int(b)
+        if b in self._ref:
+            self._ref[b] += 1
+        else:
+            self.adopt(b)
+
+    def adopt(self, b: int) -> None:
+        """Revive a parked page to ref 1 (a prefix hit on cached
+        contents). The page must currently be parked."""
+        b = int(b)
+        if b not in self._parked:
+            raise ValueError(f"adopt of un-parked block {b}")
+        del self._parked[b]
+        self._ref[b] = 1
+
+    def free(self, ids, park: Optional[Callable[[int], object]] = None
+             ) -> None:
+        """Drop one reference per page; at zero the page parks (when
+        `park(b)` returns its cache key) or returns to the free list."""
         for b in ids:
             b = int(b)
             if b == TRASH_BLOCK:
                 raise ValueError("freeing the reserved trash block")
-            if b in self._free or not 0 < b < self.num_blocks:
+            if b not in self._ref or not 0 < b < self.num_blocks:
                 raise ValueError(f"double/invalid free of block {b}")
+            self._ref[b] -= 1
+            if self._ref[b]:
+                continue
+            del self._ref[b]
+            key = park(b) if park is not None else None
+            if key is not None:
+                self._parked[b] = key      # MRU end of the LRU order
+            else:
+                self._free.append(b)
+
+    def flush_parked(self) -> int:
+        """Forget every parked page (containment rebuilt the pools, so
+        cached contents no longer exist). Returns how many were
+        dropped; the PrefixCache flushes its own mappings alongside."""
+        n = len(self._parked)
+        while self._parked:
+            b, _ = self._parked.popitem()
             self._free.append(b)
+        return n

@@ -1,0 +1,141 @@
+"""Shared-prefix KV cache: hashed full prompt blocks -> physical pages.
+
+At tenant fan-out, prompts overwhelmingly share a head — system
+prompts, few-shot templates, retrieval scaffolds. The block-paged pool
+(serve/paged_kv.py) already gives KV pages identity, so reuse is pure
+bookkeeping: hash every FULL prompt block into a position-chained key
+and let a later request whose prompt starts with the same blocks map
+the SAME physical pages instead of recomputing them. Prefill then
+starts at the first uncached block (the engine chunk-prefills just the
+suffix), which is the single biggest TTFT and HBM-per-request win on
+the serve side (DESIGN.md §26).
+
+Key structure — a chain, not independent block hashes:
+
+    h_0 = H(identity)                 identity = KV-producing weights:
+    h_i = H(h_{i-1} || tokens_i)      "base", or (adapter, generation)
+
+so block i's key commits to the ENTIRE prefix through block i (two
+prompts sharing block content at different offsets can never collide)
+and to which weights produced the K/V. Adapter hot-swap bumps the
+per-name generation, so stale entries become unreachable and drain via
+the allocator's LRU parking — never served.
+
+Lifecycle (the allocator owns the memory, this module owns the map):
+
+  * register(key, block)  at admission, for every freshly-computed
+    full prompt block — concurrent requests hit it immediately;
+  * lookup(keys)          longest cached chain prefix -> its pages;
+    the engine retains (in-use) or adopts (parked) each one;
+  * park(block)           the allocator's `free(..., park=)` callback:
+    a registered page whose last reference dropped keeps its contents
+    and waits, LRU-parked, for the next hit;
+  * _on_evict             the allocator reclaimed a parked page for
+    fresh allocation: the mapping is forgotten BEFORE the new owner
+    writes, so a stale key can never resolve to live foreign data.
+
+Only FULL blocks are shared (a partial tail block's unwritten columns
+would alias future decode writes); divergence inside a block simply
+misses. The one page shared requests DO both write — a full-hit
+re-feed's last block — is copy-on-write in the engine: shared page
+contents are immutable by construction.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Sequence
+
+
+def chain_keys(prompt: Sequence[int], block_T: int,
+               identity: str) -> List[bytes]:
+    """Position-chained hash per FULL block of `prompt` (len(prompt) //
+    block_T keys; the partial tail block, if any, is never keyed)."""
+    h = hashlib.blake2b(identity.encode("utf-8"), digest_size=16).digest()
+    out: List[bytes] = []
+    for i in range(len(prompt) // block_T):
+        blk = prompt[i * block_T:(i + 1) * block_T]
+        raw = b"".join(int(t).to_bytes(4, "little", signed=True)
+                       for t in blk)
+        h = hashlib.blake2b(h + raw, digest_size=16).digest()
+        out.append(h)
+    return out
+
+
+class PrefixCache:
+    """The key<->block bijection over one engine's BlockAllocator."""
+
+    def __init__(self, alloc, block_T: int):
+        self.alloc = alloc
+        self.block_T = int(block_T)
+        self._key_to_block: Dict[bytes, int] = {}
+        self._block_to_key: Dict[int, bytes] = {}
+        # token-level counters feeding health()/serve_stats
+        self.hit_tokens = 0
+        self.lookup_tokens = 0
+        alloc.on_evict = self._on_evict
+
+    def __len__(self) -> int:
+        return len(self._key_to_block)
+
+    def lookup(self, keys: Sequence[bytes]) -> List[int]:
+        """Physical pages of the LONGEST cached chain prefix of `keys`
+        (chained keys make any gap a guaranteed miss for the rest)."""
+        blocks: List[int] = []
+        for k in keys:
+            b = self._key_to_block.get(k)
+            if b is None:
+                break
+            blocks.append(b)
+        return blocks
+
+    def register(self, key: bytes, block: int) -> bool:
+        """Map a freshly-computed full block. First writer wins: a key
+        already mapped (two same-prefix requests racing their prefills)
+        keeps the existing page and the newcomer's copy stays private."""
+        if key in self._key_to_block:
+            return False
+        self._key_to_block[key] = int(block)
+        self._block_to_key[int(block)] = key
+        return True
+
+    def park(self, block: int) -> Optional[bytes]:
+        """The allocator's free(..., park=) callback: a registered
+        page's key (it parks, contents kept), None otherwise."""
+        return self._block_to_key.get(int(block))
+
+    def _on_evict(self, block: int, key: bytes) -> None:
+        """The allocator reclaimed a parked page: forget it."""
+        self._key_to_block.pop(key, None)
+        self._block_to_key.pop(int(block), None)
+
+    def flush(self) -> None:
+        """Drop every mapping AND every parked page (containment
+        rebuilt the pools — cached contents no longer exist)."""
+        self._key_to_block.clear()
+        self._block_to_key.clear()
+        self.alloc.flush_parked()
+
+    def note_lookup(self, hit_tokens: int, total_tokens: int) -> None:
+        self.hit_tokens += int(hit_tokens)
+        self.lookup_tokens += int(total_tokens)
+
+    @property
+    def hit_rate(self) -> Optional[float]:
+        """Fraction of looked-up prompt tokens served from cached
+        pages (None before any lookup)."""
+        if not self.lookup_tokens:
+            return None
+        return round(self.hit_tokens / self.lookup_tokens, 4)
+
+    def check_consistent(self) -> None:
+        """The bijection + allocator agreement invariant (asserted by
+        the robustness accounting helper after every fault e2e)."""
+        assert len(self._key_to_block) == len(self._block_to_key), \
+            "key<->block maps out of sync"
+        for k, b in self._key_to_block.items():
+            assert self._block_to_key.get(b) == k, \
+                f"block {b} maps back to a different key"
+        for b in getattr(self.alloc, "_parked", {}):
+            assert b in self._block_to_key, \
+                f"parked block {b} unknown to the cache"

@@ -266,6 +266,76 @@ def test_serve_bench_mesh_rows_tiny_cpu(tmp_path):
 
 
 @pytest.mark.slow
+def test_serve_bench_prefix_rows_and_ttft_gate(tmp_path):
+    """serve_bench --prefix_cache/--prefix_pool rows (round 21): the
+    reuse columns the cache claim is read from — prefix_hit_rate,
+    cow_copies, kv_pages_per_req, the _prefixN config suffix — and
+    bench_compare's direction map over the NEW row shape: TTFT p99
+    still gates lower-better, hit_rate gates higher-better, pages/req
+    lower-better."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import bench_compare as bc
+    import serve_bench as sb
+    rows = sb.run_rows("tiny-gpt2", [100.0], n_requests=5, adapters=0,
+                       num_slots=2, block_T=8, num_blocks=64,
+                       max_prompt=16, max_new=4, dtype="float32",
+                       seed=0, prompt_lo=10, prompt_hi=24,
+                       prefix_cache=True, max_prompt_chunked=32,
+                       prefix_pool=2, prefix_frac=0.8)
+    (row,) = rows
+    assert row["config"].endswith("_prefix2")
+    assert row["prefix_cache"] is True and row["sampling"] is False
+    assert 0.0 <= row["prefix_hit_rate"] <= 1.0
+    assert isinstance(row["cow_copies"], int) and row["cow_copies"] >= 0
+    assert row["kv_pages_per_req"] > 0
+    assert row["requests"] == 5 and row["terminal"]["finished"] == 5
+    # the direction map over the new columns: the TTFT p99 gate still
+    # fires on the new row shape, and reuse regressions gate too
+    assert bc.direction("ttft_ms.p99") == -1
+    assert bc.direction("prefix_hit_rate") == +1
+    assert bc.direction("kv_pages_per_req") == -1
+    assert bc.direction("cow_copies") == 0          # informational
+    old_p = str(tmp_path / "old.json")
+    new_p = str(tmp_path / "new.json")
+    with open(old_p, "w") as f:
+        json.dump({"rows": rows}, f)
+    worse = json.loads(json.dumps(row))
+    worse["ttft_ms"]["p99"] = (row["ttft_ms"]["p99"] or 1.0) * 3.0
+    with open(new_p, "w") as f:
+        json.dump({"rows": [worse]}, f)
+    assert bc.main([old_p, new_p, "--threshold", "10"]) == 2
+    assert bc.main([old_p, old_p, "--threshold", "10"]) == 0
+
+
+@pytest.mark.slow
+def test_serve_bench_sampled_rows_tiny_cpu():
+    """serve_bench --sampling rows (round 21): the _sampled config
+    suffix, the sampling marker column, and a complete sampled run —
+    every request terminal-finished with latency percentiles present
+    (sampled decode rides the same compiled step, so the row schema is
+    the greedy schema plus the marker)."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import serve_bench as sb
+    rows = sb.run_rows("tiny-gpt2", [100.0], n_requests=4, adapters=0,
+                       num_slots=2, block_T=8, num_blocks=32,
+                       max_prompt=16, max_new=4, dtype="float32",
+                       seed=0, prompt_lo=2, sampling=True)
+    (row,) = rows
+    assert row["config"].endswith("_sampled")
+    assert row["sampling"] is True and row["prefix_cache"] is False
+    assert row["prefix_hit_rate"] is None and row["cow_copies"] is None
+    assert row["requests"] == 4 and row["terminal"]["finished"] == 4
+    for p in ("p50", "p95", "p99"):
+        assert row["ttft_ms"][p] > 0
+        assert row["tpot_ms"][p] > 0
+    assert row["new_traces_after_warmup"] == 0
+
+
+@pytest.mark.slow
 def test_bench_decode_mesh_rows_tiny_cpu():
     """bench_decode --mesh rows (round 20): one row per attention path
     (xla gather vs pallas kernel) per mesh, so the sharded auto-gate's

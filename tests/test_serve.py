@@ -218,14 +218,52 @@ def test_cancel_frees_pages_and_slot(gpt2_engine):
 
 def test_submit_validation(gpt2_engine):
     eng = gpt2_engine
-    with pytest.raises(ValueError, match="max_prompt"):
-        eng.submit(list(range(1, 20)))      # 19 > 16
+    # round 21: an over-cap prompt is a POLICY reject the caller reads
+    # off .state (reason=prompt_too_long), not a ValueError — prompts
+    # in (max_prompt, true_cap] are valid chunked admissions when
+    # max_prompt_chunked is set, and the closed-set reason taxonomy is
+    # how a proxy tells "too long" from "queue full". On this engine
+    # (chunking off) the true cap IS max_prompt: 16 queues, 17 rejects.
+    at_cap = eng.submit(list(range(1, 17)))         # 16 == cap: queued
+    assert at_cap.state == "queued"
+    over = eng.submit(list(range(1, 18)))           # 17 > 16: rejected
+    assert over.state == "rejected" and over.reason == "prompt_too_long"
+    assert not over.blocks and over.tokens == []
+    eng.cancel(at_cap)
     with pytest.raises(ValueError, match="max_new_tokens"):
         eng.submit([1, 2], max_new_tokens=99)
     with pytest.raises(ValueError, match="empty"):
         eng.submit([])
     with pytest.raises(RuntimeError, match="bank"):
         eng.submit([1, 2], adapter="nope")  # bankless engine
+    # sampling knobs on a greedy engine are a CALLER error (the engine
+    # compiled no sampling lanes), as is a nonsense distribution
+    with pytest.raises(ValueError, match="sampling"):
+        eng.submit([1, 2], temperature=0.7)
+
+
+def test_prompt_too_long_boundary_with_chunking(gpt2_params):
+    """Satellite 5 regression, both sides of the TRUE cap: with
+    max_prompt_chunked set, prompts in (max_prompt, true_cap] route to
+    chunked admission (NOT rejected — the pre-r21 hard ValueError is
+    the bug this pins against), and reason=prompt_too_long fires only
+    beyond the true cap."""
+    eng = ServeEngine(
+        "gpt2", GPT2_CFG, gpt2_params,
+        ServeConfig(num_slots=2, block_T=8, num_blocks=32, max_prompt=16,
+                    max_new_tokens=8, max_prompt_chunked=40))
+    rng = np.random.default_rng(17)
+    inside = eng.submit(list(rng.integers(1, 200, 40)),   # == true cap
+                        max_new_tokens=4)
+    assert inside.state == "queued"
+    beyond = eng.submit(list(rng.integers(1, 200, 41)),   # cap + 1
+                        max_new_tokens=4)
+    assert beyond.state == "rejected"
+    assert beyond.reason == "prompt_too_long"
+    eng.drain()
+    assert inside.state == "finished"
+    assert inside.tokens == oracle("gpt2", gpt2_params, inside)
+    eng.close()
 
 
 def test_admission_backpressure_tiny_pool(gpt2_params):

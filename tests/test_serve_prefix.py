@@ -1,0 +1,302 @@
+"""Round-21 serve-plane tests: shared-prefix KV reuse, chunked prefill
+admission, per-slot sampling (serve/prefix_cache.py + engine.py,
+DESIGN.md §26).
+
+Three invariants anchor everything here:
+
+1. PARITY — with the prefix cache ON, every greedy request's tokens are
+   token-identical to (a) the same engine with the cache OFF and (b)
+   batch-at-a-time generate() with a contiguous cache, across admission
+   paths (classic / chunked / partial-hit / full-hit-COW), both model
+   families (incl. gemma sliding-window layers), base and adapter rows.
+2. COMPILE STABILITY — after every bucket width and the COW re-feed
+   program have traced once, hits / misses / COW / multi-chunk walks /
+   cancels add ZERO executables (trace_counts-pinned).
+3. ACCOUNTING — shared pages are refcounted while shared, parked (not
+   leaked, not double-freed) on last release; terminal states leave
+   refcounts == {} and in_use == 0.
+"""
+
+import dataclasses
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from mobilefinetuner_tpu.core.config import GPT2Config, Gemma3TextConfig
+from mobilefinetuner_tpu.lora.lora import LoRASpec, init_lora_gemma3
+from mobilefinetuner_tpu.models import gemma3, gpt2
+from mobilefinetuner_tpu.models.generate import (SampleConfig,
+                                                 gemma3_generate,
+                                                 gpt2_generate)
+from mobilefinetuner_tpu.serve import (AdapterBank, ServeConfig,
+                                       ServeEngine, chain_keys)
+
+# n_positions=96 (vs test_serve.py's 64) so chunked prompts up to 48
+# tokens + generation fit — the multi-chunk walk needs room
+GPT2_CFG = dataclasses.replace(
+    GPT2Config.tiny(vocab_size=211), n_embd=64, n_head=4, n_positions=96,
+    n_layer=3, embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0)
+GEMMA_CFG = dataclasses.replace(
+    Gemma3TextConfig.tiny(vocab_size=199), hidden_size=48, head_dim=12,
+    num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+    num_hidden_layers=4, sliding_window=6, sliding_window_pattern=3)
+
+
+@pytest.fixture(scope="module")
+def gpt2_params():
+    return gpt2.init_params(GPT2_CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def gemma_params():
+    return gemma3.init_params(GEMMA_CFG, jax.random.PRNGKey(1))
+
+
+def oracle(family, params, req, lora=None):
+    """Batch-at-a-time generate() with a CONTIGUOUS cache — the greedy
+    ground truth every admission path must reproduce bit-exactly."""
+    gen = gpt2_generate if family == "gpt2" else gemma3_generate
+    config = GPT2_CFG if family == "gpt2" else GEMMA_CFG
+    ids = jnp.asarray([req.prompt], jnp.int32)
+    cfg = SampleConfig(max_new_tokens=req.max_new_tokens, greedy=True,
+                       eos_id=None, pad_id=0)
+    return np.asarray(gen(config, params, ids, jnp.ones_like(ids), cfg,
+                          lora=lora))[0].tolist()
+
+
+def rand_lora(seed, scale=0.05):
+    lora = init_lora_gemma3(GEMMA_CFG, LoRASpec(rank=3, alpha=6.0),
+                            jax.random.PRNGKey(seed))
+    leaves, td = jax.tree.flatten(lora)
+    keys = jax.random.split(jax.random.PRNGKey(seed + 50), len(leaves))
+    return jax.tree.unflatten(td, [
+        l if l.ndim == 0 else scale * jax.random.normal(k, l.shape)
+        for l, k in zip(leaves, keys)])
+
+
+# ------------------------------ key hashing ----------------------------------
+
+def test_chain_keys_full_blocks_chained_and_identity_seeded():
+    p = list(range(100, 120))                    # 20 tokens, block_T 8
+    ks = chain_keys(p, 8, "base")
+    assert len(ks) == 2                          # partial tail never keyed
+    # position-chained: a shorter prompt's chain is a prefix of the
+    # longer one's, and a one-token change in block 0 reroots BOTH keys
+    assert chain_keys(p[:16], 8, "base") == ks
+    assert chain_keys(p[:8], 8, "base") == ks[:1]
+    mut = [p[0] + 1] + p[1:]
+    assert chain_keys(mut, 8, "base")[0] != ks[0]
+    assert chain_keys(mut, 8, "base")[1] != ks[1]
+    # same tokens under a different KV identity (another adapter /
+    # another hot-swap generation) must never collide
+    assert chain_keys(p, 8, "t1:0") != ks
+    assert chain_keys(p, 8, "t1:1") != chain_keys(p, 8, "t1:0")
+    assert chain_keys(p[:7], 8, "base") == []
+
+
+# ------------------ cache-on engine: parity + stability ----------------------
+
+@pytest.fixture(scope="module")
+def cache_engine(gpt2_params):
+    eng = ServeEngine(
+        "gpt2", GPT2_CFG, gpt2_params,
+        ServeConfig(num_slots=3, block_T=8, num_blocks=64, max_prompt=16,
+                    max_new_tokens=12, prefix_cache=True,
+                    max_prompt_chunked=48))
+    yield eng
+    eng.close()
+
+
+def _mix_prompts(rng, common):
+    """Every round-21 admission path in one request set: classic miss,
+    partial hit, full hit (COW re-feed), chunked long prompt, chunked
+    with a partial hit shortening the suffix."""
+    return [common + list(rng.integers(1, 200, 5)),
+            common + list(rng.integers(1, 200, 3)),
+            list(common),                              # full hit -> COW
+            list(rng.integers(1, 200, 40)),            # chunked
+            common[:8] + list(rng.integers(1, 200, 30))]
+
+
+def test_prefix_reuse_parity_then_zero_retrace(cache_engine, gpt2_params):
+    """Three waves of the full admission matrix. Wave 1 traces and is
+    oracle-equal; wave 2 (repeat prompts -> full hits + COW) is
+    oracle-equal and may still trace lazily-compiled programs (COW,
+    newly-reachable small buckets); wave 3 must add ZERO executables."""
+    eng = cache_engine
+    rng = np.random.default_rng(0)
+    common = list(rng.integers(1, 200, 16))    # two full blocks
+    prompts = _mix_prompts(rng, common)
+
+    for wave in range(3):
+        if wave == 2:
+            warm = eng.total_traces()
+        reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        done = eng.drain()
+        assert len(done) == len(reqs)
+        for r in done:
+            assert r.tokens == oracle("gpt2", gpt2_params, r), \
+                f"wave {wave} req {r.id}"
+        assert eng.alloc.in_use == 0 and eng.alloc.refcounts == {}
+        eng.prefix.check_consistent()
+
+    assert eng.total_traces() == warm, \
+        (eng.total_traces(), warm, dict(eng.trace_counts))
+    assert eng.cow_copies >= 1          # wave 2+ full hits re-fed via COW
+    assert eng.prefix.hit_rate > 0.3    # repeats dominate the lookups
+    h = eng.health()
+    assert h["prefix_hit_rate"] == eng.prefix.hit_rate
+    assert h["cow_copies"] == eng.cow_copies
+
+
+def test_cache_off_engine_matches_cache_on_tokens(gpt2_params):
+    """The cache is invisible in outputs: same prompts through a
+    cache-OFF engine produce the same greedy tokens."""
+    eng = ServeEngine(
+        "gpt2", GPT2_CFG, gpt2_params,
+        ServeConfig(num_slots=3, block_T=8, num_blocks=64, max_prompt=16,
+                    max_new_tokens=12, max_prompt_chunked=48))
+    assert eng.prefix is None
+    rng = np.random.default_rng(0)     # same stream as the cache-on test
+    prompts = _mix_prompts(rng, list(rng.integers(1, 200, 16)))
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.drain()
+    for r in reqs:
+        assert r.tokens == oracle("gpt2", gpt2_params, r)
+    eng.close()
+
+
+def test_shared_pages_refcounted_while_live(cache_engine):
+    """Two concurrent requests over the same registered prefix hold the
+    SAME physical pages at refcount 2; draining parks them (ref 0,
+    contents retained) rather than freeing or leaking."""
+    eng = cache_engine
+    rng = np.random.default_rng(7)
+    common = list(rng.integers(1, 200, 16))
+    seed = eng.submit(common, max_new_tokens=2)   # registers the blocks
+    eng.drain()
+    ra = eng.submit(common + list(rng.integers(1, 200, 4)),
+                    max_new_tokens=4)
+    rb = eng.submit(common + list(rng.integers(1, 200, 6)),
+                    max_new_tokens=4)
+    eng.step()                                    # both admitted
+    assert ra.blocks[:2] == rb.blocks[:2] != seed.blocks
+    for b in ra.blocks[:2]:
+        assert eng.alloc.refcounts[b] == 2
+    eng.drain()
+    assert eng.alloc.refcounts == {} and eng.alloc.in_use == 0
+    assert eng.alloc.parked_blocks > 0
+    eng.prefix.check_consistent()
+
+
+def test_chunk_buckets_capped_at_max_prompt_and_cancel_mid_walk(
+        cache_engine, gpt2_params):
+    """Auto-derived chunk widths cap at block-rounded max_prompt — NOT
+    the chunked true cap — so a long prompt walks MULTIPLE chunks
+    (bounding per-step prefill work) instead of one wide dispatch. A
+    cancel mid-walk releases everything and leaves zero new traces."""
+    eng = cache_engine
+    assert eng.chunk_buckets == (8, 16)           # not (8, 16, 32, 48)
+    warm = eng.total_traces()
+    rng = np.random.default_rng(11)
+    victim = eng.submit(list(rng.integers(1, 200, 40)), max_new_tokens=4)
+    eng.step()                                    # first chunk only
+    assert victim.state == "active" and victim.prefilling
+    assert 0 < victim.prefill_pos < len(victim.prompt)
+    eng.cancel(victim)
+    assert victim.state == "cancelled" and not victim.blocks
+    assert eng.alloc.in_use == 0 and eng.alloc.refcounts == {}
+    survivor = eng.submit(list(rng.integers(1, 200, 40)), max_new_tokens=4)
+    eng.drain()
+    assert survivor.tokens == oracle("gpt2", gpt2_params, survivor)
+    assert eng.total_traces() == warm
+
+
+# ------------------------------ sampling -------------------------------------
+
+def test_sampling_deterministic_and_temp0_is_greedy(gpt2_params):
+    eng = ServeEngine(
+        "gpt2", GPT2_CFG, gpt2_params,
+        ServeConfig(num_slots=2, block_T=8, num_blocks=64, max_prompt=16,
+                    max_new_tokens=12, sampling=True))
+    rng = np.random.default_rng(3)
+    common = list(rng.integers(1, 200, 16))
+    greedy = eng.submit(common, max_new_tokens=8)     # temperature 0
+    s1 = eng.submit(common, max_new_tokens=8, temperature=0.9,
+                    top_k=40, top_p=0.95, seed=1234)
+    eng.drain()
+    # sampling lanes compiled in, temperature 0: STILL the exact oracle
+    assert greedy.tokens == oracle("gpt2", gpt2_params, greedy)
+    s2 = eng.submit(common, max_new_tokens=8, temperature=0.9,
+                    top_k=40, top_p=0.95, seed=1234)
+    s3 = eng.submit(common, max_new_tokens=8, temperature=0.9,
+                    top_k=40, top_p=0.95, seed=99)
+    eng.drain()
+    assert s1.tokens == s2.tokens                 # same seed, same slotting
+    assert s2.tokens != s3.tokens or s2.tokens != greedy.tokens
+    eng.close()
+
+
+def test_sampling_seed_survives_admission_path_change(gpt2_params):
+    """The per-request PRNG is keyed on (seed, position) — NOT on how
+    the prompt entered the pool — so a fresh chunked admission and a
+    later prefix-hit admission of the same request sample identically."""
+    eng = ServeEngine(
+        "gpt2", GPT2_CFG, gpt2_params,
+        ServeConfig(num_slots=2, block_T=8, num_blocks=64, max_prompt=16,
+                    max_new_tokens=12, sampling=True, prefix_cache=True,
+                    max_prompt_chunked=48))
+    rng = np.random.default_rng(5)
+    long_p = list(rng.integers(1, 200, 36))
+    a = eng.submit(long_p, max_new_tokens=8, temperature=0.8, seed=7)
+    eng.drain()                                   # chunked, cold cache
+    b = eng.submit(long_p, max_new_tokens=8, temperature=0.8, seed=7)
+    eng.drain()                                   # prefix hit
+    assert eng.prefix.hit_rate > 0
+    assert a.tokens == b.tokens
+    eng.close()
+
+
+# ------------------- gemma: sliding window + adapters ------------------------
+
+def test_gemma_adapters_share_prefix_without_cross_tenant_reuse(
+        gemma_params):
+    """Sliding-window family, cache + chunking + adapter bank: the same
+    token prefix under base vs. adapter routes gets DISTINCT cached
+    pages (KV identity includes adapter generation), and every request
+    matches its own adapter's contiguous-generate oracle."""
+    a1 = rand_lora(5)
+    bank = AdapterBank(rand_lora(5), capacity=2)
+    eng = ServeEngine(
+        "gemma", GEMMA_CFG, gemma_params,
+        ServeConfig(num_slots=2, block_T=8, num_blocks=64, max_prompt=16,
+                    max_new_tokens=10, prefix_cache=True,
+                    max_prompt_chunked=40),
+        bank=bank)
+    eng.load_adapter("t1", a1)
+    rng = np.random.default_rng(9)
+    common = list(rng.integers(3, 190, 16))
+    prompts = [common + list(rng.integers(3, 190, 5)),   # base, miss
+               list(common),                             # base, full hit
+               common + list(rng.integers(3, 190, 3)),   # t1: same tokens,
+               list(rng.integers(3, 190, 33))]           # other identity
+    route = [None, None, "t1", None]
+    trees = {None: None, "t1": a1}
+    reqs = [eng.submit(p, max_new_tokens=8, adapter=a)
+            for p, a in zip(prompts, route)]
+    eng.drain()
+    for r, a in zip(reqs, route):
+        assert r.tokens == oracle("gemma", gemma_params, r,
+                                  lora=trees[a]), f"req {r.id} ({a})"
+    # the adapter row shares TOKENS with the base rows but must not have
+    # hit their pages: its chain keys live under a different identity
+    assert chain_keys(common, 8, "base") != chain_keys(common, 8, "t1:0")
+    assert eng.alloc.refcounts == {} and eng.alloc.in_use == 0
+    eng.prefix.check_consistent()
+    eng.close()

@@ -78,7 +78,12 @@ def read_events(path):
 def assert_terminal_accounting(recs, reqs, engine):
     """THE leak/accounting invariant: every request terminal, exactly
     one terminal `request` phase per id (matching its state), and the
-    allocator holds zero pages."""
+    allocator holds zero pages. Round 21 extends it to REFCOUNTED
+    pages: with every request terminal, every shared page's refcount
+    must have returned to zero exactly once (`refcounts == {}` — a
+    page still carrying a count is a leak, a count going negative
+    raised at free time), and a prefix cache's registered/parked sets
+    must agree with the allocator's."""
     terminal_phase = {"finished": "finish", "cancelled": "cancel",
                       "rejected": "reject", "timeout": "timeout",
                       "error": "error"}
@@ -96,6 +101,10 @@ def assert_terminal_accounting(recs, reqs, engine):
             f"req {req.id} ({req.state}): terminal phases {terms}"
     assert engine.alloc.in_use == 0, \
         f"allocator leaked {engine.alloc.in_use} pages"
+    assert engine.alloc.refcounts == {}, \
+        f"pages still refcounted: {engine.alloc.refcounts}"
+    if engine.prefix is not None:
+        engine.prefix.check_consistent()
     assert not engine.active and not engine.queue
 
 
@@ -485,6 +494,98 @@ def test_write_failure_escalates_to_full_containment(params, tmp_path):
     eng.close()
     assert_terminal_accounting(read_events(eng.telemetry.path),
                                [resident, victim, fresh], eng)
+
+
+def test_cache_on_fault_matrix_refcounts_return_to_zero(params, tmp_path):
+    """Round 21: the r14 fault matrix re-run with shared-prefix reuse
+    and chunked admission engaged — step-error containment while two
+    residents SHARE refcounted prefix pages, a cancel mid-chunk, and a
+    queued deadline timeout. After every path: every shared page's
+    refcount back to zero exactly once (refcounts == {}), the prefix
+    cache consistent with the allocator, survivors oracle-equal, and
+    zero new traces once every bucket + the COW program are warm."""
+    eng = make_engine(params, tmp_path, num_slots=2, num_blocks=64,
+                      prefix_cache=True, max_prompt_chunked=40)
+    rng = np.random.default_rng(21)
+    common = list(rng.integers(1, 200, 16))      # two full pages
+    all_reqs = []
+
+    def run(prompt, max_new=2, **kw):
+        r = eng.submit(prompt, max_new_tokens=max_new, **kw)
+        eng.drain()
+        all_reqs.append(r)
+        return r
+
+    # warm EVERY executable: classic prefill+write+step, both chunk
+    # buckets (8, 16), and the COW full-hit re-feed
+    run(common[:8])                              # classic one-shot
+    run(common + list(rng.integers(1, 200, 10)))  # chunked, bucket 16
+    run(common + list(rng.integers(1, 200, 5)))   # prefix hit, bucket 8
+    run(common)                                   # full hit -> COW
+    assert eng.cow_copies >= 1
+    traces0 = eng.total_traces()
+
+    # --- step_error containment while prefix pages are SHARED --------
+    rA = eng.submit(common + list(rng.integers(1, 200, 8)),
+                    max_new_tokens=6)
+    rB = eng.submit(common + list(rng.integers(1, 200, 8)),
+                    max_new_tokens=6)
+    all_reqs += [rA, rB]
+    eng.step()                      # admit both; rA's final chunk
+    eng.step()                      # rB's final chunk; rA decodes
+    assert not rA.prefilling and not rB.prefilling
+    shared = rA.blocks[:2]
+    assert shared == rB.blocks[:2], "prefix pages not shared"
+    assert all(eng.alloc.refcounts[b] == 2 for b in shared)
+
+    class BoomError(RuntimeError):
+        pass
+
+    def boom(step):
+        eng.step_hook = None
+        raise BoomError("injected")
+    eng.step_hook = boom
+    done = eng.step()
+    assert sorted(r.id for r in done) == sorted([rA.id, rB.id])
+    for r in (rA, rB):
+        assert r.state == "error" and r.reason == "BoomError"
+    # containment rebuilt the pools: refcounts cleared ONCE, and the
+    # cache flushed alongside (its contents no longer exist)
+    assert eng.alloc.in_use == 0 and eng.alloc.refcounts == {}
+    assert eng.alloc.parked_blocks == 0
+    eng.prefix.check_consistent()
+
+    # --- cancel mid-chunk --------------------------------------------
+    midway = eng.submit(list(rng.integers(1, 200, 35)),
+                        max_new_tokens=6)
+    all_reqs.append(midway)
+    eng.step()                      # first 16-wide chunk only
+    assert midway.state == "active" and midway.prefilling
+    assert 0 < midway.prefill_pos < len(midway.prompt)
+    eng.cancel(midway)
+    assert midway.state == "cancelled" and not midway.blocks
+    assert eng.alloc.in_use == 0 and eng.alloc.refcounts == {}
+    eng.prefix.check_consistent()
+
+    # --- queued deadline timeout with the cache engaged --------------
+    late = eng.submit(common + [3, 3, 3], deadline_ms=1.0)
+    all_reqs.append(late)
+    time.sleep(0.01)
+    eng.step()
+    assert late.state == "timeout" and late.reason == "deadline"
+    assert eng.alloc.refcounts == {}
+
+    # serving resumes post-flush: a fresh chunked admission finishes
+    # oracle-equal on the SAME executables (no retrace paid anywhere)
+    fresh = run(common + list(rng.integers(1, 200, 9)), max_new=6)
+    assert fresh.state == "finished"
+    assert fresh.tokens == oracle(params, fresh)
+    assert eng.total_traces() - traces0 == 0, dict(eng.trace_counts)
+    eng.close()
+    recs = read_events(eng.telemetry.path)
+    for rec in recs:
+        assert validate_event(rec) is None, (rec, validate_event(rec))
+    assert_terminal_accounting(recs, all_reqs, eng)
 
 
 def test_inject_never_fired_fails_the_harness(tmp_path):

@@ -36,9 +36,9 @@ import json
 import sys
 
 _HIGHER = ("tokens_per_sec", "tok_s", "mfu", "req_s", "mb_s",
-           "productive_frac", "requests")
+           "productive_frac", "requests", "hit_rate")
 _LOWER = ("_ms", "_mb", "stall", "blocking", "bytes", "elapsed_s",
-          "retraces")
+          "retraces", "pages_per_req")
 _SKIP = ("vs_baseline",)  # relative-to-moving-target noise
 
 

@@ -256,6 +256,63 @@ def prog_decode_gpt2_paged_tp():
     return text, retraces, ()
 
 
+def prog_prefill_chunk_gpt2_tp():
+    """The round-21 chunked-prefill executable (models/generate.py
+    gpt2_prefill_chunk) at ONE static bucket width, lowered at a
+    (1, 2) ("dp", "tp") mesh: W prompt rows scatter into the paged
+    pools at data-driven start/n_tok offsets, so the whole bucket set
+    costs one trace per width — never one per prompt length or chunk
+    offset. Donation (pools aliased through) and the collective census
+    are pinned exactly like the decode step's: chunk admission must
+    pay only activation-sized all-reduces under tp, and a regression
+    that re-traces per offset or drops the pool alias shows up here,
+    not as a serving stall."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mobilefinetuner_tpu.core.config import GPT2Config
+    from mobilefinetuner_tpu.models import gpt2
+    from mobilefinetuner_tpu.models.generate import gpt2_prefill_chunk
+    from mobilefinetuner_tpu.serve.paged_kv import init_pools
+    from mobilefinetuner_tpu.serve.sharding import ServeSharding
+    cfg = GPT2Config.tiny()            # 2 heads: tp=2 is head-aligned
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+    L, H = cfg.n_layer, cfg.n_head
+    D = cfg.n_embd // cfg.n_head
+    bT, NB, W, M = 8, 8, 8, 4          # one bucket width W = block_T
+    sh = ServeSharding.build("gpt2", cfg, 1, 2)
+    params = jax.device_put(params, sh.param_shardings(params))
+    pool_k, pool_v = init_pools(NB, L, H, bT, D)
+    psh = sh.pool_sharding()
+    pool_k = jax.device_put(pool_k, psh)
+    pool_v = jax.device_put(pool_v, psh)
+    traces = {"n": 0}
+
+    def chunk_py(p, pk, pv, ids, start, n_tok, tbl):
+        traces["n"] += 1
+        logits, pk2, pv2 = gpt2_prefill_chunk(
+            cfg, p, pk, pv, ids, start, n_tok, tbl,
+            compute_dtype=jnp.float32, shardings=sh)
+        return jnp.argmax(logits, -1).astype(jnp.int32), pk2, pv2
+
+    chunk = jax.jit(chunk_py, donate_argnums=(1, 2),
+                    out_shardings=(sh.repl, psh, psh))
+    dev = lambda a: jax.device_put(np.asarray(a), sh.repl)
+    tbl = dev(np.array([[1, 2, 3, 0]], np.int32))
+    # three chunks of one walking admission: moving start, a full
+    # chunk, then a partial tail — all DATA, one executable
+    for i, (st, nt) in enumerate(((0, 8), (8, 8), (16, 3))):
+        ids = dev((np.arange(W, dtype=np.int32) + 7 * i + 1)[None])
+        _, pool_k, pool_v = chunk(params, pool_k, pool_v, ids,
+                                  dev(np.int32(st)), dev(np.int32(nt)),
+                                  tbl)
+    retraces = traces["n"]
+    ids = dev(np.full((1, W), 5, np.int32))
+    text = chunk.lower(params, pool_k, pool_v, ids, dev(np.int32(0)),
+                       dev(np.int32(W)), tbl).compile().as_text()
+    return text, retraces, ()
+
+
 def prog_multitenant_gpt2():
     """The k-tenant fused optimizer step (ids-routed bank, per-slot
     Adam) — the r18 engine's executable, donated, zero retraces across
@@ -319,6 +376,7 @@ PROGRAMS = {
     "train_gpt2_fsdp": prog_train_gpt2_fsdp,
     "decode_gpt2_paged": prog_decode_gpt2_paged,
     "decode_gpt2_paged_tp": prog_decode_gpt2_paged_tp,
+    "prefill_chunk_gpt2_tp": prog_prefill_chunk_gpt2_tp,
     "multitenant_gpt2": prog_multitenant_gpt2,
 }
 

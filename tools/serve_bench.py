@@ -39,6 +39,8 @@ Usage:
   python tools/serve_bench.py --out BENCH_SERVE_r11.json --rate 4 8
   python tools/serve_bench.py --inject step_error:20 --max_queue 16 \
       --deadline_ms 2000 --stats_every 25            # fault harness
+  python tools/serve_bench.py --prefix_cache 1 --prefix_pool 4 \
+      --max_prompt_chunked 128 --sampling 1          # traffic scale
 """
 
 from __future__ import annotations
@@ -163,7 +165,9 @@ def build_engine(model: str, num_slots: int, block_T: int,
                  hbm_cap_mb: int = 0, hbm_headroom: float = 0.1,
                  trace_spans: bool = False, metrics_port: int = 0,
                  metrics_addr: str = "127.0.0.1",
-                 mesh_dp: int = 1, mesh_tp: int = 1):
+                 mesh_dp: int = 1, mesh_tp: int = 1,
+                 prefix_cache: bool = False, max_prompt_chunked: int = 0,
+                 sampling: bool = False):
     """model: gpt2s | gemma270m | tiny-gpt2 | tiny-gemma. The tiny
     modes are the CPU contract/smoke path (tests/test_serve.py).
 
@@ -204,7 +208,10 @@ def build_engine(model: str, num_slots: int, block_T: int,
                       stats_every=stats_every,
                       hbm_cap_mb=hbm_cap_mb, hbm_headroom=hbm_headroom,
                       trace_spans=trace_spans,
-                      mesh_dp=mesh_dp, mesh_tp=mesh_tp)
+                      mesh_dp=mesh_dp, mesh_tp=mesh_tp,
+                      prefix_cache=prefix_cache,
+                      max_prompt_chunked=max_prompt_chunked,
+                      sampling=sampling)
     tel = Telemetry(telemetry_out)
     registry = None
     if metrics_port > 0:
@@ -231,7 +238,8 @@ def build_engine(model: str, num_slots: int, block_T: int,
 
 def run_load(engine, names, rate: float, n_requests: int, seed: int,
              prompt_lo: int, prompt_hi: int, max_new: int,
-             deadline_ms=None):
+             deadline_ms=None, prefix_pool: int = 0,
+             prefix_frac: float = 0.7, sampling: bool = False):
     """Drive one open-loop Poisson run; returns (terminal requests,
     elapsed seconds). Deterministic given the seed: arrivals, prompt
     contents/lengths, and tenant routing all come from one rng.
@@ -240,12 +248,42 @@ def run_load(engine, names, rate: float, n_requests: int, seed: int,
     away with the pod) and the loop runs the in-flight requests out; a
     second signal (KeyboardInterrupt out of step()) cancels in-flight.
     Rejected-at-submit requests (bounded queue, shutdown) are included
-    in the returned list — filter on `.state` for completions."""
+    in the returned list — filter on `.state` for completions.
+
+    prefix_pool > 0 makes the workload SHARED-PREFIX shaped (round 21):
+    a seeded pool of that many full-block prefixes, and each request
+    opens with a pool member with probability prefix_frac (its suffix
+    stays per-request random) — the multi-turn/system-prompt traffic a
+    prefix cache earns its keep on. sampling=True submits each request
+    with a seeded per-request PRNG and a fixed softmax temperature, so
+    a sampled row is as reproducible as a greedy one."""
     rng = np.random.default_rng(seed)
     vocab = engine.config.vocab_size
     gaps = rng.exponential(1.0 / rate, n_requests)
-    prompts = [list(rng.integers(1, vocab, int(n))) for n in
-               rng.integers(prompt_lo, prompt_hi + 1, n_requests)]
+    lens = rng.integers(prompt_lo, prompt_hi + 1, n_requests)
+    if prefix_pool > 0:
+        # prefixes span whole pages (the cache's unit of reuse) and
+        # leave at least one token of unique suffix below prompt_lo —
+        # the traffic shape is a LONG shared preamble (system prompt +
+        # few-shot header) with a short unique tail, so the preamble is
+        # as many whole pages as fit under the shortest prompt
+        bT = engine.cfg.block_T
+        plen = max(bT, ((prompt_lo - 1) // bT) * bT)
+        pool = [list(rng.integers(1, vocab, plen))
+                for _ in range(prefix_pool)]
+        hit = rng.random(n_requests) < prefix_frac
+        pick = rng.integers(0, prefix_pool, n_requests)
+        prompts = [
+            (pool[int(pick[i])] if hit[i] else
+             list(rng.integers(1, vocab, plen)))
+            + list(rng.integers(1, vocab, max(int(lens[i]) - plen, 1)))
+            for i in range(n_requests)]
+    else:
+        prompts = [list(rng.integers(1, vocab, int(n))) for n in lens]
+    seeds = rng.integers(0, 2**31, n_requests)
+    samp = (lambda i: {"temperature": 0.8, "top_k": 40, "top_p": 0.95,
+                       "seed": int(seeds[i])}) if sampling \
+        else (lambda i: {})
     route = ([names[int(i)] for i in
               rng.integers(0, len(names), n_requests)]
              if names else [None] * n_requests)
@@ -261,7 +299,7 @@ def run_load(engine, names, rate: float, n_requests: int, seed: int,
                 submitted.append(
                     engine.submit(prompts[i], max_new_tokens=max_new,
                                   adapter=route[i],
-                                  deadline_ms=deadline_ms))
+                                  deadline_ms=deadline_ms, **samp(i)))
                 i += 1
             if engine.idle:
                 if i < n_requests:
@@ -320,6 +358,15 @@ def row_from(config_name: str, engine, done, elapsed: float,
         "num_blocks": engine.cfg.num_blocks,
         "decode_steps": engine.decode_steps,
         "traces": dict(engine.trace_counts),
+        # round 21: the prefix-reuse and sampling row shape. hit_rate /
+        # cow are None-safe: a cache-off row carries nulls, so the
+        # contract test can pin the schema either way
+        "sampling": bool(engine.cfg.sampling),
+        "prefix_cache": bool(engine.cfg.prefix_cache),
+        "prefix_hit_rate": (engine.prefix.hit_rate
+                            if engine.prefix is not None else None),
+        "cow_copies": (engine.cow_copies
+                       if engine.prefix is not None else None),
     }
 
 
@@ -335,7 +382,10 @@ def run_rows(model: str, rates, n_requests: int, adapters: int,
              hbm_cap_mb: int = 0, hbm_headroom: float = 0.1,
              trace_spans: bool = False, metrics_port: int = 0,
              metrics_addr: str = "127.0.0.1",
-             mesh_dp: int = 1, mesh_tp: int = 1) -> list:
+             mesh_dp: int = 1, mesh_tp: int = 1,
+             prefix_cache: bool = False, max_prompt_chunked: int = 0,
+             sampling: bool = False, prefix_pool: int = 0,
+             prefix_frac: float = 0.7) -> list:
     """One engine, one warmup request, then one row per offered rate.
     `drain` arms the SIGTERM PreemptionGuard; `inject` fires its fault
     during the FIRST rate's run (the spec names an absolute decode
@@ -358,7 +408,10 @@ def run_rows(model: str, rates, n_requests: int, adapters: int,
                               trace_spans=trace_spans,
                               metrics_port=metrics_port,
                               metrics_addr=metrics_addr,
-                              mesh_dp=mesh_dp, mesh_tp=mesh_tp)
+                              mesh_dp=mesh_dp, mesh_tp=mesh_tp,
+                              prefix_cache=prefix_cache,
+                              max_prompt_chunked=max_prompt_chunked,
+                              sampling=sampling)
     if wd is not None:
         wd.on_hang = lambda p: eng.telemetry.emit("hang", **p)
         wd.stacks_file = (eng.telemetry.path + ".stacks"
@@ -370,6 +423,32 @@ def run_rows(model: str, rates, n_requests: int, adapters: int,
     eng.submit([1] * prompt_lo, max_new_tokens=min(2, max_new),
                adapter=names[0] if names else None)
     eng.drain()
+    # r21 warmup: the reuse/chunk executables compile LAZILY (one per
+    # bucket width, plus the full-hit COW re-feed) — trace each here or
+    # its first use lands in a measured row's TTFT tail
+    if eng.prefix is not None:
+        head = [7] * block_T
+        eng.submit(head, max_new_tokens=1)
+        eng.drain()                    # registers the head page
+        eng.submit(list(head), max_new_tokens=1)
+        eng.drain()                    # full hit -> COW re-feed program
+        for w in eng.chunk_buckets:
+            # a hit on the head page + an s-token suffix dispatches the
+            # smallest bucket covering s; s caps at the widest suffix a
+            # hit can leave, which is also the widest REACHABLE width
+            s = min(w, (max_prompt_chunked or max_prompt) - block_T)
+            if s > 0:
+                eng.submit(head + [11] * s, max_new_tokens=1)
+                eng.drain()
+    if max_prompt_chunked:
+        widest = eng.chunk_buckets[-1]
+        for w in eng.chunk_buckets:
+            # widest-until-covered walk: a (widest + w)-token prompt
+            # ends its walk on bucket w
+            n = widest + w
+            if max_prompt < n <= max_prompt_chunked:
+                eng.submit([13] * n, max_new_tokens=1)
+                eng.drain()
     warm_traces = eng.total_traces()
     if inject == "adapter_load_fail":
         err = inject_adapter_load_fail(eng)
@@ -391,13 +470,41 @@ def run_rows(model: str, rates, n_requests: int, adapters: int,
         for rate in rates:
             counts0 = dict(eng.counts)   # scope the row's census to
             # THIS run: health()'s counters are engine-lifetime
+            pages0 = eng.alloc.pages_allocated
+            ht0, lt0 = ((eng.prefix.hit_tokens, eng.prefix.lookup_tokens)
+                        if eng.prefix is not None else (0, 0))
             done, elapsed = run_load(eng, names, rate, n_requests, seed,
                                      prompt_lo, prompt_hi, max_new,
-                                     deadline_ms=deadline_ms)
+                                     deadline_ms=deadline_ms,
+                                     prefix_pool=prefix_pool,
+                                     prefix_frac=prefix_frac,
+                                     sampling=sampling)
             name = f"{model}_serve_k{max(adapters, 1)}_r{rate:g}"
             if mesh_dp * mesh_tp > 1:
                 name += f"_mesh{mesh_dp}x{mesh_tp}"
+            if max_prompt_chunked:
+                name += f"_chunk{max_prompt_chunked}"
+            # the workload suffix also records whether REUSE was on, so
+            # a cache-on vs cache-off A/B lands as two bench_compare
+            # rows instead of one colliding config key
+            if prefix_pool:
+                name += (f"_prefix{prefix_pool}" if prefix_cache
+                         else f"_prefix{prefix_pool}off")
+            if sampling:
+                name += "_sampled"
             row = row_from(name, eng, done, elapsed, rate, adapters)
+            if eng.prefix is not None:
+                # scope the (token-weighted) hit rate to THIS row's
+                # lookups — engine-lifetime includes the warmup's
+                lt = eng.prefix.lookup_tokens - lt0
+                row["prefix_hit_rate"] = (
+                    round((eng.prefix.hit_tokens - ht0) / lt, 4)
+                    if lt else None)
+            nfin = max(row["requests"], 1)
+            # pages ALLOCATED this row (prefix hits acquire, not alloc)
+            # per finished request — the KV-cost-of-reuse observable
+            row["kv_pages_per_req"] = round(
+                (eng.alloc.pages_allocated - pages0) / nfin, 2)
             row["health"]["counts"] = {
                 k: int(eng.counts.get(k, 0)) - counts0.get(k, 0)
                 for k in row["health"]["counts"]}
@@ -413,12 +520,17 @@ def run_rows(model: str, rates, n_requests: int, adapters: int,
             term = row["terminal"]
             faults = ", ".join(f"{k} {v}" for k, v in term.items()
                                if k != "finished" and v)
+            reuse = ""
+            if row["prefix_hit_rate"] is not None:
+                reuse = (f", hit_rate {row['prefix_hit_rate']:.2f} "
+                         f"(cow {row['cow_copies']}, "
+                         f"{row['kv_pages_per_req']:.1f} pages/req)")
             print(f"{name}: {row['req_s']} req/s "
                   f"({row['gen_tok_s']} tok/s), "
                   f"TTFT p50/p99 = {fmt(row['ttft_ms']['p50'])}/"
                   f"{fmt(row['ttft_ms']['p99'])} ms, TPOT p50 = "
                   f"{fmt(row['tpot_ms']['p50'], '1f')} ms, "
-                  f"{row['new_traces_after_warmup']} retraces"
+                  f"{row['new_traces_after_warmup']} retraces{reuse}"
                   + (f" [{faults}]" if faults else ""))
             if eng.draining:
                 print(f"{name}: DRAINED (SIGTERM) — remaining rates "
@@ -460,6 +572,11 @@ def main(argv=None) -> int:
     ap.add_argument("--max_prompt", type=int, default=64)
     ap.add_argument("--max_new", type=int, default=32)
     ap.add_argument("--prompt_lo", type=int, default=8)
+    ap.add_argument("--prompt_hi", type=int, default=0,
+                    help="prompt-length ceiling for the workload "
+                         "(0 = max_prompt); raise past max_prompt "
+                         "with --max_prompt_chunked to offer the "
+                         "long-prompt mix chunked admission absorbs")
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--mesh", default="1,1",
                     help="serve the engine over a (dp, tp) device mesh "
@@ -469,6 +586,32 @@ def main(argv=None) -> int:
                          "suffix. On CPU (JAX_PLATFORMS=cpu) the "
                          "8-virtual-device platform is forced "
                          "automatically")
+    # --- traffic-scale serving (round 21, DESIGN.md §26) --------------
+    ap.add_argument("--prefix_cache", type=int, default=0, choices=[0, 1],
+                    help="1 = shared-prefix KV reuse: hashed full-block "
+                         "prompt prefixes map refcounted pages, finished "
+                         "requests' pages park as a reclaimable cache")
+    ap.add_argument("--prefix_pool", type=int, default=0,
+                    help="shape the workload around N seeded shared "
+                         "prefixes (each request opens with a pool "
+                         "member with probability --prefix_frac); 0 = "
+                         "fully random prompts. Rows gain a _prefixN "
+                         "config suffix")
+    ap.add_argument("--prefix_frac", type=float, default=0.7,
+                    help="fraction of requests that open with a pool "
+                         "prefix when --prefix_pool is set")
+    ap.add_argument("--max_prompt_chunked", type=int, default=0,
+                    help="TRUE prompt cap under chunked admission "
+                         "(block_T multiple > max_prompt): longer "
+                         "prompts prefill in static-bucket chunks "
+                         "interleaved with decode steps. 0 = off "
+                         "(prompts beyond max_prompt reject with "
+                         "reason=prompt_too_long)")
+    ap.add_argument("--sampling", type=int, default=0, choices=[0, 1],
+                    help="1 = per-request temperature/top-k/top-p "
+                         "sampling with seeded per-slot PRNG keys "
+                         "(same seed => same tokens); rows gain a "
+                         "_sampled config suffix")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--telemetry_out", default="")
     ap.add_argument("--out", default="",
@@ -553,7 +696,7 @@ def main(argv=None) -> int:
                     num_blocks=args.num_blocks,
                     max_prompt=args.max_prompt, max_new=args.max_new,
                     dtype=args.dtype, seed=args.seed,
-                    prompt_lo=args.prompt_lo,
+                    prompt_lo=args.prompt_lo, prompt_hi=args.prompt_hi,
                     telemetry_out=args.telemetry_out,
                     max_queue=args.max_queue,
                     shed_policy=args.shed_policy,
@@ -568,7 +711,12 @@ def main(argv=None) -> int:
                     trace_spans=bool(args.trace_spans),
                     metrics_port=args.metrics_port,
                     metrics_addr=args.metrics_addr,
-                    mesh_dp=mesh_dp, mesh_tp=mesh_tp)
+                    mesh_dp=mesh_dp, mesh_tp=mesh_tp,
+                    prefix_cache=bool(args.prefix_cache),
+                    max_prompt_chunked=args.max_prompt_chunked,
+                    sampling=bool(args.sampling),
+                    prefix_pool=args.prefix_pool,
+                    prefix_frac=args.prefix_frac)
     if args.out:
         art = {"device": jax.devices()[0].device_kind,
                "jax": jax.__version__, "rows": []}

@@ -535,6 +535,10 @@ def serve_stats_summary(events) -> dict:
         "p95_step_ms_last": last["p95_step_ms"],
         # round-20 mesh shape [dp, tp]; absent on pre-sharding streams
         "mesh": last.get("mesh"),
+        # round-21 shared-prefix reuse: cumulative hit rate + COW count
+        # from the LAST snapshot; absent (None) on cache-off streams
+        "prefix_hit_rate": last.get("prefix_hit_rate"),
+        "cow_copies": last.get("cow_copies"),
         "counts": {k: last.get(k, 0) for k in
                    ("finished", "cancelled", "rejected", "timeout",
                     "error")},
@@ -547,11 +551,16 @@ def serve_stats_lines(s) -> list:
     mesh = ""
     if s.get("mesh"):
         mesh = f", mesh {s['mesh'][0]}x{s['mesh'][1]}"
+    reuse = ""
+    if s.get("prefix_hit_rate") is not None:
+        reuse = (f", prefix hit_rate {s['prefix_hit_rate']:.2f} "
+                 f"({s.get('cow_copies') or 0} COW cop"
+                 f"{'y' if (s.get('cow_copies') or 0) == 1 else 'ies'})")
     return [f"  serve health: {s['snapshots']} snapshot(s); queue max "
             f"{s['queue_depth_max']} (last {s['queue_depth_last']}), "
             f"occupancy mean {100 * s['occupancy_mean']:.0f}%, free "
             f"pages min {s['free_blocks_min']}, p95 step "
-            f"{_fmt(s['p95_step_ms_last'], 1)} ms{mesh}"]
+            f"{_fmt(s['p95_step_ms_last'], 1)} ms{mesh}{reuse}"]
 
 
 def controller_entries(events) -> list:

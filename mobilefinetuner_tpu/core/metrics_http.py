@@ -189,14 +189,38 @@ class MetricsRegistry:
             elif ev == "serve_stats":
                 for f in ("queue_depth", "active", "occupancy",
                           "free_blocks", "p95_step_ms", "hbm_mb",
-                          "pool_mb"):
+                          "pool_mb",
+                          # round-22 cache vitals: the r21 counters the
+                          # registry used to drop — the router's
+                          # affinity scoring and the fleet report read
+                          # them off /metrics, not the JSONL
+                          "prefix_hit_rate", "cow_copies",
+                          "blocks_in_use"):
                     self._gauge(f"mft_serve_{f}", g(f))
+                # page-pool occupancy: fraction of allocatable pages
+                # held by live requests (parked cache pages count free)
+                in_use, free = g("blocks_in_use"), g("free_blocks")
+                if isinstance(in_use, (int, float)) \
+                        and isinstance(free, (int, float)) \
+                        and in_use + free > 0:
+                    self._gauge("mft_serve_pool_occupancy",
+                                round(in_use / (in_use + free), 4))
                 self._count_to("mft_decode_steps", g("step") or 0)
                 for s in ("finished", "cancelled", "rejected", "timeout",
                           "error"):
                     if isinstance(g(s), int):
                         self._count_to("mft_serve_terminal", g(s),
                                        state=s)
+            elif ev == "route":
+                # round-22 router decisions: the histogram over
+                # (policy, replica) IS the routing-decision report, and
+                # scrape age tells the operator how stale the snapshots
+                # behind those decisions ran
+                self._count("mft_route_decisions",
+                            policy=g("policy", "?"),
+                            replica=str(g("replica")))
+                self._hist("mft_route_scrape_age_ms",
+                           g("scrape_age_ms"))
             elif ev == "anomaly":
                 self._count("mft_anomalies", kind=g("kind", "?"))
             elif ev == "throttle":
@@ -248,6 +272,26 @@ class MetricsRegistry:
                             self._gauge("mft_goodput_seconds",
                                         v, bucket=k[:-2])
 
+    def set_gauge(self, name: str, value, **labels) -> None:
+        """Public labeled-gauge setter for numbers that do NOT arrive
+        through the telemetry emit path — the round-22 router folds
+        each replica's scraped vitals in as
+        `mft_fleet_*{replica="k"}` gauges (None clears)."""
+        with self._lock:
+            self._gauge(name, value, **labels)
+
+    def observe_hist(self, name: str, value) -> None:
+        """Public histogram feed for the same out-of-band callers
+        (router-side TTFT/TPOT/queue-wait over collected results)."""
+        with self._lock:
+            self._hist(name, value)
+
+    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        """Public labeled-counter increment for out-of-band callers
+        (router-side fleet request terminals by state)."""
+        with self._lock:
+            self._count(name, value, **labels)
+
     # -- exposition -----------------------------------------------------------
 
     def render(self) -> str:
@@ -294,39 +338,71 @@ class MetricsRegistry:
 
 class MetricsServer:
     """ThreadingHTTPServer wrapper: /metrics (OpenMetrics), /healthz
-    (JSON from `health_fn`). Daemon threads throughout — a live scrape
-    can never hold the process open past the run."""
+    (JSON from `health_fn`), plus optional JSON `routes` — the round-22
+    serve-fleet data plane (a replica's /submit and /collect) rides
+    the same server instead of opening a second port. Daemon threads
+    throughout — a live scrape can never hold the process open past
+    the run.
+
+    `routes`: {path: fn(payload) -> (code, obj)} — fn receives the
+    parsed JSON body on POST (None on GET) and returns an HTTP status
+    plus a JSON-serializable object. Route exceptions surface as 500s,
+    same as a scrape bug."""
 
     def __init__(self, registry: MetricsRegistry, port: int = 0,
                  addr: str = "127.0.0.1",
-                 health_fn: Optional[Callable[[], dict]] = None):
+                 health_fn: Optional[Callable[[], dict]] = None,
+                 routes: Optional[Dict[str, Callable]] = None):
         self.registry = registry
         self._health_fn = health_fn or registry.health
+        self._routes = dict(routes or {})
         server = self
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 — stdlib API
-                try:
-                    if self.path.split("?")[0] == "/metrics":
-                        body = server.registry.render().encode()
-                        ctype = OPENMETRICS_CONTENT_TYPE
-                        code = 200
-                    elif self.path.split("?")[0] == "/healthz":
-                        h = server._health_fn()
-                        body = (json.dumps(h) + "\n").encode()
-                        ctype = "application/json"
-                        code = 200 if h.get("status", "ok") == "ok" \
-                            else 503
-                    else:
-                        body, ctype, code = b"not found\n", "text/plain", 404
-                except Exception as e:  # a scrape bug must stay a 500
-                    body = f"error: {type(e).__name__}\n".encode()
-                    ctype, code = "text/plain", 500
+            def _respond(self, code, ctype, body):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _dispatch(self, payload):
+                path = self.path.split("?")[0]
+                try:
+                    if path == "/metrics" and payload is None:
+                        return self._respond(
+                            200, OPENMETRICS_CONTENT_TYPE,
+                            server.registry.render().encode())
+                    if path == "/healthz" and payload is None:
+                        h = server._health_fn()
+                        code = 200 if h.get("status", "ok") == "ok" \
+                            else 503
+                        return self._respond(
+                            code, "application/json",
+                            (json.dumps(h) + "\n").encode())
+                    fn = server._routes.get(path)
+                    if fn is None:
+                        return self._respond(404, "text/plain",
+                                             b"not found\n")
+                    code, obj = fn(payload)
+                    body = (json.dumps(obj) + "\n").encode()
+                    return self._respond(code, "application/json", body)
+                except Exception as e:  # a scrape bug must stay a 500
+                    return self._respond(
+                        500, "text/plain",
+                        f"error: {type(e).__name__}\n".encode())
+
+            def do_GET(self):  # noqa: N802 — stdlib API
+                self._dispatch(None)
+
+            def do_POST(self):  # noqa: N802 — stdlib API
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, UnicodeDecodeError):
+                    return self._respond(400, "text/plain",
+                                         b"bad json\n")
+                self._dispatch(payload)
 
             def log_message(self, *a):  # scrapes are not log lines
                 pass

@@ -87,6 +87,7 @@ THREADED_MODULES: Tuple[str, ...] = (
     "mobilefinetuner_tpu/core/metrics_http.py",
     "mobilefinetuner_tpu/serve/engine.py",
     "mobilefinetuner_tpu/multitenant/engine.py",
+    "tools/serve_router.py",
 )
 
 #: the zero-sync structural pin (was test_observability's source grep):

@@ -225,6 +225,14 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
                                     # policy string on reject/timeout, the
                                     # exception type name on error, else
                                     # None (optional on read: r11 streams)
+        "rid": _OPT_NUM,            # round-22 fleet-wide request id the
+                                    # router stamped at ingress; rides
+                                    # every phase of the request so
+                                    # trace_export --router can join the
+                                    # router's route/queue spans to the
+                                    # replica's lifecycle (None / absent
+                                    # on requests submitted directly to
+                                    # an engine, and on pre-r22 streams)
     },
     # cadenced serve-loop health snapshot (serve/engine.py health()):
     # queue depth, slot occupancy, page-pool headroom, rolling p95 step
@@ -259,6 +267,11 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         # re-feeds splitting their divergence block)
         "prefix_hit_rate": _OPT_NUM,
         "cow_copies": _OPT_NUM,
+        # round-22 pool-occupancy numerator (optional on read: pre-r22
+        # streams): pages held by live requests — with free_blocks it
+        # gives the registry's mft_serve_pool_occupancy gauge (parked
+        # cache pages count as free in both fields)
+        "blocks_in_use": _OPT_NUM,
     },
     # one memory-admission verdict (core/memory_guard.py, DESIGN.md
     # §21): immediately post-compile (phase=preflight), on a caught
@@ -370,6 +383,34 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "step": _OPT_NUM,           # worker's last observed step
         "recovery_s": _OPT_NUM,     # down-observed -> relaunched wall s
     },
+    # one routing decision (tools/serve_router.py, round 22): the
+    # router chose (or failed to choose) a replica for request `rid`
+    # from its cadenced /metrics + /healthz scrape of every replica.
+    # One event per ingress request, written to the ROUTER's own
+    # stream (host 0 of the fleet base path; replica engines write the
+    # .host<k> shards) — trace_export --router renders it as the
+    # routing instant on the router's process row, and the serve-fleet
+    # report section histograms the decisions per replica.
+    "route": {
+        "rid": (int,),              # fleet-wide request id (stamped here,
+                                    # rides the replica's request events)
+        "replica": (int, type(None)),  # chosen replica index; None when
+                                    # no healthy replica could take it
+        "policy": (str,),           # what decided the placement:
+                                    # affinity (resident-adapter match) |
+                                    # least_loaded (load score argmin) |
+                                    # failover (first choice was down at
+                                    # dispatch; rerouted) | reject (no
+                                    # healthy candidate)
+        "adapter": _OPT_STR,        # requested adapter name; None = base
+        "queue_depth": _OPT_NUM,    # chosen replica's scraped depth at
+                                    # decision time (None on reject)
+        "occupancy": _OPT_NUM,      # chosen replica's scraped occupancy
+        "scrape_age_ms": _OPT_NUM,  # staleness of the snapshot the
+                                    # decision read (the scrape cadence
+                                    # bounds it on a healthy fleet)
+        "candidates": (int,),       # healthy replicas considered
+    },
     # one multi-tenant job lifecycle transition (multitenant/engine.py,
     # DESIGN.md §23): admit (job -> slot), save (periodic step-tagged
     # checkpoint), finish (budget reached; final adapter saved at
@@ -410,11 +451,12 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
 OPTIONAL_FIELDS: Dict[str, frozenset] = {
     "step_stats": frozenset({"host_step_ms", "skipped", "tenants"}),
     "serve_stats": frozenset({"hbm_mb", "pool_mb", "mesh",
-                              "prefix_hit_rate", "cow_copies"}),
+                              "prefix_hit_rate", "cow_copies",
+                              "blocks_in_use"}),
     "run_end": frozenset({"goodput", "reason"}),
     "checkpoint": frozenset({"snapshot_ms", "write_ms", "bytes", "mb_s",
                              "async"}),
-    "request": frozenset({"reason"}),
+    "request": frozenset({"reason", "rid"}),
     "ckpt_verify": frozenset({"reason", "step", "action"}),
     "rollback": frozenset({"to_step", "steps_lost", "ckpt",
                            "data_offset", "budget_left"}),

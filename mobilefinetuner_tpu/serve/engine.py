@@ -290,6 +290,12 @@ class Request:
     reason: Optional[str] = None       # terminal detail (REQUEST_REASONS
                                        # policy string, or the exception
                                        # type name on state=error)
+    rid: Optional[int] = None          # round-22 fleet-wide request id a
+                                       # router stamped at ingress; rides
+                                       # every request event + span so
+                                       # trace_export --router joins the
+                                       # two process timelines. None on
+                                       # direct submits.
     tokens: List[int] = dataclasses.field(default_factory=list)
     enqueue_t: float = 0.0
     admit_t: float = 0.0
@@ -703,7 +709,19 @@ class ServeEngine:
             queue_ms=((req.admit_t - req.enqueue_t) * 1000.0
                       if req.admit_t else None),
             new_tokens=len(req.tokens) or None,
-            ttft_ms=req.ttft_ms, tpot_ms=req.tpot_ms, reason=req.reason)
+            ttft_ms=req.ttft_ms, tpot_ms=req.tpot_ms, reason=req.reason,
+            rid=req.rid)
+
+    def _req_span(self, name: str, req: Request, t0: float,
+                  dur_ms: float, **extra) -> None:
+        """One span on the request's own `req:<id>` track. A router-
+        stamped `rid` rides as an extra so trace_export --router can
+        join the replica-side lifecycle to the router's route/queue
+        spans without a lookup table."""
+        if req.rid is not None:
+            extra.setdefault("rid", req.rid)
+        self.tracer.emit_span(name, f"req:{req.id}", t0, dur_ms,
+                              id=req.id, **extra)
 
     def _terminal(self, req: Request, state: str, phase: str,
                   reason: Optional[str] = None) -> None:
@@ -725,16 +743,15 @@ class ServeEngine:
             # (admit -> terminal; partial output from a timeout/error
             # still shows its decode time), queue for ones that died
             # waiting (reject/shed/queued-timeout never prefilled)
-            trk = f"req:{req.id}"
             if req.admit_t:
-                self.tracer.emit_span(
-                    "decode", trk, req.admit_t,
-                    (req.finish_t - req.admit_t) * 1000.0, id=req.id,
+                self._req_span(
+                    "decode", req, req.admit_t,
+                    (req.finish_t - req.admit_t) * 1000.0,
                     outcome=state)
             else:
-                self.tracer.emit_span(
-                    "queue", trk, req.enqueue_t,
-                    (req.finish_t - req.enqueue_t) * 1000.0, id=req.id,
+                self._req_span(
+                    "queue", req, req.enqueue_t,
+                    (req.finish_t - req.enqueue_t) * 1000.0,
                     outcome=state)
 
     # ------------------------------------------------------------ tenancy ---
@@ -809,7 +826,8 @@ class ServeEngine:
                adapter: Optional[str] = None,
                deadline_ms: Optional[float] = None,
                temperature: float = 0.0, top_k: int = 0,
-               top_p: float = 1.0, seed: int = 0) -> Request:
+               top_p: float = 1.0, seed: int = 0,
+               rid: Optional[int] = None) -> Request:
         """Enqueue one request (admission happens inside step()).
         `deadline_ms` is the request's end-to-end budget from now: a
         queued request past it times out without prefilling, an active
@@ -859,6 +877,7 @@ class ServeEngine:
             aid = self.bank.slot(adapter)
         req = Request(id=self._next_id, prompt=prompt,
                       max_new_tokens=n_new, adapter=adapter, aid=aid,
+                      rid=int(rid) if rid is not None else None,
                       enqueue_t=time.perf_counter(),
                       temperature=float(temperature), top_k=int(top_k),  # graftlint: disable=sync-hazard(host submit args normalized; no device buffer is read)
                       top_p=float(top_p), seed=int(seed))  # graftlint: disable=sync-hazard(host submit args normalized; no device buffer is read)
@@ -994,13 +1013,11 @@ class ServeEngine:
             # queue span closes where prefill begins; prefill span runs
             # through the first-token host sync (both on the request's
             # own track, stamps the engine already takes)
-            trk = f"req:{req.id}"
-            self.tracer.emit_span(
-                "queue", trk, req.enqueue_t,
-                (t_prefill - req.enqueue_t) * 1000.0, id=req.id)
-            self.tracer.emit_span(
-                "prefill", trk, t_prefill, (now - t_prefill) * 1000.0,
-                id=req.id)
+            self._req_span(
+                "queue", req, req.enqueue_t,
+                (t_prefill - req.enqueue_t) * 1000.0)
+            self._req_span(
+                "prefill", req, t_prefill, (now - t_prefill) * 1000.0)
         req.tokens.append(tok0)
         self._tok[slot], self._pos[slot] = tok0, P
         self._tbl[slot] = TRASH_BLOCK
@@ -1057,9 +1074,9 @@ class ServeEngine:
         self._tbl[slot, :len(req.blocks)] = req.blocks
         if self.tracer.enabled:
             # no prefill span: the whole prompt came from cached pages
-            self.tracer.emit_span(
-                "queue", f"req:{req.id}", req.enqueue_t,
-                (now - req.enqueue_t) * 1000.0, id=req.id)
+            self._req_span(
+                "queue", req, req.enqueue_t,
+                (now - req.enqueue_t) * 1000.0)
         self._emit_request(req, phase="admit")
 
     def _admit_chunked(self, req: Request, cached: List[int],
@@ -1081,9 +1098,9 @@ class ServeEngine:
         self._tok[slot] = self._pos[slot] = 0
         self._tbl[slot] = TRASH_BLOCK
         if self.tracer.enabled:
-            self.tracer.emit_span(
-                "queue", f"req:{req.id}", req.enqueue_t,
-                (req.admit_t - req.enqueue_t) * 1000.0, id=req.id)
+            self._req_span(
+                "queue", req, req.enqueue_t,
+                (req.admit_t - req.enqueue_t) * 1000.0)
         self._emit_request(req, phase="admit")
 
     def _prefill_chunk(self, req: Request) -> None:
@@ -1117,9 +1134,9 @@ class ServeEngine:
         self._pools_at_risk = False
         req.prefill_pos += n_tok
         if self.tracer.enabled:
-            self.tracer.emit_span(
-                "prefill", f"req:{req.id}", t_chunk,
-                (time.perf_counter() - t_chunk) * 1000.0, id=req.id)
+            self._req_span(
+                "prefill", req, t_chunk,
+                (time.perf_counter() - t_chunk) * 1000.0)
         if req.prefill_pos < P:
             return
         # final chunk: its last real row IS the request's first token
@@ -1422,6 +1439,12 @@ class ServeEngine:
         from mobilefinetuner_tpu.core.xla_stats import live_hbm_mb
         hbm = live_hbm_mb()
         return {
+            # round-22 router probe: metrics_http's /healthz returns
+            # 503 on any non-"ok" status, so a draining replica stops
+            # attracting traffic the moment admissions close — the
+            # body still carries the full dict (incl. draining: true)
+            # for the router's post-mortem line
+            "status": "draining" if self.draining else "ok",
             "queue_depth": len(self.queue),
             "active": len(self.active),
             "occupancy": round(len(self.active) / self.cfg.num_slots, 4),
@@ -1457,6 +1480,7 @@ class ServeEngine:
             "serve_stats", step=self.decode_steps,
             queue_depth=h["queue_depth"], active=h["active"],
             occupancy=h["occupancy"], free_blocks=h["free_blocks"],
+            blocks_in_use=h["blocks_in_use"],
             p95_step_ms=h["p95_step_ms"], hbm_mb=h["hbm_mb"],
             pool_mb=h["pool_mb"], mesh=h["mesh"],
             prefix_hit_rate=h["prefix_hit_rate"],

@@ -275,6 +275,106 @@ def test_metrics_server_serves_metrics_and_healthz():
         srv.close()
 
 
+def test_healthz_503_when_engine_draining():
+    """Round-22 satellite: a draining engine's /healthz flips to 503
+    with draining:true in the body — the router's scrape keys replica
+    eligibility on exactly this status code, so a draining replica
+    stops taking placements without any router-side special casing.
+    Fake engine: the contract is the (health_fn -> HTTP) mapping, not
+    the engine."""
+    from mobilefinetuner_tpu.core.metrics_http import (MetricsRegistry,
+                                                       MetricsServer)
+    state = {"draining": False}
+
+    def health():
+        return {"status": ("draining" if state["draining"] else "ok"),
+                "draining": state["draining"], "queue_depth": 0}
+
+    srv = MetricsServer(MetricsRegistry(), port=0, health_fn=health)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+            assert r.status == 200
+            assert json.loads(r.read())["draining"] is False
+        state["draining"] = True
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/healthz", timeout=5)
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read())
+        # the full health payload rides the 503 — a scraper sees WHY
+        assert body["status"] == "draining"
+        assert body["draining"] is True
+    finally:
+        srv.close()
+
+
+def test_serve_stats_cache_gauges_render_and_parse():
+    """Round-22 satellite: the r21 cache vitals (prefix_hit_rate,
+    cow_copies, blocks_in_use) surface as engine /metrics gauges, plus
+    the derived pool-occupancy gauge — pinned through the mini parser
+    so the router's affinity/least-loaded scoring has a stable scrape
+    contract to read."""
+    from mobilefinetuner_tpu.core.metrics_http import MetricsRegistry
+    reg = MetricsRegistry()
+    reg.observe({"event": "serve_stats", "seq": 0, "t": 1.0, "step": 5,
+                 "queue_depth": 2, "active": 3, "occupancy": 0.75,
+                 "free_blocks": 40, "p95_step_ms": 12.0, "finished": 7,
+                 "cancelled": 0, "rejected": 1, "timeout": 0,
+                 "error": 0, "prefix_hit_rate": 0.42, "cow_copies": 3,
+                 "blocks_in_use": 24})
+    fams, samples = parse_openmetrics(reg.render())
+    for name in ("mft_serve_prefix_hit_rate", "mft_serve_cow_copies",
+                 "mft_serve_blocks_in_use", "mft_serve_pool_occupancy"):
+        assert fams[name] == "gauge", name
+    assert samples["mft_serve_prefix_hit_rate"] == 0.42
+    assert samples["mft_serve_cow_copies"] == 3.0
+    assert samples["mft_serve_blocks_in_use"] == 24.0
+    # 24 live of 64 allocatable (parked cache pages count as free)
+    assert samples["mft_serve_pool_occupancy"] == 0.375
+    # a cache-off snapshot (None vitals) must not poison the render
+    reg.observe({"event": "serve_stats", "seq": 1, "t": 2.0, "step": 6,
+                 "queue_depth": 0, "active": 0, "occupancy": 0.0,
+                 "free_blocks": 64, "p95_step_ms": None, "finished": 7,
+                 "cancelled": 0, "rejected": 1, "timeout": 0,
+                 "error": 0, "prefix_hit_rate": None, "cow_copies": None,
+                 "blocks_in_use": None})
+    parse_openmetrics(reg.render())
+
+
+def test_route_events_and_fleet_registry_helpers_render():
+    """Round-22: `route` decisions land as a (policy, replica)-labeled
+    counter + a scrape-age histogram, and the public set_gauge /
+    observe_hist / inc helpers (the router's per-replica gauges and
+    fleet SLO histograms) render through the same parser."""
+    from mobilefinetuner_tpu.core.metrics_http import MetricsRegistry
+    reg = MetricsRegistry()
+    reg.observe({"event": "route", "seq": 0, "t": 1.0, "rid": 7,
+                 "replica": 1, "policy": "affinity",
+                 "adapter": "tenant0", "queue_depth": 2,
+                 "occupancy": 0.5, "scrape_age_ms": 35.0,
+                 "candidates": 2})
+    reg.observe({"event": "route", "seq": 1, "t": 2.0, "rid": 8,
+                 "replica": None, "policy": "reject", "adapter": None,
+                 "queue_depth": None, "occupancy": None,
+                 "scrape_age_ms": None, "candidates": 0})
+    reg.set_gauge("mft_fleet_queue_depth", 3, replica="1")
+    reg.set_gauge("mft_fleet_queue_depth", 1, replica="2")
+    reg.observe_hist("mft_fleet_ttft_ms", 12.5)
+    reg.inc("mft_fleet_requests", state="finished")
+    fams, samples = parse_openmetrics(reg.render())
+    assert fams["mft_route_decisions"] == "counter"
+    assert samples[
+        'mft_route_decisions_total{policy="affinity",replica="1"}'] == 1.0
+    assert samples[
+        'mft_route_decisions_total{policy="reject",replica="None"}'] == 1.0
+    assert fams["mft_route_scrape_age_ms"] == "histogram"
+    assert samples["mft_route_scrape_age_ms_count"] == 1.0
+    assert samples['mft_fleet_queue_depth{replica="1"}'] == 3.0
+    assert samples['mft_fleet_queue_depth{replica="2"}'] == 1.0
+    assert samples["mft_fleet_ttft_ms_count"] == 1.0
+    assert samples['mft_fleet_requests_total{state="finished"}'] == 1.0
+
+
 def test_observability_modules_never_import_jax_at_module_level():
     """The zero-sync pin, structurally (migrated r19): graftlint's
     `no-jax-import` rule — metrics_http must not import jax AT ALL

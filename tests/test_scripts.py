@@ -155,6 +155,53 @@ def test_trace_export_converts_two_host_fleet_fixture(tmp_path):
         assert e["ph"] in ("X", "i", "C", "M"), e
 
 
+def test_serve_bench_router_smoke_and_trace_export_reconciles(tmp_path):
+    """Round-22 recipe guard (DESIGN.md §27): `serve_bench --router 2`
+    drives open-loop load through the real router + two tiny CPU
+    replicas and lands fleet + per-replica rows; `trace_export --router`
+    then merges the four streams into ONE timeline — router process row
+    plus a row per replica — and the span-placement reconciliation gate
+    (<1% of wall) passes. Real subprocess invocations, like an operator
+    would run."""
+    import json
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    base = str(tmp_path / "fleet.jsonl")
+    rows_out = str(tmp_path / "rows.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--model", "tiny-gpt2", "--router", "2", "--rate", "8",
+         "--requests", "10", "--adapters", "2", "--max_new", "8",
+         "--max_prompt", "32", "--num_slots", "4", "--num_blocks", "64",
+         "--dtype", "float32", "--telemetry_out", base,
+         "--out", rows_out],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=420)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    rows = json.load(open(rows_out))["rows"]
+    fleet = [x for x in rows if x.get("replicas") == 2]
+    assert len(fleet) == 1 and fleet[0]["requests"] == 10
+    assert fleet[0]["terminal"]["finished"] >= 5
+    assert sum(fleet[0]["routing"].values()) >= 10  # every decision logged
+    per_replica = [x for x in rows if "replica" in x]
+    assert {x["replica"] for x in per_replica} == {1, 2}
+    for k in (1, 2):  # replica shards really landed next to the base
+        assert os.path.exists(f"{base}.host{k}")
+    out = str(tmp_path / "fleet.trace.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_export.py"),
+         base, "--router", "-o", out],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "router reconciliation" in r.stdout
+    trace = json.load(open(out))
+    proc_names = {e["args"]["name"] for e in trace["traceEvents"]
+                  if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert proc_names == {"router", "replica 1", "replica 2"}
+    assert any(e.get("ph") == "i" and e["name"].startswith("route:rid")
+               for e in trace["traceEvents"])
+
+
 def test_bench_compare_cli_gates_on_regression(tmp_path):
     """Round-17 recipe guard: bench_compare diffs two artifacts as a
     subprocess and exits nonzero past --threshold (the CI contract)."""

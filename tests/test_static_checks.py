@@ -438,7 +438,10 @@ def test_threaded_modules_all_declare_shared_state():
     declaration (the lock-discipline rule's input, and the reader's
     map of the module's cross-thread contract)."""
     from mobilefinetuner_tpu.core.static_checks import THREADED_MODULES
-    proj = Project([os.path.join(REPO, "mobilefinetuner_tpu")])
+    # the r22 serve router lives in tools/, so the scan covers both
+    # roots (run_lint's tier-1 gate above already does)
+    proj = Project([os.path.join(REPO, "mobilefinetuner_tpu"),
+                    os.path.join(REPO, "tools")])
     declared = {m.relpath for m in proj.modules
                 if "GRAFT_SHARED_STATE" in m.source}
     for suffix in THREADED_MODULES:

@@ -71,14 +71,16 @@ REPRESENTATIVE = {
     "ckpt_dropped": dict(step=10, superseded_by=12),
     "request": dict(id=3, phase="finish", prompt_tokens=17, adapter=1,
                     queue_ms=4.2, new_tokens=32, ttft_ms=81.0,
-                    tpot_ms=9.5, reason=None),
+                    tpot_ms=9.5, reason=None, rid=41),
     # round-14 serve robustness (DESIGN.md §19): cadenced health
     # snapshot from ServeEngine.health() — queue/occupancy/page
     # headroom/p95 step latency + cumulative terminal-state counters
     "serve_stats": dict(step=50, queue_depth=3, active=8, occupancy=1.0,
                         free_blocks=120, p95_step_ms=12.5, finished=40,
                         cancelled=1, rejected=2, timeout=1, error=0,
-                        hbm_mb=512.0, pool_mb=64.0),
+                        hbm_mb=512.0, pool_mb=64.0, mesh=[1, 1],
+                        prefix_hit_rate=0.61, cow_copies=4,
+                        blocks_in_use=40),
     # round-16 memory admission (DESIGN.md §21): one verdict per
     # preflight/dispatch/serve-build check, one event per degradation-
     # ladder rung walked
@@ -103,6 +105,12 @@ REPRESENTATIVE = {
     "profile_capture": dict(step=12, trigger="slow_step",
                             path="/tmp/run.jsonl.profiles/cap0",
                             steps=2, budget_left=1),
+    # round-22 serve-fleet router (DESIGN.md §27): one placement
+    # decision from the cadenced replica scrape — rid is the same id
+    # the chosen replica's request events carry
+    "route": dict(rid=41, replica=1, policy="affinity", adapter="a",
+                  queue_depth=2, occupancy=0.75, scrape_age_ms=38.5,
+                  candidates=2),
     # round-18 multi-tenant training engine (DESIGN.md §23): one job
     # lifecycle transition; the `tenant` payload field doubles as the
     # cross-event attribution key the validator type-checks anywhere

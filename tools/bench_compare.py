@@ -36,10 +36,12 @@ import json
 import sys
 
 _HIGHER = ("tokens_per_sec", "tok_s", "mfu", "req_s", "mb_s",
-           "productive_frac", "requests", "hit_rate")
+           "productive_frac", "requests", "hit_rate", "goodput")
 _LOWER = ("_ms", "_mb", "stall", "blocking", "bytes", "elapsed_s",
           "retraces", "pages_per_req")
-_SKIP = ("vs_baseline",)  # relative-to-moving-target noise
+# relative-to-moving-target noise, plus router placement spread (how
+# many requests each replica drew is topology weather, not a regression)
+_SKIP = ("vs_baseline", "per_replica")
 
 
 def direction(name: str) -> int:

@@ -121,13 +121,20 @@ class ShardTail:
                 continue
             if not isinstance(rec, dict):
                 continue
-            ev = rec.get("event")
-            if ev == "step_stats" and isinstance(rec.get("step"), int):
-                self.last_step = rec["step"]
-            elif ev == "hang":
-                self.hangs += 1
-            elif ev == "run_end":
-                self.last_exit = rec.get("exit")
+            self._see(rec)
+
+    def _see(self, rec: dict) -> None:
+        """Per-record dispatch — the override point for policy layers
+        that tail richer shards (round 22: serve_router's ServeShardTail
+        tracks per-rid terminal `request` events so a replica's death
+        reroutes ONLY requests the shard never settled)."""
+        ev = rec.get("event")
+        if ev == "step_stats" and isinstance(rec.get("step"), int):
+            self.last_step = rec["step"]
+        elif ev == "hang":
+            self.hangs += 1
+        elif ev == "run_end":
+            self.last_exit = rec.get("exit")
 
 
 # --------------------------- decision function ------------------------------

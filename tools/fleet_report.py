@@ -33,6 +33,7 @@ from telemetry_report import (_fmt, add_format_flags,  # noqa: E402
                               memory_summary, observability_lines,
                               observability_summary, percentile,
                               recovery_lines, recovery_summary,
+                              serve_fleet_lines, serve_fleet_summary,
                               split_latest_run, straggler_entries,
                               straggler_lines)
 
@@ -177,6 +178,12 @@ def fleet_summary(shards: dict, controller=None) -> dict:
         "goodput": goodput,
         "controller": controller_summary(
             controller_entries(controller or [])),
+        # round-22 serve-fleet section (shared builder): router decision
+        # histogram + exact cross-shard rid accounting + per-replica
+        # SLO rows; None unless host 0 is a serve_router stream
+        "serve_fleet": serve_fleet_summary(
+            {h: split_latest_run(ev)[1] for h, (ev, _) in
+             shards.items()}),
     }
 
 
@@ -236,6 +243,8 @@ def print_fleet(s: dict):
     if s["hosts_missing_run_end"]:
         print(f"  hosts without run_end: {s['hosts_missing_run_end']}")
     for line in goodput_lines(s["goodput"]):  # one shared renderer
+        print(line)
+    for line in serve_fleet_lines(s.get("serve_fleet")):
         print(line)
     # the recovery timeline renders NEXT TO the goodput buckets: the
     # two together answer "where did the fleet's wall-clock go"

@@ -167,9 +167,13 @@ def build_engine(model: str, num_slots: int, block_T: int,
                  metrics_addr: str = "127.0.0.1",
                  mesh_dp: int = 1, mesh_tp: int = 1,
                  prefix_cache: bool = False, max_prompt_chunked: int = 0,
-                 sampling: bool = False):
+                 sampling: bool = False, host: int = 0):
     """model: gpt2s | gemma270m | tiny-gpt2 | tiny-gemma. The tiny
     modes are the CPU contract/smoke path (tests/test_serve.py).
+
+    `host` stamps the telemetry envelope (round 22): a router replica
+    writes shard_path(base, k) with host=k so the fleet merge key
+    (host, seq) stays collision-free across replicas.
 
     metrics_port > 0 serves the live OpenMetrics endpoint
     (core/metrics_http.py) over the engine's telemetry emit path, with
@@ -212,7 +216,7 @@ def build_engine(model: str, num_slots: int, block_T: int,
                       prefix_cache=prefix_cache,
                       max_prompt_chunked=max_prompt_chunked,
                       sampling=sampling)
-    tel = Telemetry(telemetry_out)
+    tel = Telemetry(telemetry_out, host=host)
     registry = None
     if metrics_port > 0:
         # observer attached BEFORE the engine builds, so run_start and
@@ -236,6 +240,54 @@ def build_engine(model: str, num_slots: int, block_T: int,
     return eng, names
 
 
+def gen_schedule(vocab: int, block_T: int, rate: float,
+                 n_requests: int, seed: int, prompt_lo: int,
+                 prompt_hi: int, names, prefix_pool: int = 0,
+                 prefix_frac: float = 0.7, sampling: bool = False):
+    """The seeded open-loop workload, decoupled from the engine so the
+    in-process path (run_load) and the HTTP router path
+    (run_router_rows, round 22) drive the IDENTICAL schedule: same
+    seed => same arrival gaps, prompt contents, tenant routing and
+    sampling knobs — a router row and its single-engine baseline
+    differ only in serving topology. Returns (gaps, prompts, route,
+    samp) with pure-python ints (the prompts must survive json).
+
+    prefix_pool > 0 makes the workload SHARED-PREFIX shaped (round 21):
+    a seeded pool of that many full-block prefixes, and each request
+    opens with a pool member with probability prefix_frac (its suffix
+    stays per-request random) — the multi-turn/system-prompt traffic a
+    prefix cache earns its keep on. The prefixes span whole pages (the
+    cache's unit of reuse): as many whole blocks as fit under the
+    shortest prompt, leaving at least one unique-suffix token."""
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate, n_requests)
+    lens = rng.integers(prompt_lo, prompt_hi + 1, n_requests)
+    if prefix_pool > 0:
+        bT = block_T
+        plen = max(bT, ((prompt_lo - 1) // bT) * bT)
+        pool = [[int(v) for v in rng.integers(1, vocab, plen)]
+                for _ in range(prefix_pool)]
+        hit = rng.random(n_requests) < prefix_frac
+        pick = rng.integers(0, prefix_pool, n_requests)
+        prompts = [
+            (pool[int(pick[i])] if hit[i] else
+             [int(v) for v in rng.integers(1, vocab, plen)])
+            + [int(v) for v in
+               rng.integers(1, vocab, max(int(lens[i]) - plen, 1))]
+            for i in range(n_requests)]
+    else:
+        prompts = [[int(v) for v in rng.integers(1, vocab, int(n))]
+                   for n in lens]
+    seeds = rng.integers(0, 2**31, n_requests)
+    samp = (lambda i: {"temperature": 0.8, "top_k": 40, "top_p": 0.95,
+                       "seed": int(seeds[i])}) if sampling \
+        else (lambda i: {})
+    route = ([names[int(i)] for i in
+              rng.integers(0, len(names), n_requests)]
+             if names else [None] * n_requests)
+    return gaps, prompts, route, samp
+
+
 def run_load(engine, names, rate: float, n_requests: int, seed: int,
              prompt_lo: int, prompt_hi: int, max_new: int,
              deadline_ms=None, prefix_pool: int = 0,
@@ -250,43 +302,15 @@ def run_load(engine, names, rate: float, n_requests: int, seed: int,
     Rejected-at-submit requests (bounded queue, shutdown) are included
     in the returned list — filter on `.state` for completions.
 
-    prefix_pool > 0 makes the workload SHARED-PREFIX shaped (round 21):
-    a seeded pool of that many full-block prefixes, and each request
-    opens with a pool member with probability prefix_frac (its suffix
-    stays per-request random) — the multi-turn/system-prompt traffic a
-    prefix cache earns its keep on. sampling=True submits each request
-    with a seeded per-request PRNG and a fixed softmax temperature, so
-    a sampled row is as reproducible as a greedy one."""
-    rng = np.random.default_rng(seed)
-    vocab = engine.config.vocab_size
-    gaps = rng.exponential(1.0 / rate, n_requests)
-    lens = rng.integers(prompt_lo, prompt_hi + 1, n_requests)
-    if prefix_pool > 0:
-        # prefixes span whole pages (the cache's unit of reuse) and
-        # leave at least one token of unique suffix below prompt_lo —
-        # the traffic shape is a LONG shared preamble (system prompt +
-        # few-shot header) with a short unique tail, so the preamble is
-        # as many whole pages as fit under the shortest prompt
-        bT = engine.cfg.block_T
-        plen = max(bT, ((prompt_lo - 1) // bT) * bT)
-        pool = [list(rng.integers(1, vocab, plen))
-                for _ in range(prefix_pool)]
-        hit = rng.random(n_requests) < prefix_frac
-        pick = rng.integers(0, prefix_pool, n_requests)
-        prompts = [
-            (pool[int(pick[i])] if hit[i] else
-             list(rng.integers(1, vocab, plen)))
-            + list(rng.integers(1, vocab, max(int(lens[i]) - plen, 1)))
-            for i in range(n_requests)]
-    else:
-        prompts = [list(rng.integers(1, vocab, int(n))) for n in lens]
-    seeds = rng.integers(0, 2**31, n_requests)
-    samp = (lambda i: {"temperature": 0.8, "top_k": 40, "top_p": 0.95,
-                       "seed": int(seeds[i])}) if sampling \
-        else (lambda i: {})
-    route = ([names[int(i)] for i in
-              rng.integers(0, len(names), n_requests)]
-             if names else [None] * n_requests)
+    The workload comes from gen_schedule (prefix_pool shapes it into
+    shared-prefix traffic; sampling=True submits each request with a
+    seeded per-request PRNG and a fixed softmax temperature, so a
+    sampled row is as reproducible as a greedy one)."""
+    gaps, prompts, route, samp = gen_schedule(
+        engine.config.vocab_size, engine.cfg.block_T, rate,
+        n_requests, seed, prompt_lo, prompt_hi, names,
+        prefix_pool=prefix_pool, prefix_frac=prefix_frac,
+        sampling=sampling)
     t0 = time.perf_counter()
     arrivals = t0 + np.cumsum(gaps)
     done, submitted, i = [], [], 0
@@ -368,6 +392,276 @@ def row_from(config_name: str, engine, done, elapsed: float,
         "cow_copies": (engine.cow_copies
                        if engine.prefix is not None else None),
     }
+
+
+def run_router_rows(model: str, rates, n_requests: int, adapters: int,
+                    replicas: int, telemetry_out: str,
+                    num_slots: int = 8, block_T: int = 16,
+                    num_blocks: int = 256, max_prompt: int = 64,
+                    max_new: int = 32, dtype: str = "bfloat16",
+                    seed: int = 0, prompt_lo: int = 8,
+                    prompt_hi: int = 0, max_queue: int = 0,
+                    shed_policy: str = "reject", stats_every: int = 10,
+                    prefix_cache: bool = False,
+                    max_prompt_chunked: int = 0, sampling: bool = False,
+                    prefix_pool: int = 0, prefix_frac: float = 0.7,
+                    deadline_ms=None, scrape_s: float = 0.1,
+                    collect_s: float = 0.02,
+                    startup_timeout_s: float = 300.0,
+                    settle_timeout_s: float = 600.0,
+                    baseline=None) -> list:
+    """Round 22: the same seeded open-loop Poisson load, driven over
+    HTTP through tools/serve_router.py with `replicas` engine
+    processes behind it. One router subprocess per call (one compile
+    per replica, amortised across the rates); per rate, one FLEET row
+    (goodput, TTFT/TPOT/queue-wait percentiles over ALL replicas,
+    terminal census, routing-decision histogram from the router's own
+    `route` events, per-replica prefix-cache hit rate) plus one row
+    per replica — the load-imbalance and per-tenant-locality story a
+    fleet-level mean hides. `baseline` maps rate -> single-engine TTFT
+    p99 (run_rows over the identical gen_schedule workload); when
+    given, the fleet row carries the p99 ratio bench_compare tracks.
+
+    Exact accounting is the contract here, same as the kill-replica
+    e2e: every rid the router acked MUST settle through /collect
+    before the rate's row is built — a missing rid fails the bench."""
+    import signal
+    import subprocess
+    import serve_router as sr              # sibling tool (no jax)
+    from telemetry_report import load_events
+    from mobilefinetuner_tpu.core.config import (GPT2Config,
+                                                 Gemma3TextConfig)
+    prompt_hi = prompt_hi or max_prompt
+    vocab = {"gpt2s": GPT2Config.gpt2_small,
+             "gemma270m": Gemma3TextConfig.gemma3_270m,
+             "tiny-gpt2": GPT2Config.tiny,
+             "tiny-gemma": Gemma3TextConfig.tiny}[model]().vocab_size
+    names = [f"tenant{i}" for i in range(adapters)]
+    spec = {"model": model, "num_slots": num_slots, "block_T": block_T,
+            "num_blocks": num_blocks, "max_prompt": max_prompt,
+            "max_new": max_new, "adapters": adapters, "dtype": dtype,
+            "seed": seed, "max_queue": max_queue,
+            "shed_policy": shed_policy, "stats_every": stats_every,
+            "trace_spans": True, "prefix_cache": prefix_cache,
+            "max_prompt_chunked": max_prompt_chunked,
+            "sampling": sampling}
+    base = telemetry_out
+    proc = subprocess.Popen(
+        [sys.executable, sr.__file__, "--telemetry", base,
+         "--replicas", str(replicas),
+         "--engine_json", json.dumps(spec),
+         "--scrape_s", str(scrape_s), "--collect_s", str(collect_s)])
+    url = None
+
+    def collect(results):
+        try:
+            _, obj = sr._http_json("POST", url + "/collect", {},
+                                   timeout=10.0)
+        except OSError:
+            return
+        for r in obj.get("done", ()):
+            if isinstance(r.get("rid"), int):
+                results[r["rid"]] = r
+
+    pct = lambda v: {"p50": percentile(v, 50), "p95": percentile(v, 95),
+                     "p99": percentile(v, 99)}
+    census = lambda rs: {s: sum(1 for r in rs if r["state"] == s)
+                         for s in ("finished", "cancelled", "rejected",
+                                   "timeout", "error")}
+    rows = []
+    try:
+        deadline = time.time() + startup_timeout_s
+        while True:
+            if proc.poll() is not None:
+                raise SystemExit(f"--router: router exited "
+                                 f"rc={proc.returncode} during startup")
+            if time.time() > deadline:
+                raise SystemExit("--router: router never became ready")
+            pf = sr.read_port_file(base, 0)
+            if pf:
+                try:
+                    code, _ = sr._http_json(
+                        "GET", f"http://127.0.0.1:{pf['port']}/healthz",
+                        timeout=2.0)
+                except OSError:
+                    code = 0
+                if code == 200:
+                    url = f"http://127.0.0.1:{pf['port']}"
+                    break
+            time.sleep(0.2)
+        # /healthz goes 200 at the FIRST ready replica; wait for the
+        # whole fleet so the warmup below reaches every engine
+        while time.time() < deadline:
+            try:
+                _, fl = sr._http_json("GET", url + "/fleet",
+                                      timeout=2.0)
+            except OSError:
+                fl = {}
+            if sum(1 for r in fl.get("replicas", {}).values()
+                   if r.get("status") == "ok") >= replicas:
+                break
+            time.sleep(0.2)
+        # warmup OUTSIDE the measured window: enough requests that the
+        # inflight-aware placement touches every replica, so each
+        # engine compiles prefill + step before a measured arrival
+        warm, results = [], {}
+        for _ in range(2 * replicas):
+            code, obj = sr._http_json(
+                "POST", url + "/submit",
+                {"prompt": [1] * prompt_lo,
+                 "max_new_tokens": min(2, max_new),
+                 **({"adapter": names[0]} if names else {})},
+                timeout=30.0)
+            if isinstance(obj.get("rid"), int):
+                warm.append(obj["rid"])
+        deadline = time.time() + startup_timeout_s
+        while not set(warm) <= set(results):
+            if time.time() > deadline:
+                raise SystemExit("--router: warmup never settled")
+            collect(results)
+            time.sleep(0.05)
+        for rate in rates:
+            gaps, prompts, route, samp = gen_schedule(
+                vocab, block_T, rate, n_requests, seed, prompt_lo,
+                prompt_hi, names, prefix_pool=prefix_pool,
+                prefix_frac=prefix_frac, sampling=sampling)
+            results, rids, i = {}, [], 0
+            t0 = time.perf_counter()
+            arrivals = t0 + np.cumsum(gaps)
+            while i < n_requests:
+                now = time.perf_counter()
+                while i < n_requests and arrivals[i] <= now:
+                    payload = {"prompt": prompts[i],
+                               "max_new_tokens": max_new, **samp(i)}
+                    if route[i]:
+                        payload["adapter"] = route[i]
+                    if deadline_ms:
+                        payload["deadline_ms"] = deadline_ms
+                    try:
+                        _, obj = sr._http_json(
+                            "POST", url + "/submit", payload,
+                            timeout=30.0)
+                    except OSError:
+                        obj = {}
+                    # a 503 reject still carries the rid (it settles
+                    # through /collect as a rejected row — the census
+                    # counts it, exactly like a direct-path reject)
+                    if isinstance(obj.get("rid"), int):
+                        rids.append(obj["rid"])
+                    i += 1
+                collect(results)
+                if i < n_requests:
+                    time.sleep(min(max(
+                        arrivals[i] - time.perf_counter(), 0.0), 0.02))
+            want = set(rids)
+            deadline = time.time() + settle_timeout_s
+            while not want <= set(results):
+                if time.time() > deadline:
+                    raise SystemExit(
+                        f"--router: {len(want - set(results))} rids "
+                        f"never settled — exact accounting violated")
+                collect(results)
+                time.sleep(0.03)
+            elapsed = time.perf_counter() - t0
+            res = [results[r] for r in sorted(want)]
+            name = (f"router{replicas}_{model}_serve_"
+                    f"k{max(adapters, 1)}_r{rate:g}")
+            if max_prompt_chunked:
+                name += f"_chunk{max_prompt_chunked}"
+            if prefix_pool:
+                name += (f"_prefix{prefix_pool}" if prefix_cache
+                         else f"_prefix{prefix_pool}off")
+            if sampling:
+                name += "_sampled"
+            fin = [r for r in res if r["state"] == "finished"]
+            gen_tokens = sum(int(r.get("new_tokens") or 0) for r in res)
+            # the routing-decision histogram comes from the router's
+            # OWN stream (every decision is a route event), scoped to
+            # this rate's rids; per-replica placement from the settle
+            # rows (failover rids count where they actually landed)
+            decisions = {}
+            for e in load_events(base)[0]:
+                if e["event"] == "route" and e.get("rid") in want:
+                    p = e.get("policy", "?")
+                    decisions[p] = decisions.get(p, 0) + 1
+            per_replica = {}
+            for r in res:
+                if r.get("replica") is not None:
+                    k = str(r["replica"])
+                    per_replica[k] = per_replica.get(k, 0) + 1
+            hit = {}
+            for k in range(1, replicas + 1):
+                p = sr.shard_path(base, k)
+                ss = ([e for e in load_events(p)[0]
+                       if e["event"] == "serve_stats"]
+                      if os.path.exists(p) else [])
+                hit[str(k)] = (ss[-1].get("prefix_hit_rate")
+                               if ss else None)
+            row = {
+                "config": name, "offered_rps": rate,
+                "replicas": replicas, "requests": len(fin),
+                "elapsed_s": round(elapsed, 3),
+                "req_s": (round(len(fin) / elapsed, 3)
+                          if elapsed > 0 else None),
+                "gen_tok_s": (round(gen_tokens / elapsed, 1)
+                              if elapsed > 0 else None),
+                "ttft_ms": pct(sorted(r["ttft_ms"] for r in fin
+                                      if r["ttft_ms"] is not None)),
+                "tpot_ms": pct(sorted(r["tpot_ms"] for r in fin
+                                      if r["tpot_ms"] is not None)),
+                "queue_ms": pct(sorted(r["queue_ms"] for r in fin
+                                       if r["queue_ms"] is not None)),
+                "terminal": census(res),
+                "routing": decisions,
+                "requests_per_replica": per_replica,
+                "prefix_hit_rate": hit,
+                "adapters_resident": adapters,
+                "sampling": bool(sampling),
+                "prefix_cache": bool(prefix_cache),
+            }
+            if baseline and baseline.get(rate) is not None:
+                row["baseline_ttft_p99_ms"] = baseline[rate]
+                if row["ttft_ms"]["p99"] is not None and baseline[rate]:
+                    row["ttft_p99_vs_baseline"] = round(
+                        row["ttft_ms"]["p99"] / baseline[rate], 3)
+            rows.append(row)
+            fmt = lambda v: "n/a" if v is None else f"{v:.0f}"
+            print(f"{name}: {row['req_s']} req/s "
+                  f"({row['gen_tok_s']} tok/s) over {replicas} "
+                  f"replicas, TTFT p50/p99 = "
+                  f"{fmt(row['ttft_ms']['p50'])}/"
+                  f"{fmt(row['ttft_ms']['p99'])} ms, routing "
+                  f"{decisions}, spread {per_replica}"
+                  + (f", p99 vs 1-engine x"
+                     f"{row.get('ttft_p99_vs_baseline')}"
+                     if "ttft_p99_vs_baseline" in row else ""))
+            for k in sorted(int(k) for k in per_replica):
+                sub = [r for r in res if r.get("replica") == k]
+                fin_k = [r for r in sub if r["state"] == "finished"]
+                rows.append({
+                    "config": f"{name}_replica{k}",
+                    "offered_rps": rate, "replica": k,
+                    "requests": len(fin_k),
+                    "ttft_ms": pct(sorted(
+                        r["ttft_ms"] for r in fin_k
+                        if r["ttft_ms"] is not None)),
+                    "tpot_ms": pct(sorted(
+                        r["tpot_ms"] for r in fin_k
+                        if r["tpot_ms"] is not None)),
+                    "terminal": census(sub),
+                    "prefix_hit_rate": hit.get(str(k)),
+                })
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+    print(f"router stream: {base} (replay with "
+          f"tools/trace_export.py {base} --router)")
+    return rows
 
 
 def run_rows(model: str, rates, n_requests: int, adapters: int,
@@ -612,6 +906,21 @@ def main(argv=None) -> int:
                          "sampling with seeded per-slot PRNG keys "
                          "(same seed => same tokens); rows gain a "
                          "_sampled config suffix")
+    # --- serve-fleet routing (round 22, DESIGN.md §27) ----------------
+    ap.add_argument("--router", type=int, default=0,
+                    help="drive the SAME open-loop load over HTTP "
+                         "through tools/serve_router.py with this "
+                         "many engine replica processes (0 = direct "
+                         "in-process engine). Emits one fleet row per "
+                         "rate plus per-replica rows; --telemetry_out "
+                         "becomes the router stream base — replay the "
+                         "session with tools/trace_export.py --router")
+    ap.add_argument("--router_baseline", type=int, default=0,
+                    choices=[0, 1],
+                    help="with --router: first run the identical "
+                         "workload on ONE in-process engine and stamp "
+                         "the fleet row with baseline_ttft_p99_ms + "
+                         "the ttft_p99_vs_baseline ratio")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--telemetry_out", default="")
     ap.add_argument("--out", default="",
@@ -691,32 +1000,85 @@ def main(argv=None) -> int:
         from mobilefinetuner_tpu.parallel.host_devices import \
             force_host_devices
         force_host_devices(max(8, mesh_dp * mesh_tp))
-    rows = run_rows(model, args.rate, args.requests, args.adapters,
-                    num_slots=args.num_slots, block_T=args.block_T,
-                    num_blocks=args.num_blocks,
-                    max_prompt=args.max_prompt, max_new=args.max_new,
-                    dtype=args.dtype, seed=args.seed,
-                    prompt_lo=args.prompt_lo, prompt_hi=args.prompt_hi,
-                    telemetry_out=args.telemetry_out,
-                    max_queue=args.max_queue,
-                    shed_policy=args.shed_policy,
-                    on_step_error=args.on_step_error,
-                    deadline_ms=args.deadline_ms or None,
-                    stats_every=args.stats_every, inject=args.inject,
-                    drain=bool(args.drain),
-                    watchdog_mode=args.watchdog,
-                    watchdog_min_s=args.watchdog_min_s,
-                    hbm_cap_mb=args.hbm_cap_mb,
-                    hbm_headroom=args.hbm_headroom,
-                    trace_spans=bool(args.trace_spans),
-                    metrics_port=args.metrics_port,
-                    metrics_addr=args.metrics_addr,
-                    mesh_dp=mesh_dp, mesh_tp=mesh_tp,
-                    prefix_cache=bool(args.prefix_cache),
-                    max_prompt_chunked=args.max_prompt_chunked,
-                    sampling=bool(args.sampling),
-                    prefix_pool=args.prefix_pool,
-                    prefix_frac=args.prefix_frac)
+    if args.router > 0:
+        if args.inject:
+            raise SystemExit("--router composes with --inject only by "
+                             "killing replica processes (see the "
+                             "kill-one-replica e2e); drop --inject")
+        if mesh_dp * mesh_tp > 1:
+            raise SystemExit("--router replicas are single-host "
+                             "engines (data parallelism IS the "
+                             "replica set); drop --mesh")
+        base = args.telemetry_out
+        if not base:
+            import tempfile
+            base = os.path.join(
+                tempfile.mkdtemp(prefix="serve_fleet_"), "fleet.jsonl")
+            print(f"--router: telemetry stream at {base} "
+                  f"(pass --telemetry_out to choose)")
+        baseline = None
+        if args.router_baseline:
+            brows = run_rows(
+                model, args.rate, args.requests, args.adapters,
+                num_slots=args.num_slots, block_T=args.block_T,
+                num_blocks=args.num_blocks, max_prompt=args.max_prompt,
+                max_new=args.max_new, dtype=args.dtype, seed=args.seed,
+                prompt_lo=args.prompt_lo, prompt_hi=args.prompt_hi,
+                max_queue=args.max_queue, shed_policy=args.shed_policy,
+                deadline_ms=args.deadline_ms or None,
+                prefix_cache=bool(args.prefix_cache),
+                max_prompt_chunked=args.max_prompt_chunked,
+                sampling=bool(args.sampling),
+                prefix_pool=args.prefix_pool,
+                prefix_frac=args.prefix_frac)
+            baseline = {r["offered_rps"]: r["ttft_ms"]["p99"]
+                        for r in brows}
+            rows = brows
+        else:
+            rows = []
+        rows = rows + run_router_rows(
+            model, args.rate, args.requests, args.adapters,
+            args.router, base, num_slots=args.num_slots,
+            block_T=args.block_T, num_blocks=args.num_blocks,
+            max_prompt=args.max_prompt, max_new=args.max_new,
+            dtype=args.dtype, seed=args.seed,
+            prompt_lo=args.prompt_lo, prompt_hi=args.prompt_hi,
+            max_queue=args.max_queue, shed_policy=args.shed_policy,
+            stats_every=args.stats_every or 10,
+            prefix_cache=bool(args.prefix_cache),
+            max_prompt_chunked=args.max_prompt_chunked,
+            sampling=bool(args.sampling),
+            prefix_pool=args.prefix_pool,
+            prefix_frac=args.prefix_frac,
+            deadline_ms=args.deadline_ms or None,
+            baseline=baseline)
+    else:
+        rows = run_rows(model, args.rate, args.requests, args.adapters,
+                        num_slots=args.num_slots, block_T=args.block_T,
+                        num_blocks=args.num_blocks,
+                        max_prompt=args.max_prompt, max_new=args.max_new,
+                        dtype=args.dtype, seed=args.seed,
+                        prompt_lo=args.prompt_lo, prompt_hi=args.prompt_hi,
+                        telemetry_out=args.telemetry_out,
+                        max_queue=args.max_queue,
+                        shed_policy=args.shed_policy,
+                        on_step_error=args.on_step_error,
+                        deadline_ms=args.deadline_ms or None,
+                        stats_every=args.stats_every, inject=args.inject,
+                        drain=bool(args.drain),
+                        watchdog_mode=args.watchdog,
+                        watchdog_min_s=args.watchdog_min_s,
+                        hbm_cap_mb=args.hbm_cap_mb,
+                        hbm_headroom=args.hbm_headroom,
+                        trace_spans=bool(args.trace_spans),
+                        metrics_port=args.metrics_port,
+                        metrics_addr=args.metrics_addr,
+                        mesh_dp=mesh_dp, mesh_tp=mesh_tp,
+                        prefix_cache=bool(args.prefix_cache),
+                        max_prompt_chunked=args.max_prompt_chunked,
+                        sampling=bool(args.sampling),
+                        prefix_pool=args.prefix_pool,
+                        prefix_frac=args.prefix_frac)
     if args.out:
         art = {"device": jax.devices()[0].device_kind,
                "jax": jax.__version__, "rows": []}

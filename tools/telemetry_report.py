@@ -143,6 +143,7 @@ def summarize(events, n_invalid=0) -> dict:
         "requests": request_summary(scope),
         "tenants": tenant_summary(scope),
         "serve": serve_stats_summary(scope),
+        "routing": route_summary(scope),
         "stragglers": straggler_entries(scope),
         "hangs": hang_entries(scope),
         # a killed LATEST run leaves no run_end after its run_start (a
@@ -563,6 +564,127 @@ def serve_stats_lines(s) -> list:
             f"{_fmt(s['p95_step_ms_last'], 1)} ms{mesh}{reuse}"]
 
 
+def route_summary(events) -> dict:
+    """Roll up the serve-router's `route` decision events (round 22,
+    tools/serve_router.py): decision histogram by policy and by placed
+    replica, reject count, distinct rids, and snapshot-staleness
+    percentiles (scrape_age_ms — how old the metrics behind each
+    decision were). None when the stream carries no routing traffic.
+    ONE builder shared with tools/fleet_report.py; serve_fleet_summary
+    wraps it with the cross-shard accounting."""
+    rs = [e for e in events if e.get("event") == "route"]
+    if not rs:
+        return None
+    by_policy, by_replica = {}, {}
+    for e in rs:
+        p = e.get("policy", "?")
+        by_policy[p] = by_policy.get(p, 0) + 1
+        if e.get("replica") is not None:
+            k = str(e["replica"])
+            by_replica[k] = by_replica.get(k, 0) + 1
+    ages = sorted(e["scrape_age_ms"] for e in rs
+                  if e.get("scrape_age_ms") is not None)
+    return {
+        "decisions": len(rs),
+        "rids": len({e["rid"] for e in rs}),
+        "by_policy": by_policy,
+        "by_replica": by_replica,
+        "rejects": by_policy.get("reject", 0),
+        "scrape_age_ms": {"p50": percentile(ages, 50),
+                          "p95": percentile(ages, 95),
+                          "max": ages[-1] if ages else None},
+    }
+
+
+def route_lines(r) -> list:
+    """Render a route_summary (shared with fleet_report)."""
+    if not r:
+        return []
+    pol = ", ".join(f"{k} {v}"
+                    for k, v in sorted(r["by_policy"].items()))
+    spread = ", ".join(f"r{k}:{v}"
+                       for k, v in sorted(r["by_replica"].items()))
+    a = r["scrape_age_ms"]
+    line = (f"  routing: {r['decisions']} decision(s) over "
+            f"{r['rids']} rid(s) ({pol}); spread {spread or 'none'}")
+    if a["p50"] is not None:
+        line += (f"; snapshot age p50/p95/max = {_fmt(a['p50'], 1)}/"
+                 f"{_fmt(a['p95'], 1)}/{_fmt(a['max'], 1)} ms")
+    return [line]
+
+
+def serve_fleet_summary(shards) -> dict:
+    """The serve-fleet section (round 22): {host: events} with the
+    router stream at host 0 and replica shards at host k. Router side:
+    route_summary plus EXACT rid accounting — every placed rid must
+    own at most one replica-side terminal (a duplicate means two
+    replicas both think they finished the same request; a rid with
+    none was settled router-side from the shard tail or the shutdown
+    fallback, which is how a killed replica's orphans are supposed to
+    land). Replica side: one row per shard via the SAME
+    request_summary/serve_stats_summary builders the single-engine
+    report renders. None when host 0 carries no route events (not a
+    router session)."""
+    routing = route_summary(shards.get(0, []))
+    if routing is None:
+        return None
+    placed = {e["rid"] for e in shards.get(0, [])
+              if e.get("event") == "route"
+              and isinstance(e.get("rid"), int)
+              and e.get("replica") is not None}
+    terminal: dict = {}
+    replicas = {}
+    for h, evs in sorted(shards.items()):
+        if h == 0:
+            continue
+        replicas[str(h)] = {
+            "requests": request_summary(evs),
+            "serve": serve_stats_summary(evs),
+        }
+        for e in evs:
+            if e.get("event") == "request" \
+                    and isinstance(e.get("rid"), int) \
+                    and e.get("phase") in ("finish", "cancel", "reject",
+                                           "timeout", "error"):
+                terminal[e["rid"]] = terminal.get(e["rid"], 0) + 1
+    settled = sum(1 for r in placed if terminal.get(r))
+    return {
+        "routing": routing,
+        "replicas": replicas,
+        "routed_rids": len(placed),
+        "replica_settled_rids": settled,
+        "router_settled_rids": len(placed) - settled,
+        "duplicate_terminals": sum(1 for r in placed
+                                   if terminal.get(r, 0) > 1),
+    }
+
+
+def serve_fleet_lines(f) -> list:
+    """Render a serve_fleet_summary (shared with fleet_report)."""
+    if not f:
+        return []
+    lines = route_lines(f["routing"])
+    lines.append(
+        f"  fleet accounting: {f['routed_rids']} placed, "
+        f"{f['replica_settled_rids']} replica-settled, "
+        f"{f['router_settled_rids']} router-settled"
+        + (f", {f['duplicate_terminals']} DUPLICATE terminal(s)"
+           if f["duplicate_terminals"] else ""))
+    for k, r in sorted(f["replicas"].items(), key=lambda kv: int(kv[0])):
+        req, sv = r["requests"], r["serve"]
+        if not req:
+            lines.append(f"    replica {k}: no request traffic")
+            continue
+        hit = ""
+        if sv and sv.get("prefix_hit_rate") is not None:
+            hit = f", prefix hit_rate {sv['prefix_hit_rate']:.2f}"
+        lines.append(
+            f"    replica {k}: {req['finished']}/{req['submitted']} "
+            f"finished, TTFT p99 {_fmt(req['ttft_ms']['p99'], 1)} ms, "
+            f"TPOT p50 {_fmt(req['tpot_ms']['p50'], 2)} ms{hit}")
+    return lines
+
+
 def controller_entries(events) -> list:
     """Summary dicts for `controller` events (the fleet controller's
     recovery timeline, tools/fleet_controller.py) — ONE builder shared
@@ -767,6 +889,8 @@ def print_summary(s: dict):
     for line in tenant_lines(s.get("tenants")):
         print(line)
     for line in serve_stats_lines(s.get("serve")):
+        print(line)
+    for line in route_lines(s.get("routing")):
         print(line)
     for line in straggler_lines(s.get("stragglers", [])) \
             + hang_lines(s.get("hangs", [])):

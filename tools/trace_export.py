@@ -57,7 +57,8 @@ from telemetry_report import load_events          # noqa: E402
 # incident events rendered as instant markers (name rule per event)
 _INSTANT_EVENTS = ("anomaly", "straggler", "hang", "preempt", "rollback",
                    "degrade", "mem_check", "ckpt_verify",
-                   "profile_capture", "throttle", "ckpt_dropped")
+                   "profile_capture", "throttle", "ckpt_dropped",
+                   "route")
 
 # step_stats fields rendered as counter tracks
 _COUNTERS = ("loss", "tok_s", "queue_depth", "hbm_mb", "step_time_ms")
@@ -105,6 +106,10 @@ def _instant_name(e) -> str:
         return f"degrade:{e.get('rung')}"
     if ev == "profile_capture":
         return f"profile_capture:{e.get('trigger')}"
+    if ev == "route":
+        repl = e.get("replica")
+        return (f"route:rid{e.get('rid')}->r{repl}" if repl is not None
+                else f"route:rid{e.get('rid')}->REJECT")
     return ev
 
 
@@ -300,9 +305,90 @@ def phase_reconcile(trace, goodput, pid: int = 0) -> dict:
     return out
 
 
-def export(shards, profile=None) -> dict:
+def router_reconcile(shards) -> dict | None:
+    """Router-vs-replica span reconciliation for a serve-fleet stream
+    (shard 0 = router, shard k = replica k). The merged timeline is
+    only trustworthy across process rows if each process's spans —
+    placed on the wall axis via that host's mono_offset — land where
+    that SAME process's wall-stamped events say the instant occurred.
+    Two anchors exist per routed rid, one on each side of the handoff:
+
+      router side:   the `route` span's END (t0 + offset + dur) is the
+                     ack instant the `route` EVENT stamps with wall t;
+      replica side:  the rid-tagged `queue` span's START (t0 + offset)
+                     is the submit instant the request phase=enqueue
+                     EVENT stamps with wall t.
+
+    |placed - stamped| per anchor bounds how far a span can be
+    misplaced relative to any other process's row (events share one
+    wall clock; queueing delay between route and enqueue is real time,
+    not error, and is deliberately NOT measured here). Returns None
+    when the stream carries no route events (not a router run); rids
+    missing an anchor (replica killed pre-flush, tracer off) are
+    counted, not matched — settlement handled them off-stream."""
+    shards = {h: latest_run(evs) for h, evs in shards.items()}
+    offs = {h: mono_offset(evs) for h, evs in shards.items()}
+    routes = {}
+    for e in shards.get(0, ()):
+        if e.get("event") == "route" and isinstance(e.get("rid"), int) \
+                and e.get("replica") is not None:
+            routes[e["rid"]] = e  # last route per rid wins (failover)
+    if not routes:
+        return None
+    gaps, unmatched = [], 0
+
+    def anchor(host, rid, span_name, span_end, event_t):
+        """Gap between a placed span edge and the wall stamp of the
+        event emitted at the same instant. None when either half is
+        missing on `host` for `rid`."""
+        off = offs.get(host)
+        if off is None:
+            return None
+        span = next((e for e in shards.get(host, ())
+                     if e.get("event") == "span"
+                     and e.get("name") == span_name
+                     and e.get("rid") == rid), None)
+        if span is None or event_t is None:
+            return None
+        placed = span["t0"] + off \
+            + (span["dur_ms"] / 1000.0 if span_end else 0.0)
+        return abs(placed - event_t)
+
+    enq = {}  # (host, rid) -> wall t of the last enqueue event
+    for h, evs in shards.items():
+        if h == 0:
+            continue
+        for e in evs:
+            if e.get("event") == "request" \
+                    and e.get("phase") == "enqueue" \
+                    and isinstance(e.get("rid"), int):
+                enq[(h, e["rid"])] = e["t"]
+    for rid, r in routes.items():
+        pair = (anchor(0, rid, "route", True, r["t"]),
+                anchor(r["replica"], rid, "queue", False,
+                       enq.get((r["replica"], rid))))
+        got = [g for g in pair if g is not None]
+        if len(got) < 2:
+            unmatched += 1
+        gaps.extend(got)
+    ts = [e["t"] for evs in shards.values() for e in evs
+          if isinstance(e.get("t"), (int, float))]
+    wall = (max(ts) - min(ts)) if ts else 0.0
+    worst = max(gaps) if gaps else 0.0
+    return {"rids": len(routes),
+            "matched": len(routes) - unmatched,
+            "unmatched": unmatched,
+            "anchors": len(gaps),
+            "max_gap_ms": round(worst * 1000.0, 3),
+            "wall_s": round(wall, 3),
+            "max_gap_frac": (worst / wall) if wall else 0.0}
+
+
+def export(shards, profile=None, router=False) -> dict:
     """shards: {host: events}. Returns the trace-event JSON dict.
-    Each shard is scoped to its latest run first (see latest_run)."""
+    Each shard is scoped to its latest run first (see latest_run).
+    With router=True the process rows are named for the serve-fleet
+    layout (host 0 is the router front door, host k replica k)."""
     shards = {h: latest_run(evs) for h, evs in shards.items()}
     all_events = [e for evs in shards.values() for e in evs]
     t_base = min((e["t"] for e in all_events
@@ -311,6 +397,12 @@ def export(shards, profile=None) -> dict:
     for host, events in sorted(shards.items()):
         evs, _tracks = host_trace_events(host, events, t_base)
         trace_events.extend(evs)
+    if router:
+        for e in trace_events:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                pid = e.get("pid")
+                e["args"]["name"] = ("router" if pid == 0
+                                     else f"replica {pid}")
     if profile:
         caps = [e for e in all_events
                 if e["event"] == "profile_capture"]
@@ -333,6 +425,11 @@ def main(argv=None) -> int:
     ap.add_argument("--profile", default="",
                     help="jax.profiler log dir (or trace.json[.gz]) to "
                          "merge as device-trace process rows")
+    ap.add_argument("--router", action="store_true",
+                    help="serve-fleet stream: name host 0 'router' and "
+                         "host k 'replica k', and check the per-rid "
+                         "route->enqueue clock gap across processes "
+                         "(fails when it exceeds 1%% of wall)")
     args = ap.parse_args(argv)
     paths = discover_shards(args.jsonl)
     if not paths:
@@ -362,7 +459,7 @@ def main(argv=None) -> int:
             return 1
         prof = load_profiler_events(found)
         print(f"device trace: {found} ({len(prof)} events)")
-    trace = export(shards, profile=prof)
+    trace = export(shards, profile=prof, router=args.router)
     out = args.out or (args.jsonl + ".trace.json")
     tmp = out + ".tmp"
     with open(tmp, "w") as f:
@@ -387,6 +484,24 @@ def main(argv=None) -> int:
                   f"max |span_sum - bucket| = {worst:.4f}s"
                   + (f" ({100 * worst / total:.2f}% of total)"
                      if total else ""))
+    if args.router:
+        rr = router_reconcile(shards)
+        if rr is None:
+            print("error: --router but no route events in the stream",
+                  file=sys.stderr)
+            return 1
+        print(f"router reconciliation: {rr['matched']}/{rr['rids']} "
+              f"rids fully anchored"
+              + (f" ({rr['unmatched']} settled off-stream)"
+                 if rr["unmatched"] else "")
+              + f", max span-placement gap over {rr['anchors']} "
+              f"anchor(s) = {rr['max_gap_ms']}ms "
+              f"({100 * rr['max_gap_frac']:.3f}% of {rr['wall_s']}s "
+              f"wall)")
+        if rr["max_gap_frac"] > 0.01:
+            print("error: router/replica span reconciliation gap "
+                  "exceeds 1% of wall clock", file=sys.stderr)
+            return 1
     return 0
 
 

@@ -825,6 +825,16 @@ def finish(name, r, dtype, steps) -> dict:
 
 def main():
     on_tpu = jax.devices()[0].platform != "cpu"
+    # run registry (core/run_registry.py, DESIGN.md §28): bench.py takes
+    # no flags, so registration rides $MFT_RUN_REGISTRY alone. A kill
+    # mid-suite leaves the start record; the next registry open settles
+    # it to "interrupted" (completed rows survive via the per-row flush).
+    from mobilefinetuner_tpu.core.run_registry import registry_from
+    _reg = registry_from("")
+    run_rec = _reg.begin(
+        "bench", "bench", config={"on_tpu": on_tpu},
+        platform=jax.devices()[0].platform,
+        artifacts=["BENCH_SUITE.json"]) if _reg else None
     steps = 40 if on_tpu else 2
     gsteps = 20 if on_tpu else 2
     bf16, f32 = "bfloat16", "float32"
@@ -1087,6 +1097,10 @@ def main():
 
     # (run() flushed after every row; the headline stdout line was
     # printed right after the headline row above)
+    if run_rec is not None:
+        # per-row errors are recorded IN the artifact; the suite itself
+        # completed, so the registry record is "ok" either way
+        run_rec.finalize("ok")
     return 1 if "error" in headline else 0
 
 

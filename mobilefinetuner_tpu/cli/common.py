@@ -167,6 +167,18 @@ def add_train_flags(p: argparse.ArgumentParser, lr: float = 1e-4,
                         "file continues its sequence numbers "
                         "(crash/resume). Render with "
                         "tools/telemetry_report.py")
+    g.add_argument("--run_registry", default="",
+                   help="append-only run registry stream "
+                        "(core/run_registry.py, DESIGN.md §28): one "
+                        "crash-safe `run` record per invocation — id, "
+                        "git rev, config fingerprint, mesh, platform, "
+                        "artifacts, terminal status — finalized on any "
+                        "exit path; a SIGKILLed run is settled to "
+                        "'interrupted' on the next registry open. "
+                        "Default: $MFT_RUN_REGISTRY; empty = off. "
+                        "Query with tools/observatory.py; resolve runs "
+                        "by id/rev in bench_compare/telemetry_report/"
+                        "fleet_report via --run")
     g.add_argument("--spike_z", type=float, default=8.0,
                    help="loss-spike detector: emit an `anomaly` "
                         "telemetry event when a step's loss exceeds "
@@ -1216,6 +1228,24 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
     multiproc = jax.process_count() > 1
     tel = Telemetry.for_process(getattr(args, "telemetry_out", ""))
     tel.emit("run_start", **run_manifest(vars(args), mesh))
+    # run registry (core/run_registry.py, DESIGN.md §28): one durable
+    # record per run, coordinator-only (one run, one record — the
+    # per-host shards already carry the host story). The start record
+    # flushes immediately, so a SIGKILL mid-run is settled to
+    # "interrupted" on the next registry open; finalize rides end_run,
+    # the same single-exit path run_end uses.
+    import sys as _sys
+    from mobilefinetuner_tpu.core.run_registry import RunRegistry
+    _registry = RunRegistry.from_args(args) if coord else None
+    run_rec = _registry.begin(
+        "train", os.path.basename(_sys.argv[0] or "train").replace(
+            ".py", ""),
+        config=vars(args), mesh=dict(mesh.shape) if mesh is not None
+        else None,
+        platform=jax.devices()[0].platform,
+        artifacts=[p for p in (tel.path,
+                               getattr(args, "out", "")) if p],
+        telemetry=tel) if _registry else None
     # --resume_from integrity verdicts (resolve_resume_from ran in the
     # CLI, BEFORE this stream existed): emitted here so the acceptance
     # contract — a corrupted newest checkpoint resolves down the
@@ -1267,6 +1297,13 @@ def run_training(args, *, trainable, frozen, loss_fn, nll_fn,
         extra = dict(extra_fields)
         if governor is not None:
             extra["governor_slept_ms"] = round(governor.total_slept_ms, 1)
+        # finalize the registry record BEFORE run_end: the mirrored
+        # `run` end event must land inside the run's own stream, and
+        # run_end must stay the stream's LAST event (the r13 controller
+        # keys restart decisions off it); finalize is idempotent, so
+        # nested handlers compose exactly like emit/close do
+        if run_rec is not None:
+            run_rec.finalize(exit_name)
         tel.emit("run_end", steps=steps,
                  wall_s=round(time.time() - t_start, 3),
                  exit=exit_name, goodput=meter.summary(), **extra)

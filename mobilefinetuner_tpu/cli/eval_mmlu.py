@@ -62,6 +62,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default="float32")
     p.add_argument("--telemetry_out", default="",
                    help="JSONL run-telemetry stream (core/telemetry.py)")
+    p.add_argument("--run_registry", default="",
+                   help="append-only run registry stream (core/"
+                        "run_registry.py): one crash-safe record per "
+                        "eval run; default $MFT_RUN_REGISTRY, empty = "
+                        "off")
     p.add_argument("--eval_batch", type=int, default=16,
                    help="items per forward (bucketed batching; the "
                         "reference runs per-item — on the MXU that "
@@ -157,6 +162,15 @@ def main(argv=None) -> int:
     # (coordinator at the given path; merge with tools/fleet_report.py)
     tel = Telemetry.for_process(getattr(args, "telemetry_out", ""))
     tel.emit("run_start", **run_manifest(vars(args)))
+    # run registry (core/run_registry.py): a crash between here and
+    # finalize settles to "interrupted" on the next registry open
+    from mobilefinetuner_tpu.core.run_registry import RunRegistry
+    _reg = RunRegistry.from_args(args)
+    run_rec = _reg.begin(
+        "eval", "eval_mmlu", config=vars(args),
+        platform=jax.devices()[0].platform,
+        artifacts=[p for p in (tel.path, args.out) if p],
+        telemetry=tel) if _reg else None
     t0 = _time.time()
     (hidden_fn, head_key, compute_dtype, tok, letter_encode, max_len,
      params, lora) = setup_family(args)
@@ -237,6 +251,10 @@ def main(argv=None) -> int:
     tel.emit("eval", step=result.total, loss=None, ppl=None,
              tokens=result.total, macro_accuracy=report["macro_accuracy"],
              micro_accuracy=report["micro_accuracy"])
+    # finalize before run_end so the mirrored `run` end event lands in
+    # the stream while run_end stays the stream's LAST event
+    if run_rec is not None:
+        run_rec.finalize("ok")
     tel.emit("run_end", steps=result.total,
              wall_s=round(_time.time() - t0, 3), exit="ok", goodput=None)
     tel.close()

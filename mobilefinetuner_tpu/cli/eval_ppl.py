@@ -73,6 +73,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--telemetry_out", default="",
                    help="JSONL run-telemetry stream (core/telemetry.py): "
                         "run_start manifest + eval progress + run_end")
+    p.add_argument("--run_registry", default="",
+                   help="append-only run registry stream (core/"
+                        "run_registry.py): one crash-safe record per "
+                        "eval run; default $MFT_RUN_REGISTRY, empty = "
+                        "off")
     from mobilefinetuner_tpu.cli.common import add_mem_flags
     add_mem_flags(p)
     return p
@@ -141,6 +146,15 @@ def main(argv=None) -> int:
     # (coordinator at the given path; merge with tools/fleet_report.py)
     tel = Telemetry.for_process(args.telemetry_out)
     tel.emit("run_start", **run_manifest(vars(args)))
+    # run registry (core/run_registry.py): a crash between here and
+    # finalize settles to "interrupted" on the next registry open
+    from mobilefinetuner_tpu.core.run_registry import RunRegistry
+    _reg = RunRegistry.from_args(args)
+    run_rec = _reg.begin(
+        "eval", "eval_ppl", config=vars(args),
+        platform=jax.devices()[0].platform,
+        artifacts=[p for p in (tel.path, args.out) if p],
+        telemetry=tel) if _reg else None
     # memory-admission preflight (DESIGN.md §21): AOT-compile the
     # dominant full-shape batch and check it against device capacity
     # BEFORE the data loop — the same mem_check the train path emits,
@@ -209,6 +223,10 @@ def main(argv=None) -> int:
     if jsonl:
         jsonl.write(record)
     tel.emit("eval", step=n_done, loss=mean, ppl=ppl, tokens=count)
+    # finalize before run_end so the mirrored `run` end event lands in
+    # the stream while run_end stays the stream's LAST event
+    if run_rec is not None:
+        run_rec.finalize("ok")
     # goodput is None: the eval CLIs have no metered phase loop
     tel.emit("run_end", steps=n_done,
              wall_s=round(time.time() - t0, 3), exit="ok", goodput=None)

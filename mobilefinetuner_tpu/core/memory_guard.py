@@ -93,10 +93,11 @@ def device_capacity_mb(override_mb: float = 0,
             device = jax.local_devices()[0]
         except Exception:
             return None, "unknown"
-    try:
-        limit = (device.memory_stats() or {}).get("bytes_limit", 0)
-    except Exception:
-        limit = 0
+    # guarded via xla_stats.memory_stat: some platforms return PARTIAL
+    # dicts (bytes_in_use without bytes_limit) — a missing key must fall
+    # through to the device table, never raise
+    from mobilefinetuner_tpu.core.xla_stats import memory_stat
+    limit = memory_stat(device, "bytes_limit", 0)
     if limit:
         return limit / 2 ** 20, "memory_stats"
     kind = str(getattr(device, "device_kind", "")).lower()

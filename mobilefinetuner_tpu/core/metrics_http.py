@@ -260,6 +260,27 @@ class MetricsRegistry:
                 self._gauge("mft_compile_peak_hbm_mb", g("peak_hbm_mb"))
             elif ev == "preempt":
                 self._count("mft_preempts")
+            elif ev == "run":
+                # round-23 run registry (core/run_registry.py): count
+                # finalized registrations by kind/terminal status —
+                # start records are in-flight, not a terminal tally
+                if g("phase") == "end":
+                    self._count("mft_registered_runs",
+                                kind=g("kind", "?"),
+                                status=g("status", "?"))
+            elif ev == "trend":
+                # round-23 longitudinal sentinel (tools/observatory.py):
+                # the newest sample, its rolling median and robust z per
+                # gated series — a dashboard reads the regression story
+                # off the SAME record the verdict JSON carries
+                labels = dict(metric=g("metric", "?"),
+                              config=g("config", "?"),
+                              platform=g("platform", "?"))
+                self._gauge("mft_trend_value", g("value"), **labels)
+                self._gauge("mft_trend_median", g("median"), **labels)
+                self._gauge("mft_trend_z", g("z"), **labels)
+                if g("regressed"):
+                    self._count("mft_trend_regressions", **labels)
             elif ev == "run_end":
                 self._count("mft_runs", exit=g("exit", "?"))
                 self._last_exit = g("exit")

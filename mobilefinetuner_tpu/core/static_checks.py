@@ -992,6 +992,40 @@ def _rule_emit_schema(project: Project) -> Iterator[Finding]:
             f"(dead taxonomy) — wire the event or drop the entry")
 
 
+@rule("emit-fields",
+      "a literal-kwarg emit site must carry every REQUIRED field of "
+      "its event's EVENT_SCHEMA entry (splat sites are validated at "
+      "runtime by validate_event; this catches the static half — a "
+      "field dropped at the call site would otherwise only surface "
+      "when a reader validates the stream)")
+def _rule_emit_fields(project: Project) -> Iterator[Finding]:
+    from mobilefinetuner_tpu.core.telemetry import (EVENT_SCHEMA,
+                                                    OPTIONAL_FIELDS)
+    for mod in project.all_modules():
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "emit" and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            ev = node.args[0].value
+            if ev not in EVENT_SCHEMA:
+                continue  # emit-schema already reports unknown events
+            if any(kw.arg is None for kw in node.keywords):
+                continue  # **payload splat: runtime validate_event's job
+            provided = {kw.arg for kw in node.keywords}
+            required = set(EVENT_SCHEMA[ev]) \
+                - set(OPTIONAL_FIELDS.get(ev, ()))
+            missing = sorted(required - provided)
+            if missing:
+                yield Finding(
+                    "emit-fields", mod.relpath, node.lineno, 0,
+                    f"emit({ev!r}) missing required schema field(s) "
+                    f"{', '.join(missing)} — EVENT_SCHEMA is a floor; "
+                    f"a None must be passed explicitly, not dropped")
+
+
 _SNAKE = re.compile(r"^[a-z_]+$")
 
 

@@ -441,7 +441,57 @@ EVENT_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "goodput": (dict, type(None)),
         "reason": _OPT_STR,         # "preempted" on the drain path
     },
+    # round-23 run registry (core/run_registry.py, DESIGN.md §28): one
+    # append-only, self-contained record per run REGISTRATION — phase
+    # "start" when the entrypoint opens (status "running"), phase "end"
+    # when it finalizes (terminal status). Both phases re-emit the full
+    # identity block (git rev, config fingerprint, platform, mesh) so a
+    # registry line never needs a join to interpret; a start with no
+    # matching end and a dead pid resolves to "interrupted" on the next
+    # registry open. The same event is mirrored into the run's own
+    # --telemetry_out stream as the observatory's join key.
+    "run": {
+        "run_id": (str,),
+        "phase": (str,),            # "start" | "end" (closed set)
+        "kind": (str,),             # "train" | "eval" | "serve" | "bench"
+        "tool": (str,),             # entrypoint name (basename, no .py)
+        "status": (str,),           # running | ok | interrupted | <type>
+        "git_rev": _OPT_STR,        # None outside a git checkout
+        "config_fingerprint": _OPT_STR,
+        "platform": _OPT_STR,       # "cpu" | "tpu" | ... | None
+        "mesh": (dict, type(None)),
+        "pid": (int,),              # liveness probe for dead-run repair
+        "artifacts": (list, type(None)),
+        "wall_s": _OPT_NUM,         # None on start records
+    },
+    # round-23 longitudinal sentinel (tools/observatory.py): one verdict
+    # per gated (platform, config, metric) series — the newest sample
+    # against the rolling median + MAD band of its history. Emitted
+    # through a Telemetry stream so the metrics registry folds
+    # mft_trend_* gauges off the same record the verdict JSON carries.
+    "trend": {
+        "metric": (str,),
+        "config": (str,),
+        "platform": (str,),         # series are platform-split: a CPU
+                                    # schema-pin row never gates a TPU
+                                    # perf row
+        "value": _OPT_NUM,          # newest sample
+        "median": _OPT_NUM,         # rolling median of the history
+        "mad": _OPT_NUM,            # median absolute deviation
+        "z": _OPT_NUM,              # robust z of the newest sample
+                                    # (signed: + is worse)
+        "direction": _OPT_STR,      # "higher" | "lower" | None
+        "regressed": (bool,),
+        "run": (str,),              # newest sample's run label
+        "n": (int,),                # samples in the series
+    },
 }
+
+
+# The run-registry lifecycle's CLOSED phase set (core/run_registry.py):
+# exactly one "start" and one "end" per run; the validator rejects any
+# other spelling, mirroring REQUEST_PHASES.
+RUN_PHASES = ("start", "end")
 
 
 # Fields added AFTER a schema generation was already in the wild:
@@ -532,6 +582,8 @@ def validate_event(rec: Any) -> Optional[str]:
             return f"{ev}.{field}: {type(v).__name__} not in {types}"
     if ev == "request" and rec.get("phase") not in REQUEST_PHASES:
         return f"request: unknown phase {rec.get('phase')!r}"
+    if ev == "run" and rec.get("phase") not in RUN_PHASES:
+        return f"run: unknown phase {rec.get('phase')!r}"
     return None
 
 

@@ -41,6 +41,26 @@ def shaped_all_gathers(compiled, shape, dtypes=("f32", "bf16")) -> list:
             if "all-gather" in ln and any(n in ln for n in needles)]
 
 
+def memory_stat(device, key: str, default=None):
+    """One guarded read of `device.memory_stats()[key]`. Platforms
+    return None, {}, or PARTIAL dicts — e.g. bytes_in_use present but
+    bytes_limit absent — and a consumer indexing the dict directly
+    KeyErrors exactly on those backends. A missing, non-dict, or
+    non-numeric entry is `default`, never an exception, so every
+    memory_stats consumer (live_hbm_mb here, memory_guard's capacity
+    probe, the observatory's backfill) shares one contract."""
+    try:
+        stats = device.memory_stats() or {}
+    except Exception:
+        return default
+    if not hasattr(stats, "get"):
+        return default
+    v = stats.get(key, default)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return default
+    return v
+
+
 _no_stats_logged = set()  # backends already warned about (log once)
 
 
@@ -67,12 +87,9 @@ def live_hbm_mb(devices=None):
     platform = "unknown"
     for d in devices:
         platform = getattr(d, "platform", platform)
-        try:
-            stats = d.memory_stats() or {}
-            if "bytes_in_use" in stats:
-                peak = max(peak or 0.0, stats["bytes_in_use"] / 2 ** 20)
-        except Exception:
-            continue  # a device without stats must not zero the others
+        in_use = memory_stat(d, "bytes_in_use")
+        if in_use is not None:
+            peak = max(peak or 0.0, in_use / 2 ** 20)
     if peak is None and platform not in _no_stats_logged:
         _no_stats_logged.add(platform)
         from mobilefinetuner_tpu.core.logging import get_logger

@@ -394,3 +394,44 @@ def test_bench_checkpoint_rows_contract(tmp_path):
                                            "gemma270m_lora_real"}
     for r in real:
         assert r["blocking_frac"] <= 0.25 and r["byte_identical"], r
+
+
+def test_serve_bench_registry_record_normal_and_reject(tmp_path):
+    """Round 23 (DESIGN.md §28): a serve_bench invocation leaves
+    exactly ONE finalized registry record — status "ok" on a normal
+    run, the exception's name when the build-time memory admission
+    refuses the config (the registry-scoped `with` finalizes on every
+    exit path)."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import serve_bench as sb
+    from mobilefinetuner_tpu.core.memory_guard import MemoryAdmissionError
+    from mobilefinetuner_tpu.core.run_registry import RunRegistry
+
+    registry = str(tmp_path / "runs.jsonl")
+    out = str(tmp_path / "BENCH_SERVE.json")
+    rc = sb.main(["--model", "tiny-gpt2", "--rate", "100",
+                  "--requests", "3", "--num_slots", "2",
+                  "--block_T", "8", "--num_blocks", "32",
+                  "--max_prompt", "16", "--max_new", "4",
+                  "--dtype", "float32", "--prompt_lo", "2",
+                  "--out", out, "--run_registry", registry])
+    assert rc == 0
+    (rec,) = RunRegistry(registry).records()
+    assert rec["status"] == "ok" and rec["kind"] == "serve"
+    assert out in rec["artifacts"]
+
+    reject_reg = str(tmp_path / "reject_runs.jsonl")
+    with pytest.raises(MemoryAdmissionError):
+        # 4096 blocks of float32 KV ≈ 16 MB — over the 1 MB flag cap,
+        # so the build preflight refuses before any engine exists
+        sb.main(["--model", "tiny-gpt2", "--rate", "100",
+                 "--requests", "3", "--num_slots", "2",
+                 "--block_T", "8", "--num_blocks", "4096",
+                 "--max_prompt", "16", "--max_new", "4",
+                 "--dtype", "float32", "--prompt_lo", "2",
+                 "--hbm_cap_mb", "1",
+                 "--run_registry", reject_reg])
+    (rec,) = RunRegistry(reject_reg).records()
+    assert rec["status"] == "MemoryAdmissionError"

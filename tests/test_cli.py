@@ -36,14 +36,21 @@ def wiki_dir(tmp_path_factory):
 def test_gpt2_lora_finetune_smoke(gpt2_dir, wiki_dir, tmp_path):
     from mobilefinetuner_tpu.cli.gpt2_lora_finetune import main
     out = str(tmp_path / "adapter.safetensors")
+    registry = str(tmp_path / "runs.jsonl")
     rc = main(["--pretrained_dir", gpt2_dir, "--data_dir", wiki_dir,
                "--steps", "3", "--batch_size", "2", "--seq_len", "32",
                "--lora_out", out, "--eval_interval", "3",
                "--eval_batches", "2",
-               "--eval_out", str(tmp_path / "eval.jsonl")])
+               "--eval_out", str(tmp_path / "eval.jsonl"),
+               "--run_registry", registry])
     assert rc == 0
     assert os.path.exists(out)
     assert os.path.exists(out + ".opt")
+    # exactly one FINALIZED registry record per CLI run (DESIGN.md §28)
+    from mobilefinetuner_tpu.core.run_registry import RunRegistry
+    (rec,) = RunRegistry(registry).records()
+    assert rec["status"] == "ok" and rec["kind"] == "train"
+    assert rec["wall_s"] > 0 and rec["platform"]
     records = [json.loads(l) for l in
                open(tmp_path / "eval.jsonl").read().splitlines()]
     assert any(r["type"] == "final_eval" for r in records)

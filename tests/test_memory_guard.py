@@ -292,10 +292,11 @@ def test_e2e_ladder_exhausted_raises_with_attempted_rungs(
     already enabled is skipped, not re-applied — the ladder goes
     straight to accum_x2 (then offload, via the CLI's builder)."""
     from mobilefinetuner_tpu.cli.gpt2_lora_finetune import main
+    registry = str(tmp_path / "exh_runs.jsonl")
     with pytest.raises(mg.MemoryAdmissionError) as ei:
         main(_base_argv(gpt2_dir, wiki_dir, tmp_path, "exh", steps=2)
              + ["--batch_size", "8", "--remat", "--hbm_cap_mb", "1",
-                "--on_oom_risk", "degrade"])
+                "--on_oom_risk", "degrade", "--run_registry", registry])
     assert "remat" not in ei.value.ladder      # already on: skipped
     assert "accum_x2" in ei.value.ladder and "offload" in ei.value.ladder
     evs = read_events(str(tmp_path / "exh.jsonl"))
@@ -306,6 +307,11 @@ def test_e2e_ladder_exhausted_raises_with_attempted_rungs(
                if m["event"] == "mem_check")
     assert evs[-1]["event"] == "run_end" \
         and evs[-1]["exit"] == "MemoryAdmissionError"
+    # the admission reject still leaves exactly ONE finalized registry
+    # record, carrying the exception name (DESIGN.md §28)
+    from mobilefinetuner_tpu.core.run_registry import RunRegistry
+    (rec,) = RunRegistry(registry).records()
+    assert rec["status"] == "MemoryAdmissionError"
 
 
 def test_e2e_dispatch_oom_retries_next_rung_lineage_untouched(
@@ -464,3 +470,67 @@ def test_fleet_controller_gives_up_on_inadmissible_config(tmp_path):
             f.write(json.dumps(e) + "\n")
     tail.poll()
     assert tail.last_exit == "MemoryAdmissionError"
+
+
+# --------------------------- partial memory_stats() dicts -------------------
+# Some backends return PARTIAL dicts (bytes_in_use without bytes_limit,
+# or vice versa), None, or raise outright. Round 23 routes every
+# memory_stats read through xla_stats.memory_stat so no consumer
+# KeyErrors on those platforms.
+
+class _WeirdDev:
+    device_kind = "weird accel"
+    platform = "weird"
+
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        if isinstance(self._stats, Exception):
+            raise self._stats
+        return self._stats
+
+
+def test_memory_stat_guards_every_degenerate_shape():
+    from mobilefinetuner_tpu.core.xla_stats import memory_stat
+    assert memory_stat(_WeirdDev({"bytes_in_use": 7}), "bytes_in_use") == 7
+    # partial dict: the missing key is default, not a KeyError
+    assert memory_stat(_WeirdDev({"bytes_in_use": 7}), "bytes_limit") is None
+    assert memory_stat(_WeirdDev({"bytes_in_use": 7}), "bytes_limit",
+                       default=0) == 0
+    assert memory_stat(_WeirdDev(None), "bytes_in_use") is None
+    assert memory_stat(_WeirdDev("not a dict"), "bytes_in_use") is None
+    assert memory_stat(_WeirdDev(RuntimeError("no stats")),
+                       "bytes_in_use") is None
+    # a bool is not a byte count even though bool subclasses int
+    assert memory_stat(_WeirdDev({"bytes_in_use": True}),
+                       "bytes_in_use") is None
+    assert memory_stat(_WeirdDev({"bytes_in_use": "123"}),
+                       "bytes_in_use") is None
+
+
+def test_live_hbm_mb_survives_partial_stats_dicts():
+    from mobilefinetuner_tpu.core.xla_stats import live_hbm_mb
+    # bytes_in_use present WITHOUT bytes_limit: still reported
+    devs = [_WeirdDev({"bytes_in_use": 300 * 2 ** 20}),
+            _WeirdDev({"bytes_limit": 16 * 2 ** 30})]  # in_use missing
+    assert live_hbm_mb(devices=devs) == pytest.approx(300.0)
+    # nothing reports: None (not 0.0), and no exception
+    assert live_hbm_mb(devices=[_WeirdDev(RuntimeError("boom")),
+                                _WeirdDev({})]) is None
+
+
+def test_device_capacity_falls_through_partial_stats_to_table():
+    # bytes_in_use present but bytes_limit ABSENT: the capacity probe
+    # must fall through (to the device table / unknown), not KeyError
+    dev = _WeirdDev({"bytes_in_use": 123})
+    cap, src = mg.device_capacity_mb(device=dev)
+    assert (cap, src) == (None, "unknown")
+    dev = _WeirdDev({"bytes_in_use": 123})
+    dev.device_kind = "TPU v4"
+    cap, src = mg.device_capacity_mb(device=dev)
+    assert src == "device_table" and cap == 32 * 1024.0
+    dev = _WeirdDev(RuntimeError("no stats"))
+    dev.device_kind = "TPU v4"
+    cap, src = mg.device_capacity_mb(device=dev)
+    assert src == "device_table"

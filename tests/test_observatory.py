@@ -1,0 +1,304 @@
+"""Observatory tests (tools/observatory.py, DESIGN.md §28): the
+committed-artifact backfill must ingest cleanly and span the repo's
+history, the noise-aware sentinel must gate an injected regression
+(exit 2, naming run+metric) while the clean corpus stays 0, the trend
+events must validate against EVENT_SCHEMA (tier-1 selfcheck), and the
+bench_compare satellites — exit 3 on dropped direction-aware metrics,
+--run registry resolution byte-identical to a path invocation — must
+hold."""
+
+import contextlib
+import io
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import pytest
+
+import bench_compare
+import observatory
+from mobilefinetuner_tpu.core.run_registry import RunRegistry
+from report_sections import sparkline, trend_lines
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_main(mod, argv):
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = mod.main(argv)
+    return rc, out.getvalue()
+
+
+# --------------------------- backfill ---------------------------------------
+
+def test_backfill_ingests_committed_history_clean(tmp_path):
+    report = str(tmp_path / "TREND.md")
+    rc, out = run_main(observatory,
+                       ["--backfill", "--root", REPO, "--report", report,
+                        "--json"])
+    assert rc == 0, out
+    verdict = json.loads(out)
+    assert verdict["regressions"] == []
+    assert verdict["points"] > 500 and verdict["series"] > 100
+    md = open(report).read()
+    # history starts at the earliest committed round and the table is
+    # the shared sparkline renderer
+    assert "rounds r01->" in md
+    assert "| trend |" in md or "trend" in md.splitlines()[6]
+
+
+def test_selfcheck_passes_on_committed_corpus(capsys):
+    assert observatory.selfcheck(REPO) == 0
+    assert "selfcheck ok" in capsys.readouterr().out
+
+
+def test_injected_regression_exits_2_and_names_run_and_metric(tmp_path):
+    # continue a real committed series with a collapsed-throughput
+    # candidate: half the tokens/sec of history must fire the gate.
+    # The config must have >= min_n PRIOR committed points, so pick the
+    # deepest throughput series in the backfill rather than hardcoding
+    # one artifact's first row.
+    store = []
+    for pat in observatory.BACKFILL_GLOBS:
+        import glob
+        for p in sorted(glob.glob(os.path.join(REPO, pat))):
+            store.extend(observatory.ingest_file(p))
+    deep = max((s for s in observatory.build_series(store)
+                if s["metric"] == "tokens_per_sec_per_chip"),
+               key=lambda s: len(s["values"]))
+    assert len(deep["values"]) >= 5, "throughput history too shallow"
+    cfg = deep["config"]
+    tok = [{"value": deep["values"][-1]}]
+    bad = str(tmp_path / "BENCH_r99.json")
+    with open(bad, "w") as f:
+        json.dump({"rows": [{"config": cfg,
+                             "tokens_per_sec_per_chip":
+                                 tok[0]["value"] / 2.0}]}, f)
+    rc, out = run_main(observatory,
+                       ["--backfill", "--root", REPO, bad, "--json"])
+    assert rc == 2
+    regs = json.loads(out)["regressions"]
+    assert any(r["run"] == "r99" and
+               r["metric"] == "tokens_per_sec_per_chip" and
+               r["config"] == cfg for r in regs), regs
+
+
+def test_candidate_order_places_positional_paths_last(tmp_path):
+    p = str(tmp_path / "BENCH_r02.json")
+    with open(p, "w") as f:
+        json.dump({"rows": [{"config": "c", "tok_s": 5.0}]}, f)
+    # despite the r02 name, a positional path is the candidate — judged
+    # as the LATEST point, after all committed history
+    rows = observatory.ingest_file(p, order=observatory.CANDIDATE_ORDER)
+    assert rows[0]["order"] == observatory.CANDIDATE_ORDER
+    assert rows[0]["order"] > observatory.HEAD_ORDER
+
+
+# --------------------------- sentinel ---------------------------------------
+
+def _series(values, metric="tok_s", platform="tpu", config="c"):
+    return [{"platform": platform, "config": config, "metric": metric,
+             "runs": [f"r{i:02d}" for i in range(len(values))],
+             "values": values}]
+
+
+def test_sentinel_gates_only_with_all_three_conditions():
+    # stable history, collapsed latest: fires
+    v = observatory.sentinel(_series([100, 101, 99, 100, 100, 50]))[0]
+    assert v["regressed"] and v["z"] > 4
+    # same collapse but under min_n prior points: cannot gate
+    v = observatory.sentinel(_series([100, 100, 50]))[0]
+    assert not v["regressed"]
+    # big z but under pct_floor: cannot gate
+    v = observatory.sentinel(
+        _series([100.0, 100.0, 100.0, 100.0, 100.0, 99.0]),
+        rel_floor=0.0001)[0]
+    assert v["z"] > 4 and not v["regressed"]
+    # informational metric (no direction): trended, never gated
+    v = observatory.sentinel(
+        _series([100, 100, 100, 100, 100, 50], metric="loss_final"))[0]
+    assert v["direction"] is None and not v["regressed"]
+
+
+def test_sentinel_lower_better_direction():
+    v = observatory.sentinel(
+        _series([10, 10, 11, 10, 10, 25], metric="step_time_ms"))[0]
+    assert v["direction"] == "lower" and v["regressed"]
+    # improvement in a lower-better metric never fires
+    v = observatory.sentinel(
+        _series([10, 10, 11, 10, 10, 2], metric="step_time_ms"))[0]
+    assert not v["regressed"]
+
+
+def test_sentinel_rel_floor_keeps_flat_history_from_infinite_sigma():
+    # MAD = 0; without the relative floor any nonzero delta would be
+    # infinite sigmas — the 5% floor keeps a 1% wiggle at z ~ 0.2
+    v = observatory.sentinel(_series([100.0] * 6 + [99.0]))[0]
+    assert v["z"] < 1 and not v["regressed"]
+
+
+def test_platform_split_isolates_cpu_from_tpu():
+    tpu = {"device": "TPU v4", "rows": [{"config": "c", "tok_s": 100}]}
+    cpu = {"synthetic": True, "rows": [{"config": "c", "tok_s": 1}]}
+    store = []
+    for name, data in (("BENCH_A_r01.json", tpu), ("BENCH_B_r02.json", cpu)):
+        import tempfile
+        d = tempfile.mkdtemp()
+        p = os.path.join(d, name)
+        with open(p, "w") as f:
+            json.dump(data, f)
+        store.extend(observatory.ingest_file(p))
+    series = observatory.build_series(store)
+    assert {s["platform"] for s in series} == {"tpu", "cpu"}
+    assert all(len(s["values"]) == 1 for s in series)
+
+
+def test_platform_of_variants():
+    assert observatory.platform_of({"device": "TPU v5e"}) == "tpu"
+    assert observatory.platform_of({"device_kind": "v4"}) == "tpu"
+    assert observatory.platform_of({"platform": "cpu"}) == "cpu"
+    assert observatory.platform_of({"synthetic": True}) == "cpu"
+    assert observatory.platform_of({}) == "unknown"
+
+
+def test_registry_runs_are_the_candidate(tmp_path, monkeypatch):
+    monkeypatch.delenv("MFT_RUN_REGISTRY", raising=False)
+    art = tmp_path / "BENCH_REG.json"
+    art.write_text(json.dumps(
+        {"rows": [{"config": "c", "tok_s": 42.0}]}))
+    reg = RunRegistry(str(tmp_path / "runs.jsonl"))
+    h = reg.begin("bench", "bench", platform="cpu",
+                  artifacts=[str(art)])
+    h.finalize("ok")
+    rows = observatory.ingest_registry(reg)
+    by_metric = {r["metric"]: r for r in rows}
+    assert by_metric["wall_s"]["config"].startswith("bench_bench")
+    assert by_metric["tok_s"]["run"] == h.run_id
+    assert all(r["order"] == observatory.CANDIDATE_ORDER for r in rows)
+
+
+# --------------------------- rendering --------------------------------------
+
+def test_sparkline_and_trend_lines():
+    assert sparkline([0, 1]) == "▁█"
+    assert len(sparkline([1, 2, 3, None, 5])) == 5
+    assert sparkline([5.0, 5.0, 5.0]) == "▁▁▁"  # flat: all-min, no crash
+    verdicts = observatory.sentinel(_series([100, 101, 99, 100, 100, 50]))
+    lines = trend_lines(verdicts)
+    joined = "\n".join(lines)
+    assert "**REGRESSED**" in joined and "tok_s" in joined
+
+
+def test_report_sections_back_compat_reexport():
+    # serve_bench/fleet_report historically import section builders from
+    # telemetry_report; the r23 extraction must keep that path alive
+    from telemetry_report import emit_output, percentile  # noqa: F401
+    import report_sections
+    assert percentile is report_sections.percentile
+
+
+# --------------------------- bench_compare satellites ------------------------
+
+def _write(path, rows):
+    with open(path, "w") as f:
+        json.dump({"rows": rows}, f)
+    return str(path)
+
+
+def test_bench_compare_exit_3_on_dropped_metric(tmp_path):
+    old = _write(tmp_path / "old.json",
+                 [{"config": "c", "tok_s": 100.0, "step_time_ms": 10.0}])
+    new = _write(tmp_path / "new.json", [{"config": "c", "tok_s": 100.0}])
+    rc, out = run_main(bench_compare, [old, new, "--threshold", "5"])
+    assert rc == 3
+    assert "missing from NEW" in out and "step_time_ms" in out
+    # without a threshold the drop is reported but never gates
+    rc, _out = run_main(bench_compare, [old, new])
+    assert rc == 0
+    # a regression outranks the drop: exit 2 wins
+    new2 = _write(tmp_path / "new2.json",
+                  [{"config": "c", "tok_s": 50.0}])
+    rc, _out = run_main(bench_compare, [old, new2, "--threshold", "5"])
+    assert rc == 2
+
+
+def test_bench_compare_json_verdict_lists_dropped(tmp_path):
+    old = _write(tmp_path / "old.json",
+                 [{"config": "c", "tok_s": 100.0, "notes_count": 3.0}])
+    new = _write(tmp_path / "new.json", [{"config": "c", "tok_s": 100.0}])
+    rc, out = run_main(bench_compare, [old, new, "--json",
+                                       "--threshold", "5"])
+    c = json.loads(out)
+    # notes_count has no direction: reported as dropped, never gated
+    assert c["dropped"] == [{"config": "c", "metric": "notes_count",
+                             "direction": None}]
+    assert c["gated_drops"] == [] and rc == 0
+
+
+def test_bench_compare_run_resolution_byte_identical(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.delenv("MFT_RUN_REGISTRY", raising=False)
+    old = _write(tmp_path / "BENCH_OLD.json",
+                 [{"config": "c", "tok_s": 100.0}])
+    new = _write(tmp_path / "BENCH_NEW.json",
+                 [{"config": "c", "tok_s": 90.0}])
+    reg = RunRegistry(str(tmp_path / "runs.jsonl"))
+    h1 = reg.begin("bench", "bench", platform="cpu", artifacts=[old])
+    h1.finalize("ok")
+    h2 = reg.begin("bench", "bench", platform="cpu", artifacts=[new])
+    h2.finalize("ok")
+    rc_path, out_path = run_main(bench_compare, [old, new])
+    rc_run, out_run = run_main(
+        bench_compare, ["--registry", str(tmp_path / "runs.jsonl"),
+                        "--run", h1.run_id, h2.run_id])
+    assert rc_run == rc_path
+    assert out_run == out_path  # byte-identical: resolution IS a path
+
+
+def test_bench_compare_run_without_registry_errors(capsys):
+    rc = bench_compare.main(["--run", "a", "b"])
+    assert rc == 1
+    assert "registry" in capsys.readouterr().err
+
+
+def test_bench_compare_run_unresolvable_token(tmp_path, capsys):
+    reg = RunRegistry(str(tmp_path / "runs.jsonl"))
+    h = reg.begin("bench", "bench", platform="cpu")
+    h.finalize("ok")
+    rc = bench_compare.main(["--registry", str(tmp_path / "runs.jsonl"),
+                             "--run", "nope", h.run_id])
+    assert rc == 1
+    assert "no .json artifact" in capsys.readouterr().err
+
+
+# --------------------------- observatory CLI surface -------------------------
+
+def test_observatory_nothing_ingested_is_an_error(capsys):
+    with pytest.MonkeyPatch.context() as mp:
+        mp.delenv("MFT_RUN_REGISTRY", raising=False)
+        rc = observatory.main([])
+    assert rc == 1
+    assert "nothing ingested" in capsys.readouterr().err
+
+
+def test_observatory_store_and_telemetry_out(tmp_path):
+    store = str(tmp_path / "store.jsonl")
+    stream = str(tmp_path / "trend.jsonl")
+    rc, _out = run_main(observatory,
+                        ["--backfill", "--root", REPO, "--store", store,
+                         "--telemetry_out", stream, "--json"])
+    assert rc == 0
+    rows = [json.loads(l) for l in open(store)]
+    assert all({"platform", "config", "metric", "value", "order"}
+               <= set(r) for r in rows)
+    evs = [json.loads(l) for l in open(stream)]
+    trends = [e for e in evs if e.get("event") == "trend"]
+    assert trends and all("regressed" in e for e in trends)
+    from mobilefinetuner_tpu.core.telemetry import validate_event
+    for e in trends:
+        assert validate_event(e) is None, validate_event(e)

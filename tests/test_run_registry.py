@@ -1,0 +1,220 @@
+"""Run-registry tests (core/run_registry.py, DESIGN.md §28): two-phase
+self-contained records through the Telemetry flush path, append-only
+interrupted-repair for SIGKILLed runs (the r15 kill-safe contract at
+registry granularity), resolution by run id / prefix / git rev, and the
+context-manager exit-name convention."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mobilefinetuner_tpu.core.run_registry import (RunRegistry,
+                                                   config_fingerprint,
+                                                   git_rev, registry_from)
+from mobilefinetuner_tpu.core.telemetry import Telemetry, validate_event
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def read_lines(path):
+    with open(path) as f:
+        return [json.loads(l) for l in f.read().splitlines() if l.strip()]
+
+
+# --------------------------- record lifecycle -------------------------------
+
+def test_begin_and_finalize_write_two_validating_records(tmp_path):
+    path = str(tmp_path / "runs.jsonl")
+    reg = RunRegistry(path)
+    h = reg.begin("eval", "eval_ppl", config={"split": "valid", "b": 2},
+                  platform="cpu", artifacts=["/tmp/out.json"])
+    h.finalize("ok")
+    recs = read_lines(path)
+    assert [r["phase"] for r in recs] == ["start", "end"]
+    for r in recs:
+        assert r["event"] == "run"
+        assert validate_event(r) is None, validate_event(r)
+    start, end = recs
+    # two-phase records are SELF-CONTAINED: the end record re-carries
+    # the full identity block, no join needed to interpret it
+    assert end["run_id"] == start["run_id"]
+    assert end["kind"] == "eval" and end["tool"] == "eval_ppl"
+    assert start["status"] == "running" and end["status"] == "ok"
+    assert start["wall_s"] is None and end["wall_s"] >= 0
+    assert start["pid"] == os.getpid()
+    assert end["config_fingerprint"] == config_fingerprint(
+        {"split": "valid", "b": 2})
+
+
+def test_records_fold_to_one_finalized_record_per_run(tmp_path):
+    reg = RunRegistry(str(tmp_path / "runs.jsonl"))
+    h1 = reg.begin("bench", "bench", platform="cpu")
+    h1.finalize("ok", artifacts=["BENCH_SUITE.json"])
+    h2 = reg.begin("serve", "serve_bench", platform="cpu")
+    h2.finalize("preempted")
+    recs = reg.records()
+    assert len(recs) == 2
+    by_id = {r["run_id"]: r for r in recs}
+    assert by_id[h1.run_id]["status"] == "ok"
+    assert by_id[h1.run_id]["artifacts"] == ["BENCH_SUITE.json"]
+    assert by_id[h2.run_id]["status"] == "preempted"
+
+
+def test_finalize_is_idempotent(tmp_path):
+    path = str(tmp_path / "runs.jsonl")
+    reg = RunRegistry(path)
+    h = reg.begin("train", "train_lora", platform="cpu")
+    h.finalize("ok")
+    h.finalize("interrupted")  # nested crash handler racing end_run
+    recs = [r for r in read_lines(path) if r["phase"] == "end"]
+    assert len(recs) == 1 and recs[0]["status"] == "ok"
+
+
+def test_context_manager_uses_exception_name_as_status(tmp_path):
+    reg = RunRegistry(str(tmp_path / "runs.jsonl"))
+    try:
+        with reg.begin("train", "t", platform="cpu"):
+            raise MemoryError("boom")
+    except MemoryError:
+        pass
+    (rec,) = reg.records()
+    assert rec["status"] == "MemoryError"
+    with reg.begin("train", "t2", platform="cpu"):
+        pass
+    by_tool = {r["tool"]: r for r in reg.records()}
+    assert by_tool["t2"]["status"] == "ok"
+
+
+def test_registered_run_mirrors_into_own_telemetry_stream(tmp_path):
+    """The `run` event rides the run's own --telemetry_out stream too —
+    the observatory's join key between stream and registry."""
+    reg = RunRegistry(str(tmp_path / "runs.jsonl"))
+    stream = str(tmp_path / "run.jsonl")
+    with Telemetry(stream) as tel:
+        h = reg.begin("eval", "eval_mmlu", platform="cpu", telemetry=tel)
+        h.finalize("ok")
+    evs = [r for r in read_lines(stream) if r["event"] == "run"]
+    assert [r["phase"] for r in evs] == ["start", "end"]
+    assert evs[0]["run_id"] == h.run_id
+    for r in evs:
+        assert validate_event(r) is None
+
+
+# --------------------------- crash repair -----------------------------------
+
+_KILL_CHILD = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from mobilefinetuner_tpu.core.run_registry import RunRegistry
+reg = RunRegistry(sys.argv[1])
+h = reg.begin("train", "killed_tool", platform="cpu")
+print("REGISTERED", flush=True)
+time.sleep(60)  # SIGKILLed before finalize
+"""
+
+
+def test_sigkill_between_start_and_finalize_settles_interrupted(tmp_path):
+    """The r15 kill-safe contract at registry granularity: a run
+    SIGKILLed mid-flight leaves a durable start record (per-event
+    flush), and the NEXT registry open appends an `interrupted` end
+    record — append-only repair, nothing rewritten, no zombie
+    "running" rows."""
+    path = str(tmp_path / "runs.jsonl")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    child = subprocess.Popen(
+        [sys.executable, "-c", _KILL_CHILD.format(repo=REPO), path],
+        stdout=subprocess.PIPE, env=env, text=True)
+    try:
+        assert child.stdout.readline().strip() == "REGISTERED"
+        os.kill(child.pid, signal.SIGKILL)
+    finally:
+        child.wait(timeout=30)
+
+    raw = read_lines(path)
+    assert [r["phase"] for r in raw] == ["start"]  # durable, unfinalized
+    reg = RunRegistry(path)
+    (rec,) = reg.records()  # records() settles by default
+    assert rec["status"] == "interrupted"
+    # the repair is APPEND-ONLY: start line untouched, end line added
+    raw = read_lines(path)
+    assert [r["phase"] for r in raw] == ["start", "end"]
+    assert raw[0] == [r for r in raw if r["phase"] == "start"][0]
+    # settle is idempotent — a second open appends nothing
+    assert reg.settle() == 0
+    assert len(read_lines(path)) == 2
+
+
+def test_settle_leaves_live_runs_alone(tmp_path):
+    reg = RunRegistry(str(tmp_path / "runs.jsonl"))
+    reg.begin("train", "live_tool", platform="cpu")  # this pid: alive
+    assert reg.settle() == 0
+    (rec,) = reg.records()
+    assert rec["status"] == "running"
+
+
+# --------------------------- resolution -------------------------------------
+
+def test_resolve_by_id_prefix_and_git_rev(tmp_path):
+    reg = RunRegistry(str(tmp_path / "runs.jsonl"))
+    h = reg.begin("bench", "bench", platform="cpu", root=REPO)
+    h.finalize("ok")
+    rec = reg.resolve(h.run_id)
+    assert rec and rec["tool"] == "bench"
+    # unique prefix resolves too (operator-friendly short ids)
+    assert reg.resolve(h.run_id[:-2])["run_id"] == h.run_id
+    rev = git_rev(REPO)
+    assert rev and len(rev) == 12
+    assert reg.resolve(rev)["run_id"] == h.run_id
+    assert reg.resolve(rev[:7])["run_id"] == h.run_id
+    assert reg.resolve("nonexistent") is None
+
+
+def test_artifact_for_returns_first_existing_artifact(tmp_path):
+    art = tmp_path / "BENCH_X.json"
+    art.write_text("{}")
+    reg = RunRegistry(str(tmp_path / "runs.jsonl"))
+    h = reg.begin("bench", "bench", platform="cpu",
+                  artifacts=["/nope/gone.json", str(art)])
+    h.finalize("ok")
+    assert reg.artifact_for(h.run_id) == str(art)
+    assert reg.artifact_for(h.run_id, suffix=".jsonl") is None
+
+
+def test_fingerprint_ignores_unserializable_and_ordering():
+    a = config_fingerprint({"b": 1, "a": "x", "fn": object()})
+    b = config_fingerprint({"a": "x", "b": 1})
+    assert a == b and len(a) == 12
+    assert config_fingerprint({"a": "y", "b": 1}) != a
+    assert config_fingerprint(None) is None
+
+
+def test_registry_from_env_and_flag(tmp_path, monkeypatch):
+    monkeypatch.delenv("MFT_RUN_REGISTRY", raising=False)
+    assert registry_from("") is None
+
+    class Args:
+        run_registry = ""
+    assert RunRegistry.from_args(Args()) is None
+    monkeypatch.setenv("MFT_RUN_REGISTRY", str(tmp_path / "env.jsonl"))
+    assert registry_from("").path.endswith("env.jsonl")
+    Args.run_registry = str(tmp_path / "flag.jsonl")
+    assert RunRegistry.from_args(Args()).path.endswith("flag.jsonl")
+
+
+def test_concurrent_writers_are_keyed_by_run_id_not_seq(tmp_path):
+    """Two handles appending through short-lived Telemetry opens may
+    interleave; readers key on run_id so both runs resolve."""
+    reg = RunRegistry(str(tmp_path / "runs.jsonl"))
+    h1 = reg.begin("eval", "a", platform="cpu")
+    h2 = reg.begin("eval", "b", platform="cpu")
+    h2.finalize("ok")
+    h1.finalize("ok")
+    recs = reg.records()
+    assert {r["tool"] for r in recs} == {"a", "b"}
+    assert all(r["status"] == "ok" for r in recs)

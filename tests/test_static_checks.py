@@ -240,6 +240,39 @@ def f(tel, sink):
     assert not res.findings
 
 
+def test_emit_fields_positive_and_negative(tmp_path):
+    # a literal-kwarg emit site that silently drops a required schema
+    # field is the dead-taxonomy bug in miniature: the round-23 `run`
+    # record contract (DESIGN.md §28) only holds if every field is
+    # carried explicitly (None included)
+    res = lint_snippet(tmp_path, "mobilefinetuner_tpu/core/foo.py", """
+def f(tel):
+    tel.emit("trend", metric="tok_s", config="c", platform="tpu",
+             value=1.0, median=1.0, mad=0.0, z=0.0,
+             direction="higher", regressed=False, run="r01")
+""", rules=["emit-fields"])
+    assert names(res) == ["emit-fields"]
+    assert "n" in res.findings[0].message.split("field(s)")[1]
+    # full field set: clean
+    res = lint_snippet(tmp_path, "mobilefinetuner_tpu/core/foo.py", """
+def f(tel):
+    tel.emit("trend", metric="tok_s", config="c", platform="tpu",
+             value=1.0, median=None, mad=None, z=None,
+             direction=None, regressed=False, run="r01", n=1)
+""", rules=["emit-fields"])
+    assert not res.findings
+
+
+def test_emit_fields_skips_splats_and_unknown_events(tmp_path):
+    res = lint_snippet(tmp_path, "mobilefinetuner_tpu/core/foo.py", """
+def f(tel, payload):
+    tel.emit("run", **payload)       # runtime validate_event's job
+    tel.emit("bogus_event", step=1)  # emit-schema's job, not ours
+    tel.emit(name, step=1)           # dynamic event name: unknowable
+""", rules=["emit-fields"])
+    assert not res.findings
+
+
 def test_serve_taxonomy_positive_and_negative(tmp_path):
     from mobilefinetuner_tpu.core.telemetry import (REQUEST_PHASES,
                                                     REQUEST_REASONS)

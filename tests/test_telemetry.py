@@ -127,6 +127,19 @@ REPRESENTATIVE = {
                     goodput={"total_s": 60.0, "step_s": 50.0,
                              "productive_frac": 0.83},
                     reason=None),
+    # round-23 run registry (DESIGN.md §28): one self-contained
+    # lifecycle record per registered run (start mirrors into the run's
+    # own stream as the observatory's join key; end carries the
+    # terminal status), and one sentinel verdict per trended series
+    "run": dict(run_id="20260807T120000-1234-abc123", phase="start",
+                kind="train", tool="train_lora_gemma", status="running",
+                git_rev="abcdef123456", config_fingerprint="0123456789ab",
+                platform="cpu", mesh={"data": 1}, pid=1234,
+                artifacts=["/tmp/run.jsonl"], wall_s=None),
+    "trend": dict(metric="tokens_per_sec_per_chip", config="gpt2s_lora",
+                  platform="tpu", value=100.0, median=110.0, mad=2.0,
+                  z=3.4, direction="higher", regressed=False,
+                  run="r23", n=12),
 }
 
 

@@ -25,8 +25,12 @@ Usage:
   python tools/bench_compare.py OLD.json NEW.json
   python tools/bench_compare.py OLD.json NEW.json --threshold 5
   python tools/bench_compare.py OLD.json NEW.json --json
+  python tools/bench_compare.py --registry runs.jsonl --run OLD NEW
 Exit codes: 0 = ok, 1 = usage/load error or no shared rows,
-2 = regression beyond --threshold.
+2 = regression beyond --threshold, 3 = a direction-aware metric
+present in OLD is MISSING from NEW under a threshold (a deleted
+metric must not read as "no regression" — distinct code so CI can
+tell "got slower" from "stopped measuring").
 """
 
 from __future__ import annotations
@@ -34,6 +38,8 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 _HIGHER = ("tokens_per_sec", "tok_s", "mfu", "req_s", "mb_s",
            "productive_frac", "requests", "hit_rate", "goodput")
@@ -114,12 +120,20 @@ def load_rows(path: str, key: str = "config") -> dict:
 def compare(old: dict, new: dict, threshold: float = 0.0) -> dict:
     """Row-matched per-metric deltas. A REGRESSION is a direction-aware
     metric worse by more than `threshold` percent (threshold <= 0:
-    nothing gates, everything reports)."""
+    nothing gates, everything reports). A direction-aware metric
+    present in OLD but absent from NEW is a DROPPED metric — reported
+    separately (and exit 3 under a threshold): deleting a metric must
+    not read as "no regression"."""
     shared = sorted(set(old) & set(new))
     rows = []
     regressions = []
+    dropped = []
     for cfg in shared:
         o, n = old[cfg], new[cfg]
+        for metric in sorted(set(o) - set(n)):
+            dropped.append({"config": cfg, "metric": metric,
+                            "direction": {1: "higher", -1: "lower",
+                                          0: None}[direction(metric)]})
         for metric in sorted(set(o) & set(n)):
             ov, nv = o[metric], n[metric]
             if ov == 0:
@@ -137,6 +151,8 @@ def compare(old: dict, new: dict, threshold: float = 0.0) -> dict:
                          "regressed": regressed})
             if regressed:
                 regressions.append(rows[-1])
+    gated_drops = [d for d in dropped if d["direction"]] \
+        if threshold > 0 else []
     return {
         "shared_rows": shared,
         "only_old": sorted(set(old) - set(new)),
@@ -144,6 +160,8 @@ def compare(old: dict, new: dict, threshold: float = 0.0) -> dict:
         "threshold_pct": threshold,
         "metrics": rows,
         "regressions": regressions,
+        "dropped": dropped,
+        "gated_drops": gated_drops,
     }
 
 
@@ -162,9 +180,18 @@ def print_compare(c: dict) -> None:
         print(f"  {m['metric']:<28} {m['old']:>12.4g} -> "
               f"{m['new']:>12.4g}  {m['delta_pct']:>+8.2f}% "
               f"{arrow}{flag}")
+    for d in c.get("dropped", []):
+        gate = "  [gates: exit 3]" if d["direction"] \
+            and c["threshold_pct"] > 0 else ""
+        print(f"  {d['config']}: metric {d['metric']} present in OLD, "
+              f"missing from NEW{gate}")
     if c["regressions"]:
         print(f"\n{len(c['regressions'])} metric(s) regressed beyond "
               f"{c['threshold_pct']:g}%")
+    elif c.get("gated_drops"):
+        print(f"\n{len(c['gated_drops'])} direction-aware metric(s) "
+              f"dropped from NEW (a deleted metric cannot pass the "
+              f"gate)")
     elif c["threshold_pct"] > 0:
         print(f"\nno regression beyond {c['threshold_pct']:g}% across "
               f"{len(c['shared_rows'])} shared row(s)")
@@ -173,26 +200,56 @@ def print_compare(c: dict) -> None:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="diff two BENCH_*.json artifacts by config row")
-    ap.add_argument("old")
-    ap.add_argument("new")
+    ap.add_argument("old", nargs="?", default="")
+    ap.add_argument("new", nargs="?", default="")
     ap.add_argument("--key", default="config",
                     help="row-matching key (default: config)")
     ap.add_argument("--threshold", type=float, default=0.0,
                     help="exit 2 when any direction-aware metric is "
-                         "worse by more than this percent (0 = report "
-                         "only)")
+                         "worse by more than this percent; exit 3 when "
+                         "a direction-aware metric was dropped from "
+                         "NEW (0 = report only)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable comparison instead of text")
+    ap.add_argument("--registry", default="",
+                    help="run registry stream (core/run_registry.py); "
+                         "default $MFT_RUN_REGISTRY")
+    ap.add_argument("--run", nargs=2, metavar=("OLD", "NEW"),
+                    default=None,
+                    help="resolve OLD/NEW artifacts from the registry "
+                         "by run id, id prefix, or git rev — after "
+                         "resolution this IS a path invocation, so "
+                         "output is byte-identical")
     args = ap.parse_args(argv)
+    old_path, new_path = args.old, args.new
+    if args.run:
+        from mobilefinetuner_tpu.core.run_registry import registry_from
+        reg = registry_from(args.registry)
+        if reg is None:
+            print("error: --run needs --registry or $MFT_RUN_REGISTRY",
+                  file=sys.stderr)
+            return 1
+        resolved = []
+        for token in args.run:
+            p = reg.artifact_for(token, suffix=".json")
+            if not p:
+                print(f"error: --run {token!r}: no .json artifact "
+                      f"resolved from registry {reg.path}",
+                      file=sys.stderr)
+                return 1
+            resolved.append(p)
+        old_path, new_path = resolved
+    if not old_path or not new_path:
+        ap.error("pass OLD NEW paths or --run OLD NEW")
     try:
-        old = load_rows(args.old, key=args.key)
-        new = load_rows(args.new, key=args.key)
+        old = load_rows(old_path, key=args.key)
+        new = load_rows(new_path, key=args.key)
     except OSError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
     if not old or not new:
         print(f"error: no keyed rows in "
-              f"{args.old if not old else args.new}", file=sys.stderr)
+              f"{old_path if not old else new_path}", file=sys.stderr)
         return 1
     c = compare(old, new, threshold=args.threshold)
     if not c["shared_rows"]:
@@ -202,7 +259,11 @@ def main(argv=None) -> int:
         print(json.dumps(c, indent=1))
     else:
         print_compare(c)
-    return 2 if c["regressions"] else 0
+    if c["regressions"]:
+        return 2
+    if c["gated_drops"]:
+        return 3
+    return 0
 
 
 if __name__ == "__main__":

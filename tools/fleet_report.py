@@ -24,18 +24,18 @@ import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from telemetry_report import (_fmt, add_format_flags,  # noqa: E402
-                              checkpoint_lines,
-                              checkpoint_summary, controller_entries,
-                              controller_lines, controller_summary,
-                              emit_output, goodput_lines, hang_entries,
-                              hang_lines, load_events, memory_lines,
-                              memory_summary, observability_lines,
-                              observability_summary, percentile,
-                              recovery_lines, recovery_summary,
-                              serve_fleet_lines, serve_fleet_summary,
-                              split_latest_run, straggler_entries,
-                              straggler_lines)
+from report_sections import (_fmt, add_format_flags,  # noqa: E402
+                             checkpoint_lines,
+                             checkpoint_summary, controller_entries,
+                             controller_lines, controller_summary,
+                             emit_output, goodput_lines, hang_entries,
+                             hang_lines, load_events, memory_lines,
+                             memory_summary, observability_lines,
+                             observability_summary, percentile,
+                             recovery_lines, recovery_summary,
+                             serve_fleet_lines, serve_fleet_summary,
+                             split_latest_run, straggler_entries,
+                             straggler_lines)
 
 from mobilefinetuner_tpu.core.telemetry import (controller_path,  # noqa: E402
                                                 partial_goodput)
@@ -253,15 +253,20 @@ def print_fleet(s: dict):
 
 
 def main(argv=None) -> int:
+    from report_sections import add_registry_flags, resolve_stream
     ap = argparse.ArgumentParser()
-    ap.add_argument("jsonl", help="coordinator stream (--telemetry_out "
-                                  "base path; .host<k> shards are "
-                                  "discovered next to it)")
+    ap.add_argument("jsonl", nargs="?", default="",
+                    help="coordinator stream (--telemetry_out "
+                         "base path; .host<k> shards are "
+                         "discovered next to it); or use --run to "
+                         "resolve it from the run registry")
     add_format_flags(ap)
+    add_registry_flags(ap)
     args = ap.parse_args(argv)
-    paths = discover_shards(args.jsonl)
+    base = resolve_stream(args)
+    paths = discover_shards(base)
     if not paths:
-        print(f"error: no telemetry shards at {args.jsonl}",
+        print(f"error: no telemetry shards at {base}",
               file=sys.stderr)
         return 1
     shards = {}
@@ -276,7 +281,7 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 1
     controller = None
-    cpath = controller_path(args.jsonl)
+    cpath = controller_path(base)
     if os.path.exists(cpath):
         try:
             controller, _ = load_events(cpath)

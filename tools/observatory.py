@@ -1,0 +1,511 @@
+"""Longitudinal regression observatory (round 23, DESIGN.md §28).
+
+Every bench/e2e/eval artifact this repo has ever committed is a point
+on some metric's timeline — but until now each round's numbers were
+compared only against the immediately previous artifact (bench_compare,
+two files at a time). This tool ingests ALL of them — BENCH_*/E2E_*/
+MMLU_*/MULTICHIP_*/MULTIHOST_*/TORCH_WALLCLOCK_*/ENERGY_* JSONs, serve
+artifacts, telemetry JSONL streams, and the run registry
+(core/run_registry.py) — into one normalized metrics store
+(platform x config x metric x run), then runs a NOISE-AWARE regression
+sentinel over each series:
+
+  direction   inferred per metric name (bench_compare conventions:
+              tok_s-ish higher-better, _ms/_mb-ish lower-better,
+              everything else informational — trended, never gated);
+  band        rolling median + MAD over the series' PRIOR points
+              (robust: one historical outlier cannot shift the center
+              the way a mean would), with a relative floor when MAD~0
+              so a flat history does not make the band infinitely
+              tight;
+  z           signed so POSITIVE is worse: (latest - median)/(1.4826
+              * MAD) times -direction;
+  platform    split into the series key, so a CPU schema-pin artifact
+              (synthetic harness proofs, BENCH_SERVE CPU rows) never
+              gates a TPU perf series and vice versa.
+
+Only the LATEST point of a series can regress — history is context,
+not a defendant. A regression needs z > --z AND a worse-percent floor
+(--pct_floor) AND at least --min_n prior points: all three, or the
+verdict is "ok" (an under-observed series cannot gate).
+
+Outputs: a markdown trend report (per-metric sparkline table, shared
+renderer in tools/report_sections.py), a machine-readable JSON verdict,
+`trend` events through the telemetry stream (--telemetry_out) which
+feed the live mft_trend_* gauges (core/metrics_http.py,
+--metrics_port), and exit code 2 naming run+metric when the sentinel
+fires.
+
+Usage:
+  python tools/observatory.py --backfill                # committed history
+  python tools/observatory.py --backfill --report TREND.md --json
+  python tools/observatory.py --backfill EXTRA.json --z 4
+  python tools/observatory.py --selfcheck               # tier-1: every
+      committed artifact must ingest and every trend event must
+      validate against EVENT_SCHEMA
+Exit codes: 0 = ok, 1 = load/usage error, 2 = regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+from bench_compare import _flatten, direction, load_rows  # noqa: E402
+from report_sections import trend_lines  # noqa: E402
+
+from mobilefinetuner_tpu.core.telemetry import validate_event  # noqa: E402
+
+#: committed-artifact globs the backfill sweep ingests (repo root).
+#: BASELINE.json is metadata prose, not a measurement — excluded.
+BACKFILL_GLOBS = ("BENCH_*.json", "E2E_*.json", "MMLU_*.json",
+                  "MULTICHIP_*.json", "MULTIHOST_*.json",
+                  "TORCH_WALLCLOCK_*.json", "ENERGY_*.json")
+
+#: MAD-to-sigma scale for a normal distribution
+MAD_SCALE = 1.4826
+
+#: timeline slots for artifacts with no `_rNN` round in the name:
+#: HEAD_ORDER = the current working tree's own captures (BENCH_SUITE),
+#: CANDIDATE_ORDER = explicitly-passed artifacts and registry runs —
+#: the run under test, judged against everything before it.
+HEAD_ORDER = 1 << 30
+CANDIDATE_ORDER = 1 << 31
+
+_ROUND_RE = re.compile(r"_r(\d+)\b")
+
+
+def round_of(name: str):
+    """Round ordinal from an artifact filename (`_r(\\d+)`), or None —
+    un-numbered artifacts (BENCH_SUITE.json, registry runs) order
+    AFTER every numbered round: they are the current head."""
+    m = _ROUND_RE.search(os.path.basename(name))
+    return int(m.group(1)) if m else None
+
+
+def run_label(path: str):
+    """Short run name for the trend table: rNN when the filename
+    carries a round, else the file stem."""
+    r = round_of(path)
+    if r is not None:
+        return f"r{r:02d}"
+    return os.path.splitext(os.path.basename(path))[0]
+
+
+def platform_of(data: dict) -> str:
+    """Artifact platform for the series split. Explicit `device` /
+    `device_kind` / `platform` fields win; a `synthetic: true` artifact
+    is a CPU harness proof (that is what synthetic means in this repo —
+    eval_mmlu --synthetic provenance, round 3 verdict); else unknown.
+    CPU schema pins must never gate TPU perf series."""
+    for key in ("device", "device_kind", "platform"):
+        v = data.get(key)
+        if isinstance(v, str) and v:
+            v = v.lower()
+            if "tpu" in v or re.search(r"\bv[2-6][ep]?\b", v):
+                return "tpu"
+            if "cpu" in v or "x86" in v or "arm" in v:
+                return "cpu"
+            return v
+    if data.get("synthetic") is True:
+        return "cpu"
+    return "unknown"
+
+
+def config_of(path: str) -> str:
+    """Fallback config key for flat (row-less) artifacts: the filename
+    stem minus the round suffix, lowercased — E2E_PPL_GEMMA_r03.json
+    and _r05.json must land in the SAME series."""
+    stem = os.path.splitext(os.path.basename(path))[0]
+    return _ROUND_RE.sub("", stem).lower()
+
+
+def _telemetry_points(path: str) -> list:
+    """(config, platform, {metric: value}) rows from a telemetry JSONL
+    stream: the run_end wall_s, the last step flush's throughput
+    numbers, and any registry `run` records' wall_s — the stream
+    becomes trendable without re-running anything."""
+    from report_sections import load_events
+    events, _bad = load_events(path)
+    if not events:
+        return []
+    manifest = next((e for e in events if e.get("event") == "run_start"),
+                    {})
+    kind = str(manifest.get("device_kind", "")).lower()
+    platform = "tpu" if "tpu" in kind else ("cpu" if kind else "unknown")
+    cfg = config_of(path)
+    metrics = {}
+    for e in events:
+        if e.get("event") == "step":
+            for k in ("tok_s", "step_time_ms", "mfu"):
+                if isinstance(e.get(k), (int, float)) \
+                        and not isinstance(e.get(k), bool):
+                    metrics[k] = float(e[k])
+        elif e.get("event") == "run_end":
+            if isinstance(e.get("wall_s"), (int, float)):
+                metrics["wall_s"] = float(e["wall_s"])
+    return [(cfg, platform, metrics)] if metrics else []
+
+
+def ingest_file(path: str, order: int = None) -> list:
+    """Normalized store rows from one artifact:
+    {source, run, round, order, platform, config, metric, value}.
+    Every artifact shape this repo produces loads — config-keyed rows
+    via bench_compare.load_rows, flat report dicts as a single
+    filename-keyed row, telemetry JSONL via the event reader.
+    `order` places the artifact on the timeline explicitly (the
+    candidate-run slot, AFTER all committed history); default is the
+    filename round, un-numbered artifacts right after the last
+    round (the current head)."""
+    out = []
+    rnd = round_of(path)
+    run = run_label(path)
+    if order is None:
+        order = rnd if rnd is not None else HEAD_ORDER
+
+    def add(cfg, platform, metrics):
+        for metric, value in sorted(metrics.items()):
+            out.append({"source": path, "run": run, "round": rnd,
+                        "order": order, "platform": platform,
+                        "config": cfg, "metric": metric,
+                        "value": value})
+
+    if path.endswith(".jsonl"):
+        for cfg, platform, metrics in _telemetry_points(path):
+            add(cfg, platform, metrics)
+        return out
+    with open(path) as f:
+        txt = f.read()
+    try:
+        data = json.loads(txt)
+    except json.JSONDecodeError:
+        data = None
+    platform = platform_of(data) if isinstance(data, dict) else "unknown"
+    rows = load_rows(path)
+    if rows:
+        for cfg, metrics in sorted(rows.items()):
+            add(cfg, platform, metrics)
+    elif isinstance(data, dict):
+        flat = _flatten(data)
+        if flat:
+            add(config_of(path), platform, flat)
+    return out
+
+
+def ingest_registry(reg) -> list:
+    """Store rows from the run registry: each finalized record's wall_s
+    becomes a trendable metric keyed by (kind, tool, fingerprint), and
+    each record's on-disk artifacts are ingested under its run_id."""
+    out = []
+    for rec in reg.records():
+        cfg = f"{rec.get('kind', '?')}_{rec.get('tool', '?')}"
+        if rec.get("config_fingerprint"):
+            cfg += "_" + rec["config_fingerprint"]
+        if isinstance(rec.get("wall_s"), (int, float)):
+            out.append({"source": reg.path, "run": rec["run_id"],
+                        "round": None, "order": CANDIDATE_ORDER,
+                        "platform": rec.get("platform") or "unknown",
+                        "config": cfg, "metric": "wall_s",
+                        "value": float(rec["wall_s"])})
+        for art in rec.get("artifacts") or []:
+            if os.path.exists(art):
+                for row in ingest_file(art, order=CANDIDATE_ORDER):
+                    row["run"] = rec["run_id"]
+                    out.append(row)
+    return out
+
+
+def build_series(store: list) -> list:
+    """Fold store rows into per-(platform, config, metric) series,
+    ordered by round (None = head, last) then source name. One value
+    per run: a re-captured run overwrites its earlier point (the
+    registry may ingest the same artifact bench_compare already
+    swept)."""
+    groups = {}
+    for row in store:
+        key = (row["platform"], row["config"], row["metric"])
+        groups.setdefault(key, {})[(
+            row["order"], row["run"], row["source"])] = row["value"]
+    series = []
+    for (platform, cfg, metric), pts in sorted(groups.items()):
+        ordered = sorted(pts.items())
+        series.append({
+            "platform": platform, "config": cfg, "metric": metric,
+            "runs": [k[1] for k, _v in ordered],
+            "values": [v for _k, v in ordered],
+        })
+    return series
+
+
+def sentinel(series: list, z_threshold: float = 4.0, min_n: int = 4,
+             rel_floor: float = 0.05, pct_floor: float = 10.0) -> list:
+    """Noise-aware verdict per series, judging only the LATEST point.
+    The band is median + MAD over the PRIOR points; the scale gets a
+    relative floor (rel_floor * |median|) so a flat history cannot
+    make any nonzero delta look like infinite sigmas. Regression needs
+    direction-awareness, n >= min_n prior points, z > z_threshold AND
+    worse_pct > pct_floor."""
+    out = []
+    for s in series:
+        vals = s["values"]
+        prior, latest = vals[:-1], vals[-1]
+        d = direction(s["metric"])
+        n = len(prior)
+        verdict = dict(s)
+        verdict.update({
+            "n": len(vals), "value": latest,
+            "direction": {1: "higher", -1: "lower", 0: None}[d],
+            "median": None, "mad": None, "z": None, "regressed": False,
+        })
+        if prior:
+            med = sorted(prior)[len(prior) // 2]
+            mad = sorted(abs(v - med) for v in prior)[len(prior) // 2]
+            scale = max(MAD_SCALE * mad, rel_floor * abs(med), 1e-12)
+            z_raw = (latest - med) / scale
+            worse_z = -z_raw * d
+            worse_pct = (-(latest - med) * d / abs(med) * 100.0
+                         if med else 0.0)
+            verdict["median"] = med
+            verdict["mad"] = mad
+            verdict["z"] = round(worse_z if d else abs(z_raw), 3)
+            verdict["regressed"] = bool(
+                d and n >= min_n and worse_z > z_threshold
+                and worse_pct > pct_floor)
+        out.append(verdict)
+    return out
+
+
+def trend_events(verdicts: list) -> list:
+    """`trend` event payloads (EVENT_SCHEMA) from sentinel verdicts —
+    what rides --telemetry_out and feeds the mft_trend_* gauges."""
+    events = []
+    for v in verdicts:
+        events.append({
+            "metric": v["metric"], "config": v["config"],
+            "platform": v["platform"], "value": v["value"],
+            "median": v["median"], "mad": v["mad"], "z": v["z"],
+            "direction": v["direction"], "regressed": v["regressed"],
+            "run": v["runs"][-1] if v["runs"] else "?", "n": v["n"],
+        })
+    return events
+
+
+def render_report(verdicts: list, store: list) -> list:
+    """Markdown trend report lines: coverage header, the shared
+    sparkline table, and a named line per regression."""
+    rounds = sorted({r["round"] for r in store if r["round"] is not None})
+    runs = sorted({r["run"] for r in store})
+    span = (f"r{rounds[0]:02d}->r{rounds[-1]:02d}" if rounds else "head")
+    lines = [
+        "# Longitudinal trend report",
+        "",
+        f"{len(store)} points, {len(verdicts)} series, "
+        f"{len(runs)} runs, rounds {span} "
+        f"(+{len([r for r in runs if not r.startswith('r')])} head/"
+        f"registry runs)",
+        "",
+    ]
+    lines += trend_lines(verdicts)
+    regressions = [v for v in verdicts if v["regressed"]]
+    lines.append("")
+    if regressions:
+        lines.append(f"## {len(regressions)} REGRESSION(S)")
+        for v in regressions:
+            lines.append(
+                f"- run {v['runs'][-1]} [{v['platform']}] "
+                f"{v['config']}.{v['metric']}: {v['value']:g} vs "
+                f"median {v['median']:g} (z={v['z']:g}, "
+                f"{v['direction']}-better)")
+    else:
+        lines.append("no regressions: every gated series is inside "
+                     "its noise band")
+    return lines
+
+
+def selfcheck(root: str) -> int:
+    """Tier-1 schema pin: every committed artifact must ingest without
+    error and yield points, and every trend event the sentinel would
+    emit must validate against EVENT_SCHEMA. Returns the number of
+    problems (0 = pass)."""
+    problems = 0
+    store = []
+    for pat in BACKFILL_GLOBS:
+        for path in sorted(glob.glob(os.path.join(root, pat))):
+            try:
+                rows = ingest_file(path)
+            except Exception as e:
+                print(f"SELFCHECK FAIL {path}: {type(e).__name__}: {e}")
+                problems += 1
+                continue
+            if not rows:
+                print(f"SELFCHECK FAIL {path}: no numeric points "
+                      f"ingested")
+                problems += 1
+            store.extend(rows)
+    verdicts = sentinel(build_series(store))
+    for ev in trend_events(verdicts):
+        # envelope keys (seq/t) are stamped by Telemetry.emit; supply
+        # a minimal envelope so the payload contract is what's checked
+        err = validate_event({"event": "trend", "seq": 0, "t": 0.0,
+                              **ev})
+        if err:
+            print(f"SELFCHECK FAIL trend event {ev['config']}."
+                  f"{ev['metric']}: {err}")
+            problems += 1
+    if not problems:
+        print(f"selfcheck ok: {len(store)} points, "
+              f"{len(verdicts)} series, every trend event "
+              f"schema-valid")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="run registry + longitudinal regression sentinel")
+    ap.add_argument("paths", nargs="*",
+                    help="extra artifacts to ingest (any repo shape; "
+                         ".jsonl = telemetry stream)")
+    ap.add_argument("--backfill", action="store_true",
+                    help="sweep --root for every committed artifact "
+                         "(BENCH_*/E2E_*/MMLU_*/... ) so history "
+                         "starts at r01")
+    ap.add_argument("--root", default=".",
+                    help="backfill sweep root (default: .)")
+    ap.add_argument("--registry", default="",
+                    help="run registry stream to ingest (core/"
+                         "run_registry.py); default $MFT_RUN_REGISTRY")
+    ap.add_argument("--store", default="",
+                    help="write the normalized metrics store (JSONL, "
+                         "one point per line) here")
+    ap.add_argument("--report", default="",
+                    help="write the markdown trend report here "
+                         "(default: stdout)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable verdict instead "
+                         "of the markdown report")
+    ap.add_argument("--z", type=float, default=4.0,
+                    help="robust-z gate threshold (default 4)")
+    ap.add_argument("--min_n", type=int, default=4,
+                    help="minimum PRIOR points before a series can "
+                         "gate (default 4)")
+    ap.add_argument("--rel_floor", type=float, default=0.05,
+                    help="noise-scale floor as a fraction of |median| "
+                         "(default 0.05)")
+    ap.add_argument("--pct_floor", type=float, default=10.0,
+                    help="minimum worse-percent for a regression "
+                         "(default 10)")
+    ap.add_argument("--telemetry_out", default="",
+                    help="emit one `trend` event per series into this "
+                         "telemetry stream (core/telemetry.py)")
+    ap.add_argument("--metrics_port", type=int, default=0,
+                    help="serve mft_trend_* gauges on this OpenMetrics "
+                         "port after the sweep (core/metrics_http.py); "
+                         "0 = off")
+    ap.add_argument("--metrics_addr", default="127.0.0.1")
+    ap.add_argument("--selfcheck", action="store_true",
+                    help="tier-1 pin: ingest every committed artifact, "
+                         "schema-validate every trend event; exit "
+                         "nonzero on any problem")
+    args = ap.parse_args(argv)
+
+    if args.selfcheck:
+        return 1 if selfcheck(args.root) else 0
+
+    store = []
+    if args.backfill:
+        for pat in BACKFILL_GLOBS:
+            for path in sorted(glob.glob(os.path.join(args.root, pat))):
+                try:
+                    store.extend(ingest_file(path))
+                except Exception as e:
+                    print(f"error: {path}: {type(e).__name__}: {e}",
+                          file=sys.stderr)
+                    return 1
+    for path in args.paths:
+        try:
+            # explicit paths are the candidate run: latest on every
+            # series they touch, judged against committed history
+            store.extend(ingest_file(path, order=CANDIDATE_ORDER))
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    from mobilefinetuner_tpu.core.run_registry import registry_from
+    reg = registry_from(args.registry)
+    if reg is not None and os.path.exists(reg.path):
+        store.extend(ingest_registry(reg))
+    if not store:
+        print("error: nothing ingested (pass --backfill, --registry, "
+              "or artifact paths)", file=sys.stderr)
+        return 1
+
+    if args.store:
+        tmp = args.store + ".tmp"
+        with open(tmp, "w") as f:
+            for row in store:
+                f.write(json.dumps(row) + "\n")
+        os.replace(tmp, args.store)
+
+    verdicts = sentinel(build_series(store), z_threshold=args.z,
+                        min_n=args.min_n, rel_floor=args.rel_floor,
+                        pct_floor=args.pct_floor)
+    regressions = [v for v in verdicts if v["regressed"]]
+    events = trend_events(verdicts)
+
+    if args.telemetry_out:
+        from mobilefinetuner_tpu.core.telemetry import Telemetry
+        with Telemetry(args.telemetry_out) as tel:
+            for ev in events:
+                tel.emit("trend", **ev)
+
+    report = render_report(verdicts, store)
+    if args.report:
+        tmp = args.report + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("\n".join(report) + "\n")
+        os.replace(tmp, args.report)
+    if args.json:
+        print(json.dumps({
+            "points": len(store), "series": len(verdicts),
+            "threshold_z": args.z, "verdicts": verdicts,
+            "regressions": [
+                {"run": v["runs"][-1], "platform": v["platform"],
+                 "config": v["config"], "metric": v["metric"],
+                 "value": v["value"], "median": v["median"],
+                 "z": v["z"]} for v in regressions],
+        }, indent=1))
+    elif not args.report:
+        print("\n".join(report))
+    else:
+        for v in regressions:
+            print(f"REGRESSION: run {v['runs'][-1]} "
+                  f"{v['config']}.{v['metric']} z={v['z']:g}")
+
+    if args.metrics_port:
+        from mobilefinetuner_tpu.core.metrics_http import (MetricsRegistry,
+                                                           MetricsServer)
+        mreg = MetricsRegistry()
+        for ev in events:
+            mreg.observe({"event": "trend", **ev})
+        server = MetricsServer(mreg, port=args.metrics_port,
+                               addr=args.metrics_addr)
+        print(f"serving mft_trend_* on "
+              f"http://{args.metrics_addr}:{server.port}/metrics "
+              f"(ctrl-c to stop)")
+        try:
+            import time
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+    return 2 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

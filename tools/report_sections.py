@@ -1,0 +1,843 @@
+"""Shared report-section builders (round 23, DESIGN.md §28).
+
+One home for every section builder BOTH report tools render —
+telemetry_report.py (single stream) and fleet_report.py (merged
+multi-host shards) import from here, so a percentile convention or a
+section's line format can never drift between them. Round 23 adds the
+longitudinal trend section (sparkline + regression table) that
+tools/observatory.py renders over the run registry's metric history.
+
+Nothing here imports jax: these are pure JSONL-in, lines-out
+formatters, safe for CI boxes with no accelerator runtime.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from mobilefinetuner_tpu.core.telemetry import validate_event  # noqa: E402
+
+
+def percentile(sorted_vals, q):
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return None
+    i = min(int(q / 100.0 * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def load_events(path):
+    """(events, n_invalid): valid events in file order."""
+    events, bad = [], 0
+    with open(path, "rb") as f:
+        for raw in f:
+            if not raw.strip():
+                continue
+            try:
+                rec = json.loads(raw)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                bad += 1
+                continue
+            if validate_event(rec) is None:
+                events.append(rec)
+            else:
+                bad += 1
+    return events, bad
+
+
+def split_latest_run(events):
+    """(truncated, latest_run_events): a resumed stream appends runs, so
+    'is there any run_end' is the wrong truncation test — run 1 may have
+    ended cleanly while the appended run 2 was SIGKILLed. The post-mortem
+    subject is the LATEST run: truncated iff its run_start has no
+    following run_end; the returned slice is that run's events (the whole
+    stream when nothing is truncated)."""
+    idx_start = max((i for i, e in enumerate(events)
+                     if e.get("event") == "run_start"), default=-1)
+    idx_end = max((i for i, e in enumerate(events)
+                   if e.get("event") == "run_end"), default=-1)
+    truncated = idx_start > idx_end
+    return truncated, (events[idx_start:]
+                       if truncated and idx_start >= 0 else events)
+
+
+def _fmt(v, nd=2):
+    return "-" if v is None else f"{v:.{nd}f}"
+
+
+def checkpoint_summary(events) -> dict:
+    """Roll up `checkpoint`/`ckpt_dropped` events with the round-10
+    snapshot/write split (io/async_ckpt.py): blocking_s is what the step
+    loop actually stalled (wall_s — snapshot only under --async_save),
+    write_s/bytes/mb_s the background write cost that overlapped compute,
+    dropped the snapshots coalesced away by the depth-1 writer queue.
+    ONE builder shared with tools/fleet_report.py. Pre-async streams
+    (step/final/wall_s only) still summarize: the split fields are
+    optional on read."""
+    cks = [e for e in events if e.get("event") == "checkpoint"]
+    mbs = [c["mb_s"] for c in cks if c.get("mb_s")]
+    return {
+        "count": len(cks),
+        "async": sum(1 for c in cks if c.get("async")),
+        "blocking_s": round(sum(c["wall_s"] for c in cks), 4),
+        "write_s": round(sum(c.get("write_ms") or 0.0
+                             for c in cks) / 1000.0, 4),
+        "bytes": sum(c.get("bytes") or 0 for c in cks),
+        "mb_s_mean": (round(sum(mbs) / len(mbs), 2) if mbs else None),
+        "dropped": sum(1 for e in events
+                       if e.get("event") == "ckpt_dropped"),
+    }
+
+
+def checkpoint_lines(ck) -> list:
+    """Render a checkpoint_summary dict (shared with fleet_report)."""
+    if not ck or not (ck["count"] or ck["dropped"]):
+        return []
+    line = (f"  checkpoints: {ck['count']} ({ck['async']} async), "
+            f"blocking {ck['blocking_s']:.2f}s")
+    if ck["write_s"]:
+        line += (f", background write {ck['write_s']:.2f}s"
+                 + (f" ({ck['bytes'] / 2**20:.1f} MB"
+                    + (f" @ {ck['mb_s_mean']:.1f} MB/s" if ck["mb_s_mean"]
+                       else "") + ")" if ck["bytes"] else ""))
+    if ck["dropped"]:
+        line += f", {ck['dropped']} snapshot(s) coalesced away"
+    return [line]
+
+
+def recovery_summary(events) -> dict:
+    """Roll up the round-15 numerical-fault recovery events (DESIGN.md
+    §20): skipped-update count (sum of step_stats.skipped — the
+    in-jit guard's identity steps), every `rollback` decision with its
+    steps-lost recovery cost, and the `ckpt_verify` verdicts (failures
+    listed with the mismatch reason). None when the stream carries
+    none of the three — ONE builder shared with tools/fleet_report.py
+    like the checkpoint/straggler/hang entries."""
+    stats = [e for e in events if e.get("event") == "step_stats"]
+    skipped = sum(e.get("skipped") or 0 for e in stats)
+    rollbacks = [{"step": e["step"], "reason": e["reason"],
+                  "ok": e["ok"], "to_step": e.get("to_step"),
+                  "steps_lost": e.get("steps_lost"),
+                  "ckpt": e.get("ckpt"),
+                  "budget_left": e.get("budget_left")}
+                 for e in events if e.get("event") == "rollback"]
+    verifies = [e for e in events if e.get("event") == "ckpt_verify"]
+    failures = [{"path": e["path"], "reason": e.get("reason"),
+                 "step": e.get("step")}
+                for e in verifies if not e.get("ok")]
+    if not (skipped or rollbacks or verifies):
+        return None
+    return {
+        "skipped_steps": skipped,
+        "rollbacks": rollbacks,
+        "steps_lost": sum(r["steps_lost"] or 0 for r in rollbacks
+                          if r["ok"]),
+        "ckpt_verified": sum(1 for e in verifies if e.get("ok")),
+        "ckpt_verify_failures": failures,
+    }
+
+
+def recovery_lines(r) -> list:
+    """Render a recovery_summary (shared with fleet_report)."""
+    if not r:
+        return []
+    lines = [f"  recovery: {r['skipped_steps']} skipped update(s), "
+             f"{sum(1 for x in r['rollbacks'] if x['ok'])} rollback(s) "
+             f"({r['steps_lost']} step(s) lost), "
+             f"{r['ckpt_verified']} ckpt verification(s), "
+             f"{len(r['ckpt_verify_failures'])} failure(s)"]
+    for x in r["rollbacks"]:
+        if x["ok"]:
+            lines.append(
+                f"    ROLLBACK ({x['reason']}) @ step {x['step']} -> "
+                f"{x['to_step']} ({x['steps_lost']} lost, budget left "
+                f"{x['budget_left']})")
+        else:
+            lines.append(
+                f"    ROLLBACK WANTED ({x['reason']}) @ step "
+                f"{x['step']} but not possible (no verified "
+                f"checkpoint / budget exhausted)")
+    for f in r["ckpt_verify_failures"]:
+        lines.append(f"    CKPT REJECTED: {f['path']} ({f['reason']})")
+    return lines
+
+
+def memory_summary(events) -> dict:
+    """Roll up the round-16 memory-admission events (DESIGN.md §21):
+    every `mem_check` verdict (est vs cap, the cap_frac headroom
+    number) and every `degrade` ladder decision. None when the stream
+    carries neither — ONE builder shared with tools/fleet_report.py
+    like the checkpoint/recovery sections."""
+    checks = [e for e in events if e.get("event") == "mem_check"]
+    degrades = [e for e in events if e.get("event") == "degrade"]
+    if not (checks or degrades):
+        return None
+    last = checks[-1] if checks else None
+    row = lambda c: {"phase": c.get("phase"), "est_mb": c.get("est_mb"),
+                     "cap_mb": c.get("cap_mb"), "verdict": c["verdict"],
+                     "cap_frac": c.get("cap_frac")}
+    return {
+        "checks": [row(c) for c in checks],
+        "final": row(last) if last else None,
+        "over": sum(1 for c in checks if c["verdict"] == "over"),
+        "degrades": [{"step": d.get("step"), "rung": d["rung"],
+                      "from": d.get("from"), "to": d.get("to"),
+                      "est_mb": d.get("est_mb")} for d in degrades],
+    }
+
+
+def memory_lines(m) -> list:
+    """Render a memory_summary (shared with fleet_report)."""
+    if not m:
+        return []
+    bits = []
+    f = m["final"]
+    if f:
+        bits.append(f"est {_fmt(f['est_mb'], 0)} MB vs cap "
+                    f"{_fmt(f['cap_mb'], 0)} MB"
+                    + (f" ({100 * f['cap_frac']:.0f}% of cap)"
+                       if f.get("cap_frac") else "")
+                    + f", verdict {f['verdict']}")
+    if m["over"]:
+        bits.append(f"{m['over']} over-capacity check(s)")
+    if m["degrades"]:
+        bits.append(f"{len(m['degrades'])} ladder rung(s)")
+    lines = ["  memory: " + "; ".join(bits)]
+    for d in m["degrades"]:
+        lines.append(
+            f"    DEGRADE {d['rung']}: {d['from']} -> {d['to']}"
+            + (f" (est {d['est_mb']:.0f} MB over)"
+               if d.get("est_mb") else "")
+            + (f" @ step {d['step']}" if d.get("step") is not None
+               else " @ preflight"))
+    return lines
+
+
+def observability_summary(events) -> dict:
+    """Roll up the round-17 live-observability events (DESIGN.md §22):
+    span count by track (the timeline's shape at a glance — the spans
+    themselves belong in tools/trace_export.py, not a text report) and
+    every anomaly-triggered `profile_capture` with its trigger and
+    on-disk path. None when the stream carries neither — ONE builder
+    shared with tools/fleet_report.py like the other sections."""
+    spans = [e for e in events if e.get("event") == "span"]
+    caps = [e for e in events if e.get("event") == "profile_capture"]
+    if not (spans or caps):
+        return None
+    by_track = {}
+    for s in spans:
+        by_track[s["track"]] = by_track.get(s["track"], 0) + 1
+    return {
+        "spans": len(spans),
+        "span_tracks": by_track,
+        "profile_captures": [{"step": c["step"],
+                              "trigger": c["trigger"],
+                              "path": c["path"],
+                              "budget_left": c.get("budget_left")}
+                             for c in caps],
+    }
+
+
+def observability_lines(o) -> list:
+    """Render an observability_summary (shared with fleet_report)."""
+    if not o:
+        return []
+    lines = []
+    if o["spans"]:
+        tracks = ", ".join(f"{k} {v}" for k, v in
+                           sorted(o["span_tracks"].items())[:6])
+        more = len(o["span_tracks"]) - 6
+        lines.append(f"  spans: {o['spans']} across "
+                     f"{len(o['span_tracks'])} track(s) ({tracks}"
+                     + (f", +{more} more" if more > 0 else "") + ")"
+                     + " — export with tools/trace_export.py")
+    for c in o["profile_captures"]:
+        lines.append(f"  PROFILE CAPTURED @ step {c['step']} "
+                     f"({c['trigger']}): {c['path']} "
+                     f"(budget left {c['budget_left']})")
+    return lines
+
+
+def tenant_summary(events) -> dict:
+    """Per-tenant roll-up for the multi-tenant training engine
+    (multitenant/engine.py, DESIGN.md §23): one row per adapter job from
+    its `tenant` lifecycle events plus the LAST step_stats `tenants`
+    section — steps reached vs budget, final loss, cumulative tokens,
+    host-wait attribution, lifecycle outcome, and the saved artifact.
+    None when the stream carries no multi-tenant traffic."""
+    tev = [e for e in events if e.get("event") == "tenant"]
+    stats = [e for e in events if e.get("event") == "step_stats"
+             and e.get("tenants")]
+    if not tev and not stats:
+        return None
+    rows: dict = {}
+    for e in tev:
+        r = rows.setdefault(e["name"], {"name": e["name"]})
+        r["status"] = e["phase"]
+        r["slot"] = e["slot"]
+        r["step"] = e["step"]
+        r["job_steps"] = e.get("job_steps")
+        if e.get("loss") is not None:
+            r["loss"] = e["loss"]
+        if e.get("tokens") is not None:
+            r["tokens"] = e["tokens"]
+        if e.get("phase") in ("save", "finish") and e.get("path"):
+            r["path"] = e["path"]
+    if stats:
+        for name, t in stats[-1]["tenants"].items():
+            r = rows.setdefault(name, {"name": name})
+            r.setdefault("status", "active")
+            for k in ("slot", "step", "loss", "tokens", "wait_ms"):
+                if t.get(k) is not None:
+                    r[k] = t[k]
+    order = {"finish": 0, "cancel": 1}
+    return {
+        "jobs": len(rows),
+        "finished": sum(1 for r in rows.values()
+                        if r.get("status") == "finish"),
+        "cancelled": sum(1 for r in rows.values()
+                         if r.get("status") == "cancel"),
+        "rows": sorted(rows.values(),
+                       key=lambda r: (order.get(r.get("status"), 2),
+                                      r["name"])),
+    }
+
+
+def tenant_lines(t) -> list:
+    if not t:
+        return []
+    lines = [f"  tenants: {t['jobs']} job(s), {t['finished']} finished"
+             + (f", {t['cancelled']} cancelled" if t["cancelled"]
+                else "")]
+    for r in t["rows"]:
+        budget = (f"/{r['job_steps']}" if r.get("job_steps") is not None
+                  else "")
+        bits = [f"    {r['name']}: {r.get('status', '?')} @ step "
+                f"{r.get('step', '?')}{budget}"]
+        if r.get("loss") is not None:
+            bits.append(f"loss {_fmt(r['loss'], 4)}")
+        if r.get("tokens") is not None:
+            bits.append(f"{r['tokens']} tok")
+        if r.get("wait_ms"):
+            bits.append(f"wait {_fmt(r['wait_ms'], 1)} ms")
+        if r.get("path"):
+            bits.append(f"-> {r['path']}")
+        lines.append(", ".join(bits))
+    return lines
+
+
+def request_summary(events) -> dict:
+    """Serving SLOs from the per-request `request` lifecycle events
+    (serve/engine.py): TTFT/TPOT percentiles over FINISHED requests,
+    sustained req/s over the stream's observed request span, and —
+    round 14 — the failure-mode counters and rates (reject / timeout /
+    error over submitted) a robustness policy is judged by. None when
+    the stream carries no serving traffic."""
+    reqs = [e for e in events if e.get("event") == "request"]
+    if not reqs:
+        return None
+    fins = [e for e in reqs if e.get("phase") == "finish"]
+    ttfts = sorted(e["ttft_ms"] for e in fins
+                   if e.get("ttft_ms") is not None)
+    tpots = sorted(e["tpot_ms"] for e in fins
+                   if e.get("tpot_ms") is not None)
+    pcts = lambda vals: {"p50": percentile(vals, 50),
+                         "p95": percentile(vals, 95),
+                         "p99": percentile(vals, 99)}
+    span = (max(e["t"] for e in reqs) - min(e["t"] for e in reqs)
+            if len(reqs) > 1 else 0.0)
+    gen = sum(e.get("new_tokens") or 0 for e in fins)
+    sub = sum(1 for e in reqs if e.get("phase") == "enqueue")
+    n_phase = lambda p: sum(1 for e in reqs if e.get("phase") == p)
+    rate = lambda n: round(n / sub, 4) if sub else None
+    rejected, timeouts, errors = (n_phase("reject"), n_phase("timeout"),
+                                  n_phase("error"))
+    reasons = {}
+    for e in reqs:
+        if e.get("phase") in ("reject", "timeout", "error") \
+                and e.get("reason"):
+            reasons[e["reason"]] = reasons.get(e["reason"], 0) + 1
+    return {
+        "submitted": sub,
+        "finished": len(fins),
+        "cancelled": n_phase("cancel"),
+        "rejected": rejected,
+        "timeout": timeouts,
+        "errors": errors,
+        "reject_rate": rate(rejected),
+        "timeout_rate": rate(timeouts),
+        "error_rate": rate(errors),
+        "fail_reasons": reasons,
+        "ttft_ms": pcts(ttfts),
+        "tpot_ms": pcts(tpots),
+        "req_s": round(len(fins) / span, 3) if span > 0 else None,
+        "gen_tok_s": round(gen / span, 1) if span > 0 else None,
+    }
+
+
+def request_lines(r) -> list:
+    if not r:
+        return []
+    tt, tp = r["ttft_ms"], r["tpot_ms"]
+    lines = [f"  requests: {r['finished']}/{r['submitted']} finished"
+             + (f", {r['cancelled']} cancelled" if r["cancelled"] else "")
+             + (f"; {r['req_s']:.2f} req/s"
+                if r["req_s"] is not None else "")
+             + (f", {r['gen_tok_s']:.0f} gen tok/s"
+                if r["gen_tok_s"] is not None else "")]
+    if tt["p50"] is not None:
+        lines.append(f"    TTFT p50/p95/p99 = {_fmt(tt['p50'], 1)}/"
+                     f"{_fmt(tt['p95'], 1)}/{_fmt(tt['p99'], 1)} ms")
+    if tp["p50"] is not None:
+        lines.append(f"    TPOT p50/p95/p99 = {_fmt(tp['p50'], 2)}/"
+                     f"{_fmt(tp['p95'], 2)}/{_fmt(tp['p99'], 2)} ms")
+    # pre-round-14 summaries (fleet_report fixtures) may lack the
+    # failure counters; render the line only when something failed
+    fails = [(k, r.get(k, 0), r.get(rk)) for k, rk in
+             (("rejected", "reject_rate"), ("timeout", "timeout_rate"),
+              ("errors", "error_rate"))]
+    if any(n for _, n, _ in fails):
+        pc = lambda v: f" ({100 * v:.1f}%)" if v else ""
+        bits = [f"{k} {n}{pc(rt)}" for k, n, rt in fails if n]
+        why = r.get("fail_reasons") or {}
+        if why:
+            bits.append("reasons: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(why.items())))
+        lines.append("    " + "; ".join(bits))
+    return lines
+
+
+def serve_stats_summary(events) -> dict:
+    """Roll up the cadenced `serve_stats` health snapshots
+    (serve/engine.py health()): queue-depth peak, occupancy mean,
+    free-page floor, latest rolling p95 step latency, and the final
+    cumulative terminal-state counters. None when the stream carries
+    none (pre-round-14 serve streams, training runs)."""
+    ss = [e for e in events if e.get("event") == "serve_stats"]
+    if not ss:
+        return None
+    last = ss[-1]
+    return {
+        "snapshots": len(ss),
+        "queue_depth_max": max(e["queue_depth"] for e in ss),
+        "queue_depth_last": last["queue_depth"],
+        "occupancy_mean": round(
+            sum(e["occupancy"] for e in ss) / len(ss), 4),
+        "free_blocks_min": min(e["free_blocks"] for e in ss),
+        "p95_step_ms_last": last["p95_step_ms"],
+        # round-20 mesh shape [dp, tp]; absent on pre-sharding streams
+        "mesh": last.get("mesh"),
+        # round-21 shared-prefix reuse: cumulative hit rate + COW count
+        # from the LAST snapshot; absent (None) on cache-off streams
+        "prefix_hit_rate": last.get("prefix_hit_rate"),
+        "cow_copies": last.get("cow_copies"),
+        "counts": {k: last.get(k, 0) for k in
+                   ("finished", "cancelled", "rejected", "timeout",
+                    "error")},
+    }
+
+
+def serve_stats_lines(s) -> list:
+    if not s:
+        return []
+    mesh = ""
+    if s.get("mesh"):
+        mesh = f", mesh {s['mesh'][0]}x{s['mesh'][1]}"
+    reuse = ""
+    if s.get("prefix_hit_rate") is not None:
+        reuse = (f", prefix hit_rate {s['prefix_hit_rate']:.2f} "
+                 f"({s.get('cow_copies') or 0} COW cop"
+                 f"{'y' if (s.get('cow_copies') or 0) == 1 else 'ies'})")
+    return [f"  serve health: {s['snapshots']} snapshot(s); queue max "
+            f"{s['queue_depth_max']} (last {s['queue_depth_last']}), "
+            f"occupancy mean {100 * s['occupancy_mean']:.0f}%, free "
+            f"pages min {s['free_blocks_min']}, p95 step "
+            f"{_fmt(s['p95_step_ms_last'], 1)} ms{mesh}{reuse}"]
+
+
+def route_summary(events) -> dict:
+    """Roll up the serve-router's `route` decision events (round 22,
+    tools/serve_router.py): decision histogram by policy and by placed
+    replica, reject count, distinct rids, and snapshot-staleness
+    percentiles (scrape_age_ms — how old the metrics behind each
+    decision were). None when the stream carries no routing traffic.
+    ONE builder shared with tools/fleet_report.py; serve_fleet_summary
+    wraps it with the cross-shard accounting."""
+    rs = [e for e in events if e.get("event") == "route"]
+    if not rs:
+        return None
+    by_policy, by_replica = {}, {}
+    for e in rs:
+        p = e.get("policy", "?")
+        by_policy[p] = by_policy.get(p, 0) + 1
+        if e.get("replica") is not None:
+            k = str(e["replica"])
+            by_replica[k] = by_replica.get(k, 0) + 1
+    ages = sorted(e["scrape_age_ms"] for e in rs
+                  if e.get("scrape_age_ms") is not None)
+    return {
+        "decisions": len(rs),
+        "rids": len({e["rid"] for e in rs}),
+        "by_policy": by_policy,
+        "by_replica": by_replica,
+        "rejects": by_policy.get("reject", 0),
+        "scrape_age_ms": {"p50": percentile(ages, 50),
+                          "p95": percentile(ages, 95),
+                          "max": ages[-1] if ages else None},
+    }
+
+
+def route_lines(r) -> list:
+    """Render a route_summary (shared with fleet_report)."""
+    if not r:
+        return []
+    pol = ", ".join(f"{k} {v}"
+                    for k, v in sorted(r["by_policy"].items()))
+    spread = ", ".join(f"r{k}:{v}"
+                       for k, v in sorted(r["by_replica"].items()))
+    a = r["scrape_age_ms"]
+    line = (f"  routing: {r['decisions']} decision(s) over "
+            f"{r['rids']} rid(s) ({pol}); spread {spread or 'none'}")
+    if a["p50"] is not None:
+        line += (f"; snapshot age p50/p95/max = {_fmt(a['p50'], 1)}/"
+                 f"{_fmt(a['p95'], 1)}/{_fmt(a['max'], 1)} ms")
+    return [line]
+
+
+def serve_fleet_summary(shards) -> dict:
+    """The serve-fleet section (round 22): {host: events} with the
+    router stream at host 0 and replica shards at host k. Router side:
+    route_summary plus EXACT rid accounting — every placed rid must
+    own at most one replica-side terminal (a duplicate means two
+    replicas both think they finished the same request; a rid with
+    none was settled router-side from the shard tail or the shutdown
+    fallback, which is how a killed replica's orphans are supposed to
+    land). Replica side: one row per shard via the SAME
+    request_summary/serve_stats_summary builders the single-engine
+    report renders. None when host 0 carries no route events (not a
+    router session)."""
+    routing = route_summary(shards.get(0, []))
+    if routing is None:
+        return None
+    placed = {e["rid"] for e in shards.get(0, [])
+              if e.get("event") == "route"
+              and isinstance(e.get("rid"), int)
+              and e.get("replica") is not None}
+    terminal: dict = {}
+    replicas = {}
+    for h, evs in sorted(shards.items()):
+        if h == 0:
+            continue
+        replicas[str(h)] = {
+            "requests": request_summary(evs),
+            "serve": serve_stats_summary(evs),
+        }
+        for e in evs:
+            if e.get("event") == "request" \
+                    and isinstance(e.get("rid"), int) \
+                    and e.get("phase") in ("finish", "cancel", "reject",
+                                           "timeout", "error"):
+                terminal[e["rid"]] = terminal.get(e["rid"], 0) + 1
+    settled = sum(1 for r in placed if terminal.get(r))
+    return {
+        "routing": routing,
+        "replicas": replicas,
+        "routed_rids": len(placed),
+        "replica_settled_rids": settled,
+        "router_settled_rids": len(placed) - settled,
+        "duplicate_terminals": sum(1 for r in placed
+                                   if terminal.get(r, 0) > 1),
+    }
+
+
+def serve_fleet_lines(f) -> list:
+    """Render a serve_fleet_summary (shared with fleet_report)."""
+    if not f:
+        return []
+    lines = route_lines(f["routing"])
+    lines.append(
+        f"  fleet accounting: {f['routed_rids']} placed, "
+        f"{f['replica_settled_rids']} replica-settled, "
+        f"{f['router_settled_rids']} router-settled"
+        + (f", {f['duplicate_terminals']} DUPLICATE terminal(s)"
+           if f["duplicate_terminals"] else ""))
+    for k, r in sorted(f["replicas"].items(), key=lambda kv: int(kv[0])):
+        req, sv = r["requests"], r["serve"]
+        if not req:
+            lines.append(f"    replica {k}: no request traffic")
+            continue
+        hit = ""
+        if sv and sv.get("prefix_hit_rate") is not None:
+            hit = f", prefix hit_rate {sv['prefix_hit_rate']:.2f}"
+        lines.append(
+            f"    replica {k}: {req['finished']}/{req['submitted']} "
+            f"finished, TTFT p99 {_fmt(req['ttft_ms']['p99'], 1)} ms, "
+            f"TPOT p50 {_fmt(req['tpot_ms']['p50'], 2)} ms{hit}")
+    return lines
+
+
+def controller_entries(events) -> list:
+    """Summary dicts for `controller` events (the fleet controller's
+    recovery timeline, tools/fleet_controller.py) — ONE builder shared
+    with tools/fleet_report.py like the straggler/hang entries."""
+    return [{"t": e["t"], "action": e["action"],
+             "worker": e.get("worker"), "reason": e.get("reason"),
+             "attempt": e.get("attempt"), "step": e.get("step"),
+             "recovery_s": e.get("recovery_s")}
+            for e in events if e.get("event") == "controller"]
+
+
+def latest_controller_session(entries) -> list:
+    """The controller stream appends across sessions (re-running with
+    the same --telemetry base resumes the file). Scope to the LATEST
+    session — the same rule the worker shards get from split_latest_run
+    — so a resumed fleet's recovery accounting describes THIS run, not
+    every run ever recorded. A session STARTS with a burst of `launch`
+    events, so the latest session begins at the last launch whose
+    predecessor is not itself a launch — robust even when an earlier
+    session died without its stop/give_up terminator (a SIGKILLed
+    controller writes no goodbye). Streams with no launch at all
+    (hand-built fixtures) fall back to terminator slicing."""
+    starts = [i for i, e in enumerate(entries)
+              if e["action"] == "launch"
+              and (i == 0 or entries[i - 1]["action"] != "launch")]
+    if starts:
+        return entries[starts[-1]:]
+    ends = [i for i, e in enumerate(entries)
+            if e["action"] in ("stop", "give_up")]
+    if not ends:
+        return entries
+    last = ends[-1]
+    if last == len(entries) - 1:  # closed session: back to the previous
+        prev = ends[-2] if len(ends) > 1 else -1
+        return entries[prev + 1:]
+    return entries[last + 1:]     # live session after the last closed one
+
+
+def controller_summary(entries) -> dict:
+    """Roll up the recovery timeline (scoped to the LATEST controller
+    session): restarts/shrinks/lost counts and the total recovery
+    wall-clock (down-observed -> relaunched, summed over restart+shrink
+    events) — the number that turns recovery cost into a visible line
+    next to the goodput buckets instead of a mystery gap in step reach.
+    None when no controller ran."""
+    if not entries:
+        return None
+    entries = latest_controller_session(entries)
+    return {
+        "events": len(entries),
+        "restarts": sum(1 for e in entries if e["action"] == "restart"),
+        "shrinks": sum(1 for e in entries if e["action"] == "shrink"),
+        "lost": sum(1 for e in entries if e["action"] == "lost"),
+        "drains": sum(1 for e in entries if e["action"] == "drain"),
+        "gave_up": any(e["action"] == "give_up" for e in entries),
+        "recovery_s": round(sum(e["recovery_s"] or 0.0 for e in entries
+                                if e["action"] in ("restart", "shrink")),
+                            3),
+        "entries": entries,
+    }
+
+
+def controller_lines(cs) -> list:
+    """Render a controller_summary (shared with fleet_report)."""
+    if not cs:
+        return []
+    head = (f"  controller: {cs['restarts']} restart(s), "
+            f"{cs['shrinks']} shrink(s), {cs['lost']} lost, "
+            f"recovery {cs['recovery_s']:.2f}s"
+            + (", GAVE UP" if cs["gave_up"] else "")
+            + (f", {cs['drains']} drain(s)" if cs["drains"] else ""))
+    lines = [head]
+    for e in cs["entries"]:
+        if e["action"] not in ("restart", "shrink", "lost", "give_up",
+                               "drain"):
+            continue
+        bits = [f"    {e['action'].upper()}"]
+        if e["worker"] is not None:
+            bits.append(f"worker {e['worker']}")
+        if e["reason"]:
+            bits.append(f"({e['reason']})")
+        if e["step"] is not None:
+            bits.append(f"@ step {e['step']}")
+        if e["attempt"] is not None:
+            bits.append(f"attempt {e['attempt']}")
+        if e["recovery_s"] is not None:
+            bits.append(f"recovered in {e['recovery_s']:.2f}s")
+        lines.append(" ".join(bits))
+    return lines
+
+
+def straggler_entries(events) -> list:
+    """Summary dicts for `straggler` events — ONE builder shared with
+    tools/fleet_report.py (same rule as goodput_lines)."""
+    return [{"step": e["step"], "slow_host": e["slow_host"],
+             "host_ms": e["host_ms"], "fleet_ms": e["fleet_ms"],
+             "ratio": e["ratio"]}
+            for e in events if e.get("event") == "straggler"]
+
+
+def hang_entries(events) -> list:
+    """Summary dicts for `hang` events (host = the WRITER's envelope
+    stamp: which process's watchdog fired)."""
+    return [{"host": e.get("host", 0), "step": e["step"],
+             "stall_s": e["stall_s"], "device_probe": e["device_probe"],
+             "action": e["action"], "stacks_file": e["stacks_file"]}
+            for e in events if e.get("event") == "hang"]
+
+
+def straggler_lines(entries) -> list:
+    return [f"  STRAGGLER @ step {e['step']}: host {e['slow_host']} at "
+            f"{e['host_ms']:.1f} ms vs fleet {e['fleet_ms']:.1f} ms "
+            f"({e['ratio']}x)" for e in entries]
+
+
+def hang_lines(entries) -> list:
+    return [f"  HANG on host {e['host']} @ step {e['step']}: stalled "
+            f"{e['stall_s']:.1f}s, device probe {e['device_probe']}, "
+            f"action {e['action']} (stacks: {e['stacks_file']})"
+            for e in entries]
+
+
+def goodput_lines(g) -> list:
+    """Render a goodput dict — writer-side (GoodputMeter.summary) or
+    reader-side (partial_goodput) — to report lines. ONE renderer,
+    shared with tools/fleet_report.py, so the two reports cannot
+    drift."""
+    if not g:
+        return []
+    if g.get("partial"):
+        return [f"  goodput (PARTIAL, reconstructed): compile "
+                f"{g['compile_s']:.1f}s, checkpoint "
+                f"{g['checkpoint_s']:.1f}s, governor sleep "
+                f"{g['governor_sleep_s']:.1f}s, input-wait "
+                f"{100 * g['input_wait_frac_of_step']:.1f}% of step "
+                f"time over {g['observed_span_s']:.1f}s observed"]
+    buckets = ", ".join(
+        f"{k[:-2]} {v:.1f}s" for k, v in g.items()
+        if k.endswith("_s") and k != "total_s" and v)
+    return [f"  goodput: {100 * g['productive_frac']:.1f}% productive "
+            f"of {g['total_s']:.1f}s ({buckets})"]
+
+
+def add_format_flags(ap: argparse.ArgumentParser) -> None:
+    """--format {text,json} (+ the legacy --json alias), shared by both
+    report tools so the output contract cannot drift between them."""
+    ap.add_argument("--format", choices=["text", "json"], default="text",
+                    help="'json' = machine-readable summary (the same "
+                         "section builders the text report renders — "
+                         "dashboards and CI consume the numbers humans "
+                         "read)")
+    ap.add_argument("--json", action="store_true",
+                    help="alias for --format json (kept for existing "
+                         "callers)")
+
+
+def emit_output(summary: dict, args, text_printer) -> None:
+    """ONE serializer for both report tools: the summary dict the
+    section builders assembled is either json.dumps'd verbatim or
+    handed to the tool's text printer — the JSON output IS the text
+    report's input, so the two can never disagree."""
+    try:
+        if args.json or args.format == "json":
+            print(json.dumps(summary, indent=1))
+        else:
+            text_printer(summary)
+    except BrokenPipeError:  # `report run.jsonl | head` is a normal use
+        pass
+
+
+# -- run-registry resolution (round 23, DESIGN.md §28) ----------------------
+
+def add_registry_flags(ap: argparse.ArgumentParser) -> None:
+    """--registry/--run, shared by every report tool that can resolve
+    its input from the run registry instead of a raw file path."""
+    ap.add_argument("--registry", default="",
+                    help="run registry stream (core/run_registry.py); "
+                         "default $MFT_RUN_REGISTRY")
+    ap.add_argument("--run", default="",
+                    help="resolve the input path from the registry by "
+                         "run id, unique id prefix, or git rev — "
+                         "instead of passing a file path")
+
+
+def resolve_stream(args, what: str = "telemetry stream",
+                   suffix: str = ".jsonl") -> str:
+    """The tool's input path: --run wins (registry artifact lookup —
+    after resolution it IS a path invocation, so output stays
+    byte-identical), else the positional. SystemExit with a named
+    error when neither resolves."""
+    token = getattr(args, "run", "")
+    if token:
+        from mobilefinetuner_tpu.core.run_registry import registry_from
+        reg = registry_from(getattr(args, "registry", ""))
+        if reg is None:
+            raise SystemExit(
+                "--run needs --registry or $MFT_RUN_REGISTRY")
+        path = reg.artifact_for(token, suffix=suffix)
+        if not path:
+            raise SystemExit(
+                f"--run {token!r}: no {what} artifact ({suffix}) "
+                f"resolved from registry {reg.path}")
+        return path
+    path = getattr(args, "jsonl", None)
+    if not path:
+        raise SystemExit(f"pass a {what} path or --run <id>")
+    return path
+
+
+# -- longitudinal trend section (round 23, DESIGN.md §28) -------------------
+
+#: eight-level unicode sparkline ramp (lowest..highest)
+SPARK_RAMP = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values) -> str:
+    """One unicode sparkline over a numeric series (Nones skipped on
+    scale, rendered as spaces in place) — the per-metric history cell
+    of the observatory's trend table."""
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    out = []
+    for v in values:
+        if v is None:
+            out.append(" ")
+            continue
+        i = int((v - lo) / span * (len(SPARK_RAMP) - 1))
+        out.append(SPARK_RAMP[i])
+    return "".join(out)
+
+
+def trend_lines(series) -> list:
+    """Markdown trend table over observatory series dicts (each one:
+    metric/config/platform/values/runs/verdict fields — see
+    tools/observatory.py). One row per (platform, config, metric),
+    regressions flagged in the status column."""
+    if not series:
+        return []
+    rows = ["| platform | config | metric | n | latest | median | z | trend | status |",
+            "|---|---|---|---|---|---|---|---|---|"]
+    for s in series:
+        status = "**REGRESSED**" if s.get("regressed") else "ok"
+        z = s.get("z")
+        med = s.get("median")
+        latest = s.get("value")
+        rows.append(
+            "| {platform} | {config} | {metric} | {n} | {latest} | "
+            "{median} | {z} | `{spark}` | {status} |".format(
+                platform=s.get("platform", "?"),
+                config=s.get("config", "?"),
+                metric=s.get("metric", "?"),
+                n=s.get("n", 0),
+                latest=_fmt(latest, 3) if latest is not None else "-",
+                median=_fmt(med, 3) if med is not None else "-",
+                z=_fmt(z, 2) if z is not None else "-",
+                spark=sparkline(s.get("values", [])),
+                status=status))
+    return rows

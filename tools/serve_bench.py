@@ -925,6 +925,11 @@ def main(argv=None) -> int:
     ap.add_argument("--telemetry_out", default="")
     ap.add_argument("--out", default="",
                     help="append rows to this JSON artifact")
+    ap.add_argument("--run_registry", default="",
+                    help="append-only run registry stream (core/"
+                         "run_registry.py): one crash-safe record per "
+                         "bench invocation; default $MFT_RUN_REGISTRY, "
+                         "empty = off")
     # --- robustness / fault harness (round 14, DESIGN.md §19) ---------
     ap.add_argument("--max_queue", type=int, default=0,
                     help="bounded admission: cap the FCFS queue; "
@@ -1000,97 +1005,113 @@ def main(argv=None) -> int:
         from mobilefinetuner_tpu.parallel.host_devices import \
             force_host_devices
         force_host_devices(max(8, mesh_dp * mesh_tp))
-    if args.router > 0:
-        if args.inject:
-            raise SystemExit("--router composes with --inject only by "
-                             "killing replica processes (see the "
-                             "kill-one-replica e2e); drop --inject")
-        if mesh_dp * mesh_tp > 1:
-            raise SystemExit("--router replicas are single-host "
-                             "engines (data parallelism IS the "
-                             "replica set); drop --mesh")
-        base = args.telemetry_out
-        if not base:
-            import tempfile
-            base = os.path.join(
-                tempfile.mkdtemp(prefix="serve_fleet_"), "fleet.jsonl")
-            print(f"--router: telemetry stream at {base} "
-                  f"(pass --telemetry_out to choose)")
-        baseline = None
-        if args.router_baseline:
-            brows = run_rows(
+    # run registry (core/run_registry.py, DESIGN.md §28): one
+    # crash-safe record per bench invocation. Admission rejects and
+    # fault-harness aborts finalize with the exception's name via the
+    # handle's __exit__; a SIGKILL mid-run settles to "interrupted"
+    # on the next registry open.
+    import contextlib
+    from mobilefinetuner_tpu.core.run_registry import RunRegistry
+    _reg = RunRegistry.from_args(args)
+    run_rec = _reg.begin(
+        "serve", "serve_bench", config=vars(args),
+        platform=jax.devices()[0].platform,
+        mesh=({"data": mesh_dp, "model": mesh_tp}
+              if mesh_dp * mesh_tp > 1 else None),
+        artifacts=[p for p in (args.telemetry_out, args.out)
+                   if p]) if _reg else None
+    with run_rec if run_rec is not None else contextlib.nullcontext():
+        if args.router > 0:
+            if args.inject:
+                raise SystemExit("--router composes with --inject only by "
+                                 "killing replica processes (see the "
+                                 "kill-one-replica e2e); drop --inject")
+            if mesh_dp * mesh_tp > 1:
+                raise SystemExit("--router replicas are single-host "
+                                 "engines (data parallelism IS the "
+                                 "replica set); drop --mesh")
+            base = args.telemetry_out
+            if not base:
+                import tempfile
+                base = os.path.join(
+                    tempfile.mkdtemp(prefix="serve_fleet_"), "fleet.jsonl")
+                print(f"--router: telemetry stream at {base} "
+                      f"(pass --telemetry_out to choose)")
+            baseline = None
+            if args.router_baseline:
+                brows = run_rows(
+                    model, args.rate, args.requests, args.adapters,
+                    num_slots=args.num_slots, block_T=args.block_T,
+                    num_blocks=args.num_blocks, max_prompt=args.max_prompt,
+                    max_new=args.max_new, dtype=args.dtype, seed=args.seed,
+                    prompt_lo=args.prompt_lo, prompt_hi=args.prompt_hi,
+                    max_queue=args.max_queue, shed_policy=args.shed_policy,
+                    deadline_ms=args.deadline_ms or None,
+                    prefix_cache=bool(args.prefix_cache),
+                    max_prompt_chunked=args.max_prompt_chunked,
+                    sampling=bool(args.sampling),
+                    prefix_pool=args.prefix_pool,
+                    prefix_frac=args.prefix_frac)
+                baseline = {r["offered_rps"]: r["ttft_ms"]["p99"]
+                            for r in brows}
+                rows = brows
+            else:
+                rows = []
+            rows = rows + run_router_rows(
                 model, args.rate, args.requests, args.adapters,
-                num_slots=args.num_slots, block_T=args.block_T,
-                num_blocks=args.num_blocks, max_prompt=args.max_prompt,
-                max_new=args.max_new, dtype=args.dtype, seed=args.seed,
+                args.router, base, num_slots=args.num_slots,
+                block_T=args.block_T, num_blocks=args.num_blocks,
+                max_prompt=args.max_prompt, max_new=args.max_new,
+                dtype=args.dtype, seed=args.seed,
                 prompt_lo=args.prompt_lo, prompt_hi=args.prompt_hi,
                 max_queue=args.max_queue, shed_policy=args.shed_policy,
-                deadline_ms=args.deadline_ms or None,
+                stats_every=args.stats_every or 10,
                 prefix_cache=bool(args.prefix_cache),
                 max_prompt_chunked=args.max_prompt_chunked,
                 sampling=bool(args.sampling),
                 prefix_pool=args.prefix_pool,
-                prefix_frac=args.prefix_frac)
-            baseline = {r["offered_rps"]: r["ttft_ms"]["p99"]
-                        for r in brows}
-            rows = brows
+                prefix_frac=args.prefix_frac,
+                deadline_ms=args.deadline_ms or None,
+                baseline=baseline)
         else:
-            rows = []
-        rows = rows + run_router_rows(
-            model, args.rate, args.requests, args.adapters,
-            args.router, base, num_slots=args.num_slots,
-            block_T=args.block_T, num_blocks=args.num_blocks,
-            max_prompt=args.max_prompt, max_new=args.max_new,
-            dtype=args.dtype, seed=args.seed,
-            prompt_lo=args.prompt_lo, prompt_hi=args.prompt_hi,
-            max_queue=args.max_queue, shed_policy=args.shed_policy,
-            stats_every=args.stats_every or 10,
-            prefix_cache=bool(args.prefix_cache),
-            max_prompt_chunked=args.max_prompt_chunked,
-            sampling=bool(args.sampling),
-            prefix_pool=args.prefix_pool,
-            prefix_frac=args.prefix_frac,
-            deadline_ms=args.deadline_ms or None,
-            baseline=baseline)
-    else:
-        rows = run_rows(model, args.rate, args.requests, args.adapters,
-                        num_slots=args.num_slots, block_T=args.block_T,
-                        num_blocks=args.num_blocks,
-                        max_prompt=args.max_prompt, max_new=args.max_new,
-                        dtype=args.dtype, seed=args.seed,
-                        prompt_lo=args.prompt_lo, prompt_hi=args.prompt_hi,
-                        telemetry_out=args.telemetry_out,
-                        max_queue=args.max_queue,
-                        shed_policy=args.shed_policy,
-                        on_step_error=args.on_step_error,
-                        deadline_ms=args.deadline_ms or None,
-                        stats_every=args.stats_every, inject=args.inject,
-                        drain=bool(args.drain),
-                        watchdog_mode=args.watchdog,
-                        watchdog_min_s=args.watchdog_min_s,
-                        hbm_cap_mb=args.hbm_cap_mb,
-                        hbm_headroom=args.hbm_headroom,
-                        trace_spans=bool(args.trace_spans),
-                        metrics_port=args.metrics_port,
-                        metrics_addr=args.metrics_addr,
-                        mesh_dp=mesh_dp, mesh_tp=mesh_tp,
-                        prefix_cache=bool(args.prefix_cache),
-                        max_prompt_chunked=args.max_prompt_chunked,
-                        sampling=bool(args.sampling),
-                        prefix_pool=args.prefix_pool,
-                        prefix_frac=args.prefix_frac)
-    if args.out:
-        art = {"device": jax.devices()[0].device_kind,
-               "jax": jax.__version__, "rows": []}
-        if os.path.exists(args.out):
-            with open(args.out) as f:
-                art = json.load(f)
-        art["rows"].extend(rows)
-        tmp = args.out + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(art, f, indent=1)
-        os.replace(tmp, args.out)
-    return 0
+            rows = run_rows(model, args.rate, args.requests, args.adapters,
+                            num_slots=args.num_slots, block_T=args.block_T,
+                            num_blocks=args.num_blocks,
+                            max_prompt=args.max_prompt, max_new=args.max_new,
+                            dtype=args.dtype, seed=args.seed,
+                            prompt_lo=args.prompt_lo, prompt_hi=args.prompt_hi,
+                            telemetry_out=args.telemetry_out,
+                            max_queue=args.max_queue,
+                            shed_policy=args.shed_policy,
+                            on_step_error=args.on_step_error,
+                            deadline_ms=args.deadline_ms or None,
+                            stats_every=args.stats_every, inject=args.inject,
+                            drain=bool(args.drain),
+                            watchdog_mode=args.watchdog,
+                            watchdog_min_s=args.watchdog_min_s,
+                            hbm_cap_mb=args.hbm_cap_mb,
+                            hbm_headroom=args.hbm_headroom,
+                            trace_spans=bool(args.trace_spans),
+                            metrics_port=args.metrics_port,
+                            metrics_addr=args.metrics_addr,
+                            mesh_dp=mesh_dp, mesh_tp=mesh_tp,
+                            prefix_cache=bool(args.prefix_cache),
+                            max_prompt_chunked=args.max_prompt_chunked,
+                            sampling=bool(args.sampling),
+                            prefix_pool=args.prefix_pool,
+                            prefix_frac=args.prefix_frac)
+        if args.out:
+            art = {"device": jax.devices()[0].device_kind,
+                   "jax": jax.__version__, "rows": []}
+            if os.path.exists(args.out):
+                with open(args.out) as f:
+                    art = json.load(f)
+            art["rows"].extend(rows)
+            tmp = args.out + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(art, f, indent=1)
+            os.replace(tmp, args.out)
+        return 0
 
 
 if __name__ == "__main__":
